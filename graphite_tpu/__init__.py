@@ -1,0 +1,37 @@
+"""graphite_tpu — a TPU-native tile-array multicore simulator.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of MIT's Graphite
+distributed multicore simulator (reference: nmtrmail/Graphite).  Instead of
+Graphite's two-host-threads-per-tile + TCP-socket transport design
+(`common/system/sim_thread.cc`, `common/transport/socktransport.cc`), all tile
+state lives in a struct-of-arrays tensor sharded over the TPU's ICI mesh and
+every tile advances one lax-barrier quantum per compiled XLA step.
+
+Layer map (mirrors SURVEY.md §1, reference layers L0–L7):
+
+    frontend/   trace producers (the Pin-frontend analog: synthetic + capture)
+    config/     carbon_sim.cfg-compatible config + target-topology math
+    models/     core timing, cache/coherence, NoC, DRAM, branch predictors
+    ops/        vectorized primitives those models share (caches, queues, mailboxes)
+    engine/     the quantum-step state machine + Simulator orchestration
+    parallel/   device-mesh sharding (pjit/shard_map/ppermute over ICI)
+    power/      McPAT/DSENT-equivalent energy-area models fed by event counters
+    stats/      sim.out-style summary + statistics traces
+    utils/      logging, misc helpers
+
+Simulated time is exact integer picoseconds throughout
+(reference: `common/misc/time_types.h:31-78`), so the package enables
+jax_enable_x64 at import.  Hot per-quantum deltas still use int32 internally.
+"""
+
+import jax
+
+# Picosecond-resolution simulated time needs 64-bit integers (a 1 GHz tile
+# overflows int32 picoseconds after ~2ms of simulated time).  TPUs emulate
+# int64 in pairs of int32 ops; the hot kernels keep deltas in int32.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from graphite_tpu.time_types import Time, Latency  # noqa: E402,F401
+from graphite_tpu.config import ConfigFile, SimConfig  # noqa: E402,F401

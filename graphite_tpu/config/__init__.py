@@ -1,0 +1,17 @@
+"""Config subsystem: carbon_sim.cfg-compatible parsing + target topology.
+
+Reference: `common/config/` (INI parser, boost-spirit grammar),
+`common/misc/handle_args.cc` (CLI overrides), `common/misc/config.{h,cc}`
+(target-topology Config object).
+"""
+
+from graphite_tpu.config.config_file import ConfigFile, parse_override_args
+from graphite_tpu.config.simconfig import SimConfig, SimulationMode, TileSpec
+
+__all__ = [
+    "ConfigFile",
+    "parse_override_args",
+    "SimConfig",
+    "SimulationMode",
+    "TileSpec",
+]

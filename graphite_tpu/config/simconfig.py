@@ -1,0 +1,232 @@
+"""Target-topology configuration: tiles, processes, MCP/thread-spawner math.
+
+Reference: `common/misc/config.{h,cc}`.
+ - total_tiles = application_tiles + 1 (MCP) [+ num_processes thread-spawner
+   tiles in FULL mode] (`config.cc:59-96`).
+ - MCP lives on the last tile, owned by process 0 (`config.cc:191-193`,
+   `config.h:88-89`).
+ - Thread-spawner for process p is tile total_tiles-(1+num_processes-p),
+   i.e. tiles application_tiles..total_tiles-2 (`config.cc:123-133,180-189`).
+ - Default process→tile mapping is round-robin striping of application tiles
+   (`config.cc:220-227`); mesh-aware models may override it
+   (`config.cc:198-218`, `network_model.h:95`).
+ - Per-tile heterogeneous core/cache types come from the `[tile] model_list`
+   tuples `<num,core,l1i,l1d,l2>` with `default` placeholders
+   (`config.cc:365-472`, `carbon_sim.cfg:158-176`).
+
+In the TPU build "process" maps to *device shard*: the tile axis of the
+state tensor is sharded over the ICI mesh, and the process→tile mapping
+becomes the sharding layout.  The MCP/thread-spawner bookkeeping is kept for
+config parity (tile counts, summary layout, trace addressing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import re
+
+from graphite_tpu.config.config_file import ConfigFile
+from graphite_tpu.time_types import ghz_to_mhz
+
+INVALID_TILE_ID = -1
+
+# The four static networks (`common/network/packet_type.h:40-56`).
+STATIC_NETWORK_USER = 0
+STATIC_NETWORK_MEMORY = 1
+STATIC_NETWORK_SYSTEM = 2
+STATIC_NETWORK_DVFS = 3
+NUM_STATIC_NETWORKS = 4
+STATIC_NETWORK_NAMES = ("user", "memory", "system", "dvfs")
+
+
+class SimulationMode(enum.Enum):
+    FULL = "full"
+    LITE = "lite"
+
+
+@dataclasses.dataclass(frozen=True)
+class TileSpec:
+    """Per-tile model selection (`config.cc:447`, TileParameters)."""
+
+    core_type: str = "simple"
+    l1_icache_type: str = "T1"
+    l1_dcache_type: str = "T1"
+    l2_cache_type: str = "T1"
+
+
+def _parse_list(text: str, delims: str) -> list[str]:
+    """Split a `"<a,b>, <c,d>"`-style list on the given bracket delimiters.
+
+    Mirrors the reference's parseList utility usage in `config.cc:392,405`.
+    """
+    if delims == "<>":
+        return [m.group(1).strip() for m in re.finditer(r"<([^<>]*)>", text)]
+    return [s.strip() for s in text.split(delims) if s.strip()]
+
+
+class SimConfig:
+    """The resolved target topology (reference `Config` singleton analog)."""
+
+    def __init__(self, cfg: ConfigFile):
+        self.cfg = cfg
+        self.application_tiles: int = cfg.get_int("general/total_cores")
+        self.num_processes: int = cfg.get_int("general/num_processes", 1)
+        self.mode = SimulationMode(cfg.get_string("general/mode", "lite"))
+        self.enable_core_modeling = cfg.get_bool("general/enable_core_modeling", True)
+        self.enable_power_modeling = cfg.get_bool("general/enable_power_modeling", False)
+        self.enable_area_modeling = cfg.get_bool("general/enable_area_modeling", False)
+        self.enable_shared_mem = cfg.get_bool("general/enable_shared_mem", True)
+        self.output_file = cfg.get_string("general/output_file", "sim.out")
+        self.max_frequency_mhz = ghz_to_mhz(cfg.get_float("general/max_frequency", 1.0))
+        self.technology_node = cfg.get_int("general/technology_node", 45)
+        self.temperature = cfg.get_int("general/temperature", 300)
+        self.tile_width_mm = cfg.get_float("general/tile_width", 1.0)
+
+        if self.application_tiles <= 0:
+            raise ValueError("general/total_cores must be > 0")
+        if self.num_processes <= 0:
+            raise ValueError("general/num_processes must be > 0")
+        if self.mode == SimulationMode.LITE and self.num_processes > 1:
+            raise ValueError("Use only 1 process in lite mode")  # config.cc:66-70
+
+        # Tile-count bookkeeping (`config.cc:77-82`).
+        self.total_tiles = self.application_tiles + 1  # + MCP
+        if self.mode == SimulationMode.FULL:
+            self.total_tiles += self.num_processes  # + thread spawners
+
+        # Static network model types (`config.cc:474-497`).
+        self.network_types: list[str] = [
+            cfg.get_string("network/user", "magic"),
+            cfg.get_string("network/memory", "magic"),
+            "magic",  # SYSTEM is always magic (config.cc:484)
+            "magic",  # DVFS is always magic (config.cc:485)
+        ]
+
+        self.tile_specs = self._parse_tile_parameters()
+        self.process_to_tiles, self.tile_to_process = self._compute_tile_map()
+
+    # --- derived ids (`config.cc:108-147`, `config.h:88-89`) --------------
+
+    @property
+    def mcp_tile_id(self) -> int:
+        return self.total_tiles - 1
+
+    def is_application_tile(self, tile_id: int) -> bool:
+        return 0 <= tile_id < self.application_tiles
+
+    def thread_spawner_tile_id(self, proc_num: int) -> int:
+        if self.mode != SimulationMode.FULL:
+            return INVALID_TILE_ID
+        return self.total_tiles - (1 + self.num_processes - proc_num)
+
+    def is_thread_spawner_tile(self, tile_id: int) -> bool:
+        return (
+            self.mode == SimulationMode.FULL
+            and self.application_tiles <= tile_id < self.total_tiles - 1
+        )
+
+    # --- model_list parsing (`config.cc:365-472`) -------------------------
+
+    def _parse_tile_parameters(self) -> list[TileSpec]:
+        default = TileSpec()
+        model_list = self.cfg.get_string("tile/model_list", "<default>")
+        specs: list[TileSpec] = []
+        for tup in _parse_list(model_list, "<>"):
+            fields = [f.strip() for f in tup.split(",")]
+            num = self.application_tiles
+            vals = [default.core_type, default.l1_icache_type,
+                    default.l1_dcache_type, default.l2_cache_type]
+            for i, f in enumerate(fields):
+                if f == "default" or f == "":
+                    continue
+                if i == 0:
+                    num = int(f)
+                elif i <= 4:
+                    vals[i - 1] = f
+                else:
+                    raise ValueError(f"tile tuple has too many fields: {tup!r}")
+            specs.extend(TileSpec(*vals) for _ in range(num))
+            if len(specs) > self.application_tiles:
+                raise ValueError(
+                    f"model_list initializes {len(specs)} tiles, "
+                    f"but there are only {self.application_tiles} application tiles"
+                )
+        if len(specs) != self.application_tiles:
+            raise ValueError(
+                f"model_list initializes {len(specs)} of "
+                f"{self.application_tiles} application tiles"
+            )
+        # MCP + thread-spawner tiles get default models (`config.cc:466-471`).
+        specs.extend(TileSpec() for _ in range(self.total_tiles - self.application_tiles))
+        return specs
+
+    # --- process ↔ tile mapping (`config.cc:154-228`) ---------------------
+
+    def _compute_tile_map(self) -> tuple[list[list[int]], list[int]]:
+        mapping = self._network_process_mapping()
+        if mapping is None:
+            # Default: round-robin striping (`config.cc:220-227`).
+            mapping = [[] for _ in range(self.num_processes)]
+            for t in range(self.application_tiles):
+                mapping[t % self.num_processes].append(t)
+
+        proc_to_tiles = [list(tl) for tl in mapping]
+        tile_to_proc = [0] * self.total_tiles
+        for p, tiles in enumerate(proc_to_tiles):
+            for t in tiles:
+                tile_to_proc[t] = p
+        if self.mode == SimulationMode.FULL:
+            # Thread-spawner tiles: one per process (`config.cc:177-189`).
+            for p in range(self.num_processes):
+                t = self.application_tiles + p
+                tile_to_proc[t] = p
+                proc_to_tiles[p].append(t)
+        # MCP on the last tile, process 0 (`config.cc:191-193`).
+        proc_to_tiles[0].append(self.total_tiles - 1)
+        tile_to_proc[self.total_tiles - 1] = 0
+        return proc_to_tiles, tile_to_proc
+
+    def _network_process_mapping(self) -> list[list[int]] | None:
+        """Mesh-aware process→tile mapping override (`config.cc:198-218`).
+
+        emesh_hop_by_hop/atac stripe *contiguous mesh blocks* per process so
+        cross-process traffic rides neighboring links; in the TPU build the
+        same layout keeps neighbor `ppermute` exchanges on adjacent ICI
+        devices.  Implemented in network models; queried here lazily to avoid
+        an import cycle.
+        """
+        from graphite_tpu.models.network_emesh import (
+            emesh_process_to_tile_mapping,
+            is_tile_count_permissible,
+        )
+
+        for net_type in self.network_types:
+            if net_type in ("emesh_hop_counter", "emesh_hop_by_hop", "atac"):
+                # Mesh models require an exact w*h factorization; the
+                # reference aborts at `config.cc:87-90`.
+                if not is_tile_count_permissible(self.application_tiles):
+                    raise ValueError(
+                        f"tile count {self.application_tiles} does not factor "
+                        f"into a full 2D mesh (network model {net_type!r})"
+                    )
+        for net_type in self.network_types:
+            if net_type in ("emesh_hop_by_hop", "atac"):
+                return emesh_process_to_tile_mapping(
+                    self.application_tiles, self.num_processes
+                )
+        return None
+
+    # --- misc -------------------------------------------------------------
+
+    def tile_spec(self, tile_id: int) -> TileSpec:
+        return self.tile_specs[tile_id]
+
+    def process_map_hosts(self) -> list[str]:
+        """[process_map] hostnames (`carbon_sim.cfg:119-139`)."""
+        sec = self.cfg.section("process_map")
+        hosts = []
+        for p in range(self.num_processes):
+            raw = sec.get(f"process{p}", '"127.0.0.1"').strip().strip('"')
+            hosts.append(raw)
+        return hosts

@@ -1,0 +1,6 @@
+"""Timing models: cores, caches/coherence, NoC, DRAM, branch prediction.
+
+Each module re-implements the *semantics* of one reference model family
+(`common/tile/core/models/`, `common/tile/memory_subsystem/`,
+`common/network/models/`) as vectorized JAX functions over the tile axis.
+"""

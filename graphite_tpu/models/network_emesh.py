@@ -1,0 +1,115 @@
+"""Electrical-mesh topology math shared by the e-mesh NoC models.
+
+Host-side (static topology) pieces of the reference's
+`common/network/models/network_model_emesh_hop_by_hop.cc`:
+ - mesh dims: width = floor(sqrt(N)), height = ceil(N/width); the tile
+   count must factor exactly (`:308-320`);
+ - XY coordinates and Manhattan distance (`:282-296`);
+ - greedy memory-controller placement on a sub-mesh grid (`:322-364`);
+ - process→tile mapping as contiguous rectangular blocks (`:366-433`) — in
+   the TPU build this is the sharding layout that keeps X/Y neighbor
+   `ppermute` exchanges on adjacent ICI devices.
+
+Device-side routing (per-hop timing, contention, broadcast tree) lives in
+`network_emesh_hop_counter.py` / `network_emesh_hop_by_hop.py`.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def mesh_dims(tile_count: int) -> tuple[int, int]:
+    """(width, height) of the 2D mesh (`network_model_emesh_hop_by_hop.cc:286-287`)."""
+    width = int(math.floor(math.sqrt(tile_count)))
+    height = int(math.ceil(tile_count / width))
+    return width, height
+
+
+def is_tile_count_permissible(tile_count: int) -> bool:
+    """Mesh requires an exact w*h factorization (`:308-320`)."""
+    w, h = mesh_dims(tile_count)
+    return tile_count == w * h
+
+
+def tile_xy(tile_id: int, mesh_width: int) -> tuple[int, int]:
+    return tile_id % mesh_width, tile_id // mesh_width
+
+
+def manhattan_distance(sender: int, receiver: int, mesh_width: int) -> int:
+    sx, sy = tile_xy(sender, mesh_width)
+    dx, dy = tile_xy(receiver, mesh_width)
+    return abs(sx - dx) + abs(sy - dy)
+
+
+def memory_controller_positions(num_controllers: int, tile_count: int) -> list[int]:
+    """Greedy center-of-block placement (`:322-364`)."""
+    mesh_width, mesh_height = mesh_dims(tile_count)
+    mc_w = int(math.floor(math.sqrt(num_controllers)))
+    mc_h = int(math.ceil(num_controllers / mc_w))
+
+    positions: list[int] = []
+    for j in range(mc_h):
+        for i in range(mc_w):
+            if len(positions) >= num_controllers:
+                break
+            size_x = mesh_width // mc_w
+            size_y = mesh_height // mc_h
+            base_x = i * size_x
+            base_y = j * size_y
+            if i == mc_w - 1:
+                size_x = mesh_width - (mc_w - 1) * size_x
+            if j == mc_h - 1:
+                size_y = mesh_height - (mc_h - 1) * size_y
+            pos_x = base_x + size_x // 2
+            pos_y = base_y + size_y // 2
+            positions.append(pos_x + pos_y * mesh_width)
+    return positions
+
+
+def emesh_process_to_tile_mapping(
+    tile_count: int, process_count: int
+) -> list[list[int]]:
+    """Contiguous rectangular block decomposition (`:366-433`).
+
+    Processes form a floor(sqrt(P)) × floor(P/pw) grid over the lower
+    portion of the mesh; leftover processes split the remaining rows in
+    vertical strips — reproduced exactly so sharded runs agree with the
+    reference's distributed layout.
+    """
+    mesh_width, mesh_height = mesh_dims(tile_count)
+    mapping: list[list[int]] = [[] for _ in range(process_count)]
+
+    pw = int(math.floor(math.sqrt(process_count)))
+    ph = int(math.floor(process_count / pw))
+    mesh_height_l = int((mesh_height * pw * ph) / process_count)
+
+    for i in range(pw):
+        for j in range(ph):
+            size_x = mesh_width // pw
+            size_y = mesh_height_l // ph
+            base_x = i * size_x
+            base_y = j * size_y
+            if i == pw - 1:
+                size_x = mesh_width - (pw - 1) * size_x
+            if j == ph - 1:
+                size_y = mesh_height_l - (ph - 1) * size_y
+            for ii in range(size_x):
+                for jj in range(size_y):
+                    tile_id = (base_x + ii) + (base_y + jj) * mesh_width
+                    mapping[i + j * pw].append(tile_id)
+
+    procs_left = process_count - pw * ph
+    for p in range(pw * ph, process_count):
+        size_x = mesh_width // procs_left
+        size_y = mesh_height - mesh_height_l
+        base_x = (p - pw * ph) * size_x
+        base_y = mesh_height_l
+        if p == process_count - 1:
+            size_x = mesh_width - (procs_left - 1) * size_x
+        for ii in range(size_x):
+            for jj in range(size_y):
+                tile_id = (base_x + ii) + (base_y + jj) * mesh_width
+                mapping[p].append(tile_id)
+
+    return mapping

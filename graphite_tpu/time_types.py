@@ -1,0 +1,135 @@
+"""Simulated-time types: picosecond-resolution Time and cycle Latency.
+
+Reference semantics: `common/misc/time_types.h:7-119`.
+ - Time is an integer picosecond count (`time_types.h:31-78`).
+ - Latency is (cycles, frequency-in-GHz); conversion to picoseconds is
+   ceil(1000 * cycles / frequency) (`time_types.h:81-86`).
+ - Time.toCycles(frequency) = ceil(ps * frequency / 1000) (`time_types.h:104-109`).
+ - Time.toNanosec = ceil(ps / 1000) (`time_types.h:111-114`).
+
+Design differences for the TPU build:
+ - Frequencies are carried as *integer megahertz* so every conversion is exact
+   integer ceil-division — device code (int32/int64 tensors) and host code
+   produce bit-identical results, which the determinism tests rely on.  The
+   reference's `double`-based ceil matches integer ceil-div for every
+   frequency expressible in MHz (all of `technology/dvfs_levels_*.cfg` is).
+ - Both scalar-host and jnp-tensor forms are provided; the tensor forms are
+   what the vectorized models use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+# Conversion factors.
+PS_PER_NS = 1000
+PS_PER_CYCLE_NUMERATOR = 1_000_000  # ps/cycle = 1e6 / freq_mhz
+
+
+def ghz_to_mhz(freq_ghz: float) -> int:
+    """Represent a GHz float frequency exactly as integer MHz."""
+    mhz = round(freq_ghz * 1000.0)
+    if mhz <= 0:
+        raise ValueError(f"non-positive frequency: {freq_ghz} GHz")
+    return int(mhz)
+
+
+def _ceil_div(a, b):
+    """Ceil division for non-negative ints; works on ints and jnp arrays."""
+    return (a + b - 1) // b
+
+
+def cycles_to_ps(cycles, freq_mhz):
+    """Latency::toPicosec (`time_types.h:81-86`): ceil(1e6*cycles/freq_mhz).
+
+    Works elementwise on jnp int arrays (int64 recommended) and python ints.
+    """
+    return _ceil_div(cycles * PS_PER_CYCLE_NUMERATOR, freq_mhz)
+
+
+def ps_to_cycles(ps, freq_mhz):
+    """Time::toCycles (`time_types.h:104-109`): ceil(ps*freq_mhz/1e6)."""
+    return _ceil_div(ps * freq_mhz, PS_PER_CYCLE_NUMERATOR)
+
+
+def ps_to_ns(ps):
+    """Time::toNanosec (`time_types.h:111-114`): ceil(ps/1000)."""
+    return _ceil_div(ps, PS_PER_NS)
+
+
+def ns_to_ps(ns):
+    return ns * PS_PER_NS
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Time:
+    """Host-side scalar simulated time, integer picoseconds.
+
+    Mirrors `common/misc/time_types.h:31-78`.  Device-side code uses raw
+    int64 tensors of picoseconds; this wrapper is for host orchestration,
+    config parsing, and summaries.
+    """
+
+    ps: int = 0
+
+    def __add__(self, other: "Time | Latency") -> "Time":
+        if isinstance(other, Latency):
+            return Time(self.ps + other.to_ps())
+        return Time(self.ps + other.ps)
+
+    def __sub__(self, other: "Time") -> "Time":
+        return Time(self.ps - other.ps)
+
+    def to_cycles(self, freq_mhz: int) -> int:
+        return ps_to_cycles(self.ps, freq_mhz)
+
+    def to_ns(self) -> int:
+        return ps_to_ns(self.ps)
+
+    def to_sec(self) -> float:
+        return self.ps / 1.0e12
+
+    @staticmethod
+    def from_ns(ns: int) -> "Time":
+        return Time(ns * PS_PER_NS)
+
+    @staticmethod
+    def from_cycles(cycles: int, freq_mhz: int) -> "Time":
+        return Time(cycles_to_ps(cycles, freq_mhz))
+
+
+@dataclasses.dataclass(frozen=True)
+class Latency:
+    """Host-side (cycles, frequency) pair; `time_types.h:7-29`.
+
+    Adding latencies requires matching frequencies, as in the reference
+    (`time_types.h:88-102`).
+    """
+
+    cycles: int
+    freq_mhz: int
+
+    def __add__(self, other: "Latency") -> "Latency":
+        if self.freq_mhz != other.freq_mhz:
+            raise ValueError(
+                "Attempting to add latencies from different frequencies"
+            )
+        return Latency(self.cycles + other.cycles, self.freq_mhz)
+
+    def to_ps(self) -> int:
+        return cycles_to_ps(self.cycles, self.freq_mhz)
+
+    def to_time(self) -> Time:
+        return Time(self.to_ps())
+
+
+# --- Device-side helpers -------------------------------------------------
+
+TIME_DTYPE = jnp.int64  # absolute simulated times
+DELTA_DTYPE = jnp.int32  # per-quantum deltas (quantum ≤ ~2ms always fits)
+
+
+def time_zeros(shape):
+    return jnp.zeros(shape, dtype=TIME_DTYPE)

@@ -1,0 +1,20 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip sharding (shard_map over a Mesh) is tested on 8 virtual CPU
+devices since only one real TPU chip is available; the driver separately
+dry-runs the multi-chip path via __graft_entry__.dryrun_multichip.
+Must run before jax initializes its backends, hence env vars here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+import graphite_tpu  # noqa: E402,F401  (enables x64)

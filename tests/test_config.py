@@ -1,0 +1,190 @@
+"""Config parsing + topology math vs reference semantics.
+
+References: `common/config/` (INI surface), `common/misc/config.cc`
+(tile/process math), `carbon_sim.cfg` (the canonical file must parse).
+"""
+
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig, SimulationMode, TileSpec
+from graphite_tpu.config.config_file import ConfigError, parse_override_args
+from graphite_tpu.models.network_emesh import (
+    emesh_process_to_tile_mapping,
+    is_tile_count_permissible,
+    manhattan_distance,
+    memory_controller_positions,
+    mesh_dims,
+)
+
+REFERENCE_CFG = "/root/reference/carbon_sim.cfg"
+
+
+def test_parses_reference_carbon_sim_cfg():
+    cfg = ConfigFile.from_file(REFERENCE_CFG)
+    assert cfg.get_int("general/total_cores") == 64
+    assert cfg.get_int("general/num_processes") == 1
+    assert cfg.get_bool("general/enable_shared_mem") is True
+    assert cfg.get_string("general/mode") == "full"
+    assert cfg.get_float("general/max_frequency") == 2.0
+    assert cfg.get_string("general/output_file") == "sim.out"
+    assert cfg.get_int("clock_skew_management/lax_barrier/quantum") == 1000
+    assert cfg.get_string("clock_skew_management/scheme") == "lax_barrier"
+    assert cfg.get_int("core/static_instruction_costs/idiv") == 18
+    assert cfg.get_string("caching_protocol/type") == "pr_l1_pr_l2_dram_directory_msi"
+    assert cfg.get_int("l2_cache/T1/cache_size") == 512
+    assert cfg.get_string("l2_cache/T1/replacement_policy") == "lru"
+    assert cfg.get_string("dram_directory/total_entries") == "auto"
+    assert cfg.get_string("network/user") == "emesh_hop_counter"
+    # trailing comments stripped (carbon_sim.cfg:143)
+    assert cfg.get_int("runtime_energy_modeling/interval") == 1000
+    # quoted strings with commas (carbon_sim.cfg:151)
+    assert cfg.get_string("dvfs/domains").startswith("<1.0, CORE")
+    # float in scientific notation (carbon_sim.cfg:358)
+    assert cfg.get_float("link_model/optical/waveguide_delay_per_mm") == 10e-3
+    assert cfg.get_string("process_map/process3") == "127.0.0.1"
+
+
+def test_typed_getter_errors_and_defaults():
+    cfg = ConfigFile.from_string("[a/b]\nx = 5\nflag = false\n")
+    assert cfg.get_int("a/b/x") == 5
+    assert cfg.get_bool("a/b/flag") is False
+    assert cfg.get_int("a/b/missing", 7) == 7
+    with pytest.raises(ConfigError):
+        cfg.get_int("a/b/missing")
+
+
+def test_cli_overrides():
+    rest, overrides, path = parse_override_args(
+        ["prog", "--general/total_cores=16", "-c", "other.cfg", "--log/enabled=true"]
+    )
+    assert rest == ["prog"]
+    assert path == "other.cfg"
+    assert overrides.get_int("general/total_cores") == 16
+    assert overrides.get_bool("log/enabled") is True
+    base = ConfigFile.from_string("[general]\ntotal_cores = 64\n")
+    base.merge(overrides)
+    assert base.get_int("general/total_cores") == 16
+
+
+def _simconfig(total=64, procs=1, mode="full", extra=""):
+    text = (
+        f"[general]\ntotal_cores = {total}\nnum_processes = {procs}\n"
+        f"mode = {mode}\n{extra}"
+    )
+    return SimConfig(ConfigFile.from_string(text))
+
+
+class TestTopology:
+    def test_tile_count_bookkeeping_full_mode(self):
+        # config.cc:77-82: +1 MCP, +1 spawner per process
+        sc = _simconfig(total=64, procs=2, mode="full")
+        assert sc.application_tiles == 64
+        assert sc.total_tiles == 64 + 1 + 2
+        assert sc.mcp_tile_id == 66
+        # spawners on tiles app..total-2 (config.cc:180)
+        assert sc.thread_spawner_tile_id(0) == 64
+        assert sc.thread_spawner_tile_id(1) == 65
+        assert sc.is_thread_spawner_tile(64)
+        assert not sc.is_thread_spawner_tile(66)
+        assert sc.is_application_tile(63)
+        assert not sc.is_application_tile(64)
+
+    def test_tile_count_bookkeeping_lite_mode(self):
+        sc = _simconfig(total=16, procs=1, mode="lite")
+        assert sc.total_tiles == 17  # +MCP only
+        assert sc.thread_spawner_tile_id(0) == -1
+
+    def test_lite_mode_single_process_only(self):
+        with pytest.raises(ValueError):
+            _simconfig(total=16, procs=2, mode="lite")
+
+    def test_round_robin_striping(self):
+        # config.cc:220-227
+        sc = _simconfig(total=8, procs=3, mode="full")
+        assert sc.process_to_tiles[0][:3] == [0, 3, 6]
+        assert sc.process_to_tiles[1][:3] == [1, 4, 7]
+        assert sc.process_to_tiles[2][:2] == [2, 5]
+        # spawners appended per process, MCP on process 0 (config.cc:177-193)
+        assert sc.process_to_tiles[0][-1] == sc.mcp_tile_id
+        assert sc.tile_to_process[sc.mcp_tile_id] == 0
+        assert sc.tile_to_process[sc.thread_spawner_tile_id(2)] == 2
+
+    def test_model_list_parsing(self):
+        # config.cc:365-472 / carbon_sim.cfg:158-176
+        sc = _simconfig(
+            total=8,
+            extra='[tile]\nmodel_list = "<2,iocoom,T1,T1,T1>, <6,simple,default,default,default>"\n',
+        )
+        assert sc.tile_spec(0).core_type == "iocoom"
+        assert sc.tile_spec(1).core_type == "iocoom"
+        assert sc.tile_spec(2).core_type == "simple"
+        assert sc.tile_spec(7).core_type == "simple"
+        # MCP/spawner tiles get defaults (config.cc:466-471)
+        assert sc.tile_spec(sc.mcp_tile_id) == TileSpec()
+
+    def test_model_list_count_mismatch(self):
+        with pytest.raises(ValueError):
+            _simconfig(total=8, extra='[tile]\nmodel_list = "<4,iocoom>"\n')
+
+    def test_reference_cfg_end_to_end(self):
+        cfg = ConfigFile.from_file(REFERENCE_CFG)
+        sc = SimConfig(cfg)
+        assert sc.mode == SimulationMode.FULL
+        assert sc.application_tiles == 64
+        assert sc.total_tiles == 66
+        assert sc.tile_spec(0).core_type == "iocoom"
+        assert sc.network_types[0] == "emesh_hop_counter"
+        assert sc.network_types[2] == "magic"  # SYSTEM always magic
+        assert sc.max_frequency_mhz == 2000
+        assert len(sc.process_map_hosts()) == 1
+
+
+class TestEMeshTopology:
+    def test_mesh_dims(self):
+        # network_model_emesh_hop_by_hop.cc:286-287,308-320
+        assert mesh_dims(64) == (8, 8)
+        assert mesh_dims(12) == (3, 4)
+        assert is_tile_count_permissible(64)
+        assert is_tile_count_permissible(12)
+        assert not is_tile_count_permissible(7)
+
+    def test_manhattan_distance(self):
+        assert manhattan_distance(0, 63, 8) == 14
+        assert manhattan_distance(0, 1, 8) == 1
+        assert manhattan_distance(9, 9, 8) == 0
+
+    def test_memory_controller_positions(self):
+        pos = memory_controller_positions(4, 64)
+        assert len(pos) == 4
+        assert len(set(pos)) == 4
+        assert all(0 <= p < 64 for p in pos)
+
+    def test_process_mapping_partitions_all_tiles(self):
+        for tiles, procs in [(64, 4), (64, 2), (16, 3), (64, 1), (1024, 8)]:
+            mapping = emesh_process_to_tile_mapping(tiles, procs)
+            seen = sorted(t for tl in mapping for t in tl)
+            assert seen == list(range(tiles)), (tiles, procs)
+
+    def test_process_mapping_is_contiguous_blocks(self):
+        mapping = emesh_process_to_tile_mapping(64, 4)
+        # process 0 owns the lower-left 4x4 quadrant
+        assert sorted(mapping[0]) == [
+            x + y * 8 for y in range(4) for x in range(4)
+        ]
+
+    def test_impermissible_tile_count_rejected(self):
+        # config.cc:87-90: mesh models abort on non-factorable tile counts
+        with pytest.raises(ValueError, match="mesh"):
+            _simconfig(
+                total=7, procs=1, mode="full",
+                extra="[network]\nuser = emesh_hop_by_hop\nmemory = emesh_hop_by_hop\n",
+            )
+
+    def test_simconfig_uses_emesh_mapping(self):
+        sc = _simconfig(
+            total=64, procs=4, mode="full",
+            extra="[network]\nuser = emesh_hop_by_hop\nmemory = emesh_hop_by_hop\n",
+        )
+        assert sorted(sc.process_to_tiles[0][:-2]) == [
+            x + y * 8 for y in range(4) for x in range(4)
+        ]

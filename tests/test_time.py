@@ -1,0 +1,79 @@
+"""Time/Latency semantics vs `common/misc/time_types.h:81-119`."""
+
+import math
+
+import jax.numpy as jnp
+import pytest
+
+from graphite_tpu.time_types import (
+    Latency,
+    Time,
+    cycles_to_ps,
+    ghz_to_mhz,
+    ps_to_cycles,
+    ps_to_ns,
+)
+
+
+def ref_latency_to_ps(cycles: int, freq_ghz: float) -> int:
+    """The reference's double-based ceil (`time_types.h:81-86`)."""
+    return int(math.ceil((1000.0 * cycles) / freq_ghz))
+
+
+def ref_time_to_cycles(ps: int, freq_ghz: float) -> int:
+    """`time_types.h:104-109`."""
+    return int(math.ceil((float(ps) * freq_ghz) / 1.0e3))
+
+
+@pytest.mark.parametrize("freq_ghz", [0.5, 1.0, 1.5, 2.0, 2.5, 3.3])
+@pytest.mark.parametrize("cycles", [0, 1, 2, 3, 7, 100, 999, 12345])
+def test_cycles_to_ps_matches_reference(freq_ghz, cycles):
+    got = cycles_to_ps(cycles, ghz_to_mhz(freq_ghz))
+    want = ref_latency_to_ps(cycles, freq_ghz)
+    assert got == want
+
+
+@pytest.mark.parametrize("freq_ghz", [0.5, 1.0, 2.0, 2.5])
+@pytest.mark.parametrize("ps", [0, 1, 499, 500, 501, 1000, 123456, 10**9])
+def test_ps_to_cycles_matches_reference(freq_ghz, ps):
+    got = ps_to_cycles(ps, ghz_to_mhz(freq_ghz))
+    want = ref_time_to_cycles(ps, freq_ghz)
+    assert got == want
+
+
+def test_ps_to_ns_is_ceil():
+    # `time_types.h:111-114`
+    assert ps_to_ns(0) == 0
+    assert ps_to_ns(1) == 1
+    assert ps_to_ns(1000) == 1
+    assert ps_to_ns(1001) == 2
+
+
+def test_vectorized_matches_scalar():
+    cycles = jnp.array([0, 1, 3, 999, 12345], dtype=jnp.int64)
+    out = cycles_to_ps(cycles, ghz_to_mhz(2.0))
+    assert out.dtype == jnp.int64
+    for c, o in zip([0, 1, 3, 999, 12345], out.tolist()):
+        assert o == ref_latency_to_ps(c, 2.0)
+
+
+def test_time_latency_host_types():
+    t = Time.from_ns(5)
+    assert t.ps == 5000
+    t2 = t + Latency(cycles=8, freq_mhz=1000)
+    assert t2.ps == 5000 + 8000
+    assert (t2 - t).ps == 8000
+    assert t2.to_ns() == 13
+    assert Time(1500).to_ns() == 2  # ceil
+
+
+def test_latency_add_requires_same_frequency():
+    with pytest.raises(ValueError):
+        Latency(1, 1000) + Latency(1, 2000)
+    assert (Latency(2, 1000) + Latency(3, 1000)).cycles == 5
+
+
+def test_int64_no_overflow():
+    # 10 seconds of simulated time in ps exceeds int32
+    t = jnp.asarray(10**13, dtype=jnp.int64)
+    assert int(ps_to_ns(t)) == 10**10
