@@ -1,0 +1,88 @@
+"""Benchmark: aggregate simulated instructions/second on one chip.
+
+North star (BASELINE.json): ≥10M aggregate simulated instr/s at 1024 tiles.
+This round's kernel: a 256-tile compute+message workload (nearest-neighbor
+pattern over the e-mesh, hop-counter NoC timing) replayed through the full
+vectorized core/network/sync stack.  Prints exactly one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+N_TILES = int(os.environ.get("BENCH_TILES", "256"))
+N_ROUNDS = int(os.environ.get("BENCH_ROUNDS", "64"))
+COMPUTE_PER_ROUND = int(os.environ.get("BENCH_COMPUTE", "62"))
+BASELINE_INSTR_PER_SEC = 10_000_000  # BASELINE.json north star
+
+
+def main() -> None:
+    import graphite_tpu  # noqa: F401  (x64)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.engine.simulator import Simulator
+    from graphite_tpu.trace import synthetic
+
+    cfg_text = f"""
+[general]
+total_cores = {N_TILES}
+mode = lite
+max_frequency = 1.0
+[network]
+user = emesh_hop_counter
+memory = emesh_hop_counter
+[network/emesh_hop_counter]
+flit_width = 64
+[network/emesh_hop_counter/router]
+delay = 1
+[network/emesh_hop_counter/link]
+delay = 1
+[core/static_instruction_costs]
+generic = 1
+mov = 1
+ialu = 1
+imul = 3
+falu = 3
+fmul = 5
+[branch_predictor]
+type = one_bit
+mispredict_penalty = 14
+size = 1024
+[clock_skew_management]
+scheme = lax
+"""
+    sc = SimConfig(ConfigFile.from_string(cfg_text))
+    batch = synthetic.message_ring_batch(
+        N_TILES, n_rounds=N_ROUNDS, compute_per_round=COMPUTE_PER_ROUND
+    )
+    sim = Simulator(sc, batch, mailbox_depth=8, inner_block=64)
+
+    # Warm-up: compile the quantum step.
+    warm = sim._run_quantum(sim.state, jnp.asarray(1, jnp.int64))
+    jax.block_until_ready(warm)
+
+    t0 = time.perf_counter()
+    results = sim.run()
+    elapsed = time.perf_counter() - t0
+
+    total_instr = results.total_instructions
+    ips = total_instr / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"simulated instr/s ({N_TILES}-tile emesh, "
+                f"compute+message workload)",
+                "value": round(ips),
+                "unit": "instr/s",
+                "vs_baseline": round(ips / BASELINE_INSTR_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

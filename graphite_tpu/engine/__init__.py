@@ -1,0 +1,16 @@
+"""The quantum-stepped simulation engine.
+
+Graphite runs 2 host threads per tile (app + sim) synchronized by
+locks/semaphores and TCP transport (`common/system/sim_thread.cc`,
+`common/transport/socktransport.cc`), with lax clock-skew schemes bounding
+drift (`common/system/clock_skew_management_schemes/`).  This engine inverts
+that: all tile state is a struct-of-arrays pytree, and one compiled XLA step
+advances every tile through one lax-barrier quantum (`carbon_sim.cfg:92-97`)
+as a masked vectorized state machine.  Blocking operations (netRecv, barrier
+waits — reference `network.cc:358-460`, `sync_server.cc`) become explicit
+retry states resolved by messages delivered between subquantum rounds.
+"""
+
+from graphite_tpu.engine.simulator import Simulator, SimResults
+
+__all__ = ["Simulator", "SimResults"]

@@ -1,0 +1,278 @@
+"""Host-side simulation orchestration (the Simulator/MCP analog).
+
+Reference: `common/system/simulator.{h,cc}` boots transport, managers, and
+per-tile threads (`simulator.cc:83-133`); the MCP thread serves centralized
+requests (`mcp.cc:59-146`); the lax-barrier loop synchronizes every quantum
+(`lax_barrier_sync_client.cc:31-68`).  Here the Simulator builds the engine
+parameters from the parsed config, owns the device state, and drives the
+compiled quantum step in a host loop; everything the MCP did between quanta
+(deadlock detection, stats sampling, shutdown) happens here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from graphite_tpu.config.config_file import ConfigFile
+from graphite_tpu.config.simconfig import SimConfig
+from graphite_tpu.engine.state import DeviceTrace, SimState, init_state
+from graphite_tpu.engine.step import EngineParams, make_quantum_step
+from graphite_tpu.models.dvfs import module_freq_mhz
+from graphite_tpu.models.network_user import UserNetworkParams
+from graphite_tpu.time_types import ns_to_ps, ps_to_ns
+from graphite_tpu.trace.schema import STATIC_COST_KEYS, Op, TraceBatch
+
+LAX_INFINITE_QUANTUM_PS = 2**61
+
+
+class DeadlockError(RuntimeError):
+    pass
+
+
+class MailboxOverflowError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class SimResults:
+    """Final counters, mirroring the `sim.out` summary structure
+    (`core_model.cc:90-115`, `tile.cc:105-123`)."""
+
+    n_tiles: int
+    completion_time_ps: int
+    instruction_count: np.ndarray
+    clock_ps: np.ndarray
+    memory_stall_ps: np.ndarray
+    execution_stall_ps: np.ndarray
+    recv_instructions: np.ndarray
+    recv_stall_ps: np.ndarray
+    sync_instructions: np.ndarray
+    sync_stall_ps: np.ndarray
+    bp_correct: np.ndarray
+    bp_incorrect: np.ndarray
+    packets_sent: np.ndarray
+    packets_received: np.ndarray
+    total_packet_latency_ps: np.ndarray
+    n_quanta: int
+
+    @property
+    def total_instructions(self) -> int:
+        return int(self.instruction_count.sum())
+
+    def summary(self) -> str:
+        """sim.out-style per-tile summary (`simulator.cc:152-170`)."""
+        out = []
+        out.append("Simulation Summary")
+        out.append(f"Target Completion Time (in nanoseconds): "
+                   f"{ps_to_ns(self.completion_time_ps)}")
+        out.append(f"Total Instructions: {self.total_instructions}")
+        for t in range(self.n_tiles):
+            out.append(f"Tile {t} Summary:")
+            out.append("  Core Summary:")
+            out.append(f"    Total Instructions: {int(self.instruction_count[t])}")
+            out.append("    Completion Time (in nanoseconds): "
+                       f"{ps_to_ns(int(self.clock_ps[t]))}")
+            out.append(f"    Synchronization Stalls: {int(self.sync_instructions[t])}")
+            out.append(f"    Network Recv Stalls: {int(self.recv_instructions[t])}")
+            out.append("    Stall Time Breakdown (in nanoseconds): ")
+            out.append(f"      Memory: {ps_to_ns(int(self.memory_stall_ps[t]))}")
+            out.append("      Execution Unit: "
+                       f"{ps_to_ns(int(self.execution_stall_ps[t]))}")
+            out.append("      Synchronization: "
+                       f"{ps_to_ns(int(self.sync_stall_ps[t]))}")
+            out.append("      Network Recv: "
+                       f"{ps_to_ns(int(self.recv_stall_ps[t]))}")
+            bp_total = int(self.bp_correct[t] + self.bp_incorrect[t])
+            if bp_total:
+                out.append("    Branch Predictor:")
+                out.append(f"      Num Correct: {int(self.bp_correct[t])}")
+                out.append(f"      Num Incorrect: {int(self.bp_incorrect[t])}")
+            out.append("  Network Summary (USER):")
+            out.append(f"    Packets Sent: {int(self.packets_sent[t])}")
+            out.append(f"    Packets Received: {int(self.packets_received[t])}")
+            if self.packets_received[t]:
+                avg = self.total_packet_latency_ps[t] / self.packets_received[t] / 1000
+                out.append(f"    Average Packet Latency (in nanoseconds): {avg:.3f}")
+        return "\n".join(out)
+
+
+class Simulator:
+    """Builds engine parameters from a SimConfig and runs a trace batch."""
+
+    def __init__(
+        self,
+        config: SimConfig | ConfigFile | str,
+        trace: TraceBatch,
+        *,
+        mailbox_depth: int = 16,
+        inner_block: int = 32,
+        bp_size: int | None = None,
+        n_barriers: int = 64,
+        n_mutexes: int = 64,
+        mesh=None,
+    ):
+        if isinstance(config, str):
+            config = ConfigFile.from_file(config)
+        if isinstance(config, ConfigFile):
+            config = SimConfig(config)
+        self.config = config
+        cfg = config.cfg
+        self.trace_batch = trace
+        n_tiles = trace.n_tiles
+        if n_tiles != config.application_tiles:
+            raise ValueError(
+                f"trace has {n_tiles} tiles but config expects "
+                f"{config.application_tiles} application tiles"
+            )
+        unsupported = {int(Op.COND_WAIT)}
+        present = set(np.unique(trace.op).tolist())
+        if present & unsupported:
+            raise NotImplementedError(
+                "COND_WAIT trace events need the full sync engine (pending)"
+            )
+
+        costs = tuple(
+            cfg.get_int(f"core/static_instruction_costs/{k}", 0)
+            for k in STATIC_COST_KEYS
+        )
+        bp_type = cfg.get_string("branch_predictor/type", "one_bit")
+        self.params = EngineParams(
+            n_tiles=n_tiles,
+            static_cost_cycles=costs,
+            net=UserNetworkParams.from_config(config, "user"),
+            bp_enabled=(bp_type != "none"),
+            bp_size=bp_size or cfg.get_int("branch_predictor/size", 1024),
+            bp_mispredict_penalty=cfg.get_int(
+                "branch_predictor/mispredict_penalty", 14
+            ),
+            mailbox_depth=mailbox_depth,
+            inner_block=inner_block,
+        )
+        # Clock-skew scheme (`carbon_sim.cfg:85-108`): lax_barrier uses the
+        # config quantum; lax runs one unbounded quantum; lax_p2p is
+        # approximated by a quantum equal to its slack.
+        scheme = cfg.get_string("clock_skew_management/scheme", "lax_barrier")
+        if scheme == "lax_barrier":
+            self.quantum_ps = ns_to_ps(
+                cfg.get_int("clock_skew_management/lax_barrier/quantum", 1000)
+            )
+        elif scheme == "lax_p2p":
+            self.quantum_ps = ns_to_ps(
+                cfg.get_int("clock_skew_management/lax_p2p/slack", 1000)
+            )
+        else:
+            self.quantum_ps = None  # lax: unbounded
+
+        models_on = not cfg.get_bool(
+            "general/trigger_models_within_application", False
+        )
+        core_freq = module_freq_mhz(cfg, "CORE")
+        self.state: SimState = init_state(
+            n_tiles,
+            core_freq_mhz=core_freq,
+            bp_size=self.params.bp_size,
+            mailbox_depth=mailbox_depth,
+            n_barriers=n_barriers,
+            n_mutexes=n_mutexes,
+            models_enabled=models_on,
+        )
+        self.device_trace = DeviceTrace.from_batch(trace)
+        if mesh is not None:
+            # Shard the tile axis over the device mesh (SURVEY §2.10): the
+            # TPU-native form of Graphite's process striping.
+            from graphite_tpu.parallel.mesh import shard_sim
+
+            self.state, self.device_trace = shard_sim(
+                self.state, self.device_trace, mesh
+            )
+        self._run_quantum = make_quantum_step(self.params, self.device_trace)
+
+    def _next_boundary(self, clock_ps: int) -> int:
+        """First quantum boundary strictly above clock_ps."""
+        q = self.quantum_ps
+        return (clock_ps // q + 1) * q
+
+    def run(self, max_quanta: int = 1_000_000) -> SimResults:
+        """Drive quanta until every tile's trace is exhausted.
+
+        Empty quanta are skipped by jumping qend to the next boundary above
+        the laggard tile's clock (the reference's barrier only collects
+        *running* threads, so idle quanta never happen there either —
+        `lax_barrier_sync_server.h:12-36`).  A quantum with zero progress
+        while some tile was eligible to run is a genuine deadlock.
+        """
+        state = self.state
+        n_quanta = 0
+        prev_sig = None
+        qend = 0
+        while True:
+            done = np.asarray(state.done)
+            clocks = np.asarray(state.core.clock_ps)
+            if done.all():
+                break
+            if self.quantum_ps is None:
+                qend = LAX_INFINITE_QUANTUM_PS
+            else:
+                min_pending = int(clocks[~done].min())
+                qend = max(qend + self.quantum_ps,
+                           self._next_boundary(min_pending))
+            state = self._run_quantum(state, jnp.asarray(qend, jnp.int64))
+            n_quanta += 1
+            if bool(np.asarray(state.net.overflow)):
+                raise MailboxOverflowError(
+                    "a (dst,src) mailbox ring overflowed; re-run with a "
+                    "larger mailbox_depth"
+                )
+            sig = (
+                int(np.asarray(state.core.idx).sum()),
+                int(np.asarray(state.core.clock_ps).sum()),
+            )
+            if sig == prev_sig:
+                # Zero progress.  If some tile sits beyond qend (it crossed
+                # the boundary executing one long record), jump the window
+                # up to it — blocked peers may be waiting on its future
+                # sends.  Only when every non-done tile was already eligible
+                # is this a genuine deadlock.
+                done_now = np.asarray(state.done)
+                clocks_now = np.asarray(state.core.clock_ps)
+                ahead = clocks_now[~done_now]
+                ahead = ahead[ahead >= qend]
+                if self.quantum_ps is not None and ahead.size:
+                    qend = self._next_boundary(int(ahead.min())) - self.quantum_ps
+                    prev_sig = None
+                    continue
+                blocked = np.flatnonzero(~done_now).tolist()
+                raise DeadlockError(
+                    f"no progress across a quantum; blocked tiles: "
+                    f"{blocked[:16]}{'...' if len(blocked) > 16 else ''}"
+                )
+            prev_sig = sig
+            if n_quanta >= max_quanta:
+                raise RuntimeError(f"exceeded max_quanta={max_quanta}")
+        self.state = state
+        return self._results(state, n_quanta)
+
+    def _results(self, state: SimState, n_quanta: int) -> SimResults:
+        core, net = state.core, state.net
+        clock = np.asarray(core.clock_ps)
+        return SimResults(
+            n_tiles=self.params.n_tiles,
+            completion_time_ps=int(clock.max()),
+            instruction_count=np.asarray(core.instruction_count),
+            clock_ps=clock,
+            memory_stall_ps=np.asarray(core.memory_stall_ps),
+            execution_stall_ps=np.asarray(core.execution_stall_ps),
+            recv_instructions=np.asarray(core.recv_instructions),
+            recv_stall_ps=np.asarray(core.recv_stall_ps),
+            sync_instructions=np.asarray(core.sync_instructions),
+            sync_stall_ps=np.asarray(core.sync_stall_ps),
+            bp_correct=np.asarray(core.bp_correct),
+            bp_incorrect=np.asarray(core.bp_incorrect),
+            packets_sent=np.asarray(net.packets_sent),
+            packets_received=np.asarray(net.packets_received),
+            total_packet_latency_ps=np.asarray(net.total_latency_ps),
+            n_quanta=n_quanta,
+        )

@@ -1,0 +1,176 @@
+"""Simulation state: struct-of-arrays pytrees over the tile axis.
+
+The reference scatters this state across per-tile C++ objects
+(`Tile`/`Core`/`CoreModel`/`Network` — `common/tile/tile.cc:15-37`); here it
+is a pytree of dense arrays with leading dimension n_tiles so one XLA step
+advances every tile.  Checkpoint/resume (absent in the reference, SURVEY §5)
+falls out for free: the state pytree *is* the checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from graphite_tpu.trace.schema import TraceBatch
+
+
+@struct.dataclass
+class CoreState:
+    """Per-tile core-model state (`common/tile/core/core_model.h:19-146`)."""
+
+    clock_ps: jax.Array          # int64[T] — CoreModel::_curr_time
+    idx: jax.Array               # int32[T] — next trace record
+    freq_mhz: jax.Array          # int32[T] — per-tile core frequency
+    # counters (`core_model.cc:90-115` outputSummary)
+    instruction_count: jax.Array     # int64[T]
+    memory_stall_ps: jax.Array       # int64[T]
+    execution_stall_ps: jax.Array    # int64[T]
+    recv_instructions: jax.Array     # int64[T]
+    recv_stall_ps: jax.Array         # int64[T]
+    sync_instructions: jax.Array     # int64[T]
+    sync_stall_ps: jax.Array         # int64[T]
+    # branch predictor (`branch_predictors/one_bit_branch_predictor.cc`)
+    bp_bits: jax.Array           # uint8[T, bp_size]
+    bp_correct: jax.Array        # int64[T]
+    bp_incorrect: jax.Array      # int64[T]
+
+
+@struct.dataclass
+class UserNetState:
+    """The USER network (`packet_type.h:40-56`) as per-pair mailbox rings.
+
+    Replaces the reference's per-tile `_netQueue` + condition variable
+    (`network.cc:358-460`) and the TCP transport underneath: slot
+    [dst, src, k] holds the k-th in-flight packet from src to dst.  Each
+    sender lane writes only its own src column, so scatters never collide.
+    """
+
+    time_ps: jax.Array     # int64[T, T, D] — arrival time at receiver
+    lat_ps: jax.Array      # int32[T, T, D] — zero-load delay (for stats)
+    head: jax.Array        # int32[T, T] — total pushes (mod D write slot)
+    count: jax.Array       # int32[T, T] — in-flight entries
+    overflow: jax.Array    # bool[]     — any ring exceeded D (sim invalid)
+    # receive-side counters (`network_model.cc` updateReceiveCounters)
+    packets_sent: jax.Array      # int64[T]
+    packets_received: jax.Array  # int64[T]
+    total_latency_ps: jax.Array  # int64[T]
+
+
+@struct.dataclass
+class SyncState:
+    """Simulated sync objects (`common/system/sync_server.h:86-114`).
+
+    The MCP SyncServer's SimBarrier/SimMutex tables become dense arrays
+    indexed by object id; arrivals use scatter-adds, releases are computed
+    globally per subquantum iteration.
+    """
+
+    barrier_count: jax.Array     # int32[NB] — participant count (init)
+    barrier_arrived: jax.Array   # int32[NB]
+    barrier_time_ps: jax.Array   # int64[NB] — max arrival time
+    barrier_waiting: jax.Array   # bool[T] — this tile has joined its barrier
+    mutex_locked: jax.Array      # int32[NM] — 0 free / 1 held
+    mutex_owner: jax.Array       # int32[NM]
+    mutex_time_ps: jax.Array     # int64[NM] — time of last lock/unlock
+    mutex_waiting: jax.Array     # bool[T] — tile has a pending lock request
+
+
+@struct.dataclass
+class SimState:
+    core: CoreState
+    net: UserNetState
+    sync: SyncState
+    models_enabled: jax.Array    # bool[] — CarbonEnableModels/DisableModels
+    done: jax.Array              # bool[T] — thread exited (THREAD_EXIT)
+
+
+@struct.dataclass
+class DeviceTrace:
+    """TraceBatch resident on device, one array per field, [T, L]."""
+
+    op: jax.Array
+    flags: jax.Array
+    pc: jax.Array
+    addr0: jax.Array
+    addr1: jax.Array
+    size0: jax.Array
+    size1: jax.Array
+    aux0: jax.Array
+    aux1: jax.Array
+    dyn_ps: jax.Array
+
+    @classmethod
+    def from_batch(cls, batch: TraceBatch) -> "DeviceTrace":
+        return cls(
+            **{
+                f.name: jnp.asarray(getattr(batch, f.name))
+                for f in dataclasses.fields(batch)
+            }
+        )
+
+    @property
+    def length(self) -> int:
+        return self.op.shape[1]
+
+
+def init_state(
+    n_tiles: int,
+    *,
+    core_freq_mhz: int | np.ndarray,
+    bp_size: int = 1024,
+    mailbox_depth: int = 8,
+    n_barriers: int = 64,
+    n_mutexes: int = 64,
+    models_enabled: bool = True,
+) -> SimState:
+    T, D = n_tiles, mailbox_depth
+    i64 = jnp.int64
+    core = CoreState(
+        clock_ps=jnp.zeros(T, i64),
+        idx=jnp.zeros(T, jnp.int32),
+        freq_mhz=jnp.broadcast_to(
+            jnp.asarray(core_freq_mhz, jnp.int32), (T,)
+        ).copy(),
+        instruction_count=jnp.zeros(T, i64),
+        memory_stall_ps=jnp.zeros(T, i64),
+        execution_stall_ps=jnp.zeros(T, i64),
+        recv_instructions=jnp.zeros(T, i64),
+        recv_stall_ps=jnp.zeros(T, i64),
+        sync_instructions=jnp.zeros(T, i64),
+        sync_stall_ps=jnp.zeros(T, i64),
+        bp_bits=jnp.zeros((T, bp_size), jnp.uint8),
+        bp_correct=jnp.zeros(T, i64),
+        bp_incorrect=jnp.zeros(T, i64),
+    )
+    net = UserNetState(
+        time_ps=jnp.zeros((T, T, D), i64),
+        lat_ps=jnp.zeros((T, T, D), jnp.int32),
+        head=jnp.zeros((T, T), jnp.int32),
+        count=jnp.zeros((T, T), jnp.int32),
+        overflow=jnp.zeros((), jnp.bool_),
+        packets_sent=jnp.zeros(T, i64),
+        packets_received=jnp.zeros(T, i64),
+        total_latency_ps=jnp.zeros(T, i64),
+    )
+    sync = SyncState(
+        barrier_count=jnp.zeros(n_barriers, jnp.int32),
+        barrier_arrived=jnp.zeros(n_barriers, jnp.int32),
+        barrier_time_ps=jnp.zeros(n_barriers, i64),
+        barrier_waiting=jnp.zeros(T, jnp.bool_),
+        mutex_locked=jnp.zeros(n_mutexes, jnp.int32),
+        mutex_owner=jnp.full(n_mutexes, -1, jnp.int32),
+        mutex_time_ps=jnp.zeros(n_mutexes, i64),
+        mutex_waiting=jnp.zeros(T, jnp.bool_),
+    )
+    return SimState(
+        core=core,
+        net=net,
+        sync=sync,
+        models_enabled=jnp.asarray(models_enabled, jnp.bool_),
+        done=jnp.zeros(T, jnp.bool_),
+    )

@@ -1,0 +1,399 @@
+"""The vectorized subquantum step: every tile advances one trace record.
+
+This replaces Graphite's per-instruction host control flow — Pin callback →
+`CoreModel::queueInstruction/iterate` (`pin/instruction_modeling.cc:13-21`,
+`common/tile/core/models/simple_core_model.cc:37-97`) and the blocking
+netRecv / MCP sync-server round trips (`network.cc:358-460`,
+`common/system/sync_server.cc:27-160`) — with a masked SoA state machine:
+
+ - one `lax.scan` iteration processes (at most) one trace record per tile,
+   all tiles in parallel;
+ - blocked operations (recv with no matching packet, barrier not full,
+   mutex held) simply do not advance `idx`; they retry next iteration, when
+   messages pushed by other tiles in earlier iterations have landed;
+ - sends scatter into per-(dst,src) mailbox rings — each sender lane owns
+   its own src column, so writes never collide;
+ - barrier arrivals/releases use scatter-add/scatter-max plus a global
+   release mask, reproducing SimBarrier's max-arrival-time release
+   (`sync_server.cc:133-160`);
+ - mutex grants pick the earliest-simulated-time waiter via a segmented
+   min over (clock, tile) keys, reproducing SimMutex handoff-at-unlock-time
+   (`sync_server.cc:27-57,185-240`) deterministically (the reference's FIFO
+   is host-arrival-order and racy).
+
+Timing semantics per record mirror the reference exactly:
+ - static instruction cost from the `[core/static_instruction_costs]` table
+   (`core_model.cc:65-76`), converted at the tile's DVFS frequency;
+ - branch cost 1 cycle on correct prediction else the mispredict penalty,
+   one-bit predictor indexed by pc (`instruction.cc:47-70`,
+   `one_bit_branch_predictor.cc:13-24`, `carbon_sim.cfg:202-205`);
+ - dynamic instruction cost carried in the record (`instruction.h:149-198`);
+ - netRecv: clock = max(clock, arrival); a RecvInstruction is accounted only
+   when arrival > clock (`network.cc:443-453`);
+ - barrier release at max arrival time with a SyncInstruction only when the
+   wait was positive (`sync_server.cc:141-144`, `sync_client.cc:83-87`);
+ - models-disabled ⇒ zero cost and no counters, but full functional effect
+   (`simulator.cc:399-413`, `core_model.h` _enabled gate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphite_tpu.engine.state import SimState, DeviceTrace
+from graphite_tpu.models.network_user import UserNetworkParams, route_latency_ps
+from graphite_tpu.trace.schema import (
+    FLAG_BRANCH_TAKEN,
+    Op,
+)
+from graphite_tpu.time_types import cycles_to_ps
+
+I64 = jnp.int64
+FAR_FUTURE_PS = jnp.asarray(2**62, I64)
+ANY_SENDER = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineParams:
+    """Static compile-time parameters of the step function."""
+
+    n_tiles: int
+    static_cost_cycles: tuple  # 20 ints (`carbon_sim.cfg:189-200`)
+    net: UserNetworkParams
+    bp_enabled: bool = True
+    bp_size: int = 1024
+    bp_mispredict_penalty: int = 14
+    mailbox_depth: int = 8
+    inner_block: int = 32      # trace records per tile per scan
+
+
+def _gather_field(field: jax.Array, idx: jax.Array) -> jax.Array:
+    return jnp.take_along_axis(field, idx[:, None], axis=1)[:, 0]
+
+
+
+
+def subquantum_iteration(
+    params: EngineParams,
+    trace: DeviceTrace,
+    state: SimState,
+    quantum_end_ps: jax.Array,
+) -> tuple[SimState, jax.Array]:
+    """Process one trace record per tile; returns (state, tiles_advanced)."""
+    T = params.n_tiles
+    D = params.mailbox_depth
+    core, net, sync = state.core, state.net, state.sync
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    idx = jnp.minimum(core.idx, trace.length - 1)
+
+    op = _gather_field(trace.op, idx).astype(jnp.int32)
+    flags = _gather_field(trace.flags, idx).astype(jnp.int32)
+    pc = _gather_field(trace.pc, idx)
+    aux0 = _gather_field(trace.aux0, idx)
+    aux1 = _gather_field(trace.aux1, idx)
+    dyn_ps = _gather_field(trace.dyn_ps, idx)
+
+    enabled = state.models_enabled
+    done = state.done | (op == Op.NOP) | (op == Op.THREAD_EXIT)
+    active = (~done) & (core.clock_ps < quantum_end_ps)
+
+    # --- classify -------------------------------------------------------
+    is_branch = op == Op.BRANCH
+    is_static = (op < Op.DYNAMIC_MISC) & ~is_branch      # 0-14 minus branch
+    is_dynamic = (op >= Op.DYNAMIC_MISC) & (op < 20)     # 15-19
+    is_spawn_instr = op == Op.SPAWN
+    is_send = op == Op.SEND
+    is_recv = op == Op.NET_RECV
+    is_binit = op == Op.BARRIER_INIT
+    is_bwait = op == Op.BARRIER_WAIT
+    is_minit = op == Op.MUTEX_INIT
+    is_mlock = op == Op.MUTEX_LOCK
+    is_munlock = op == Op.MUTEX_UNLOCK
+    is_join = op == Op.THREAD_JOIN
+    # Events that always complete in one iteration:
+    is_simple_event = (
+        (op == Op.THREAD_SPAWN)
+        | is_binit | is_minit | is_munlock
+        | (op == Op.ENABLE_MODELS) | (op == Op.DISABLE_MODELS)
+        | (op == Op.DVFS_SET) | (op == Op.DVFS_GET)
+        | (op == Op.COND_INIT)  # cond signal/broadcast/wait handled in sync engine
+        | (op == Op.COND_SIGNAL) | (op == Op.COND_BROADCAST)
+    )
+
+    # --- static + dynamic instruction costs ------------------------------
+    cost_table = jnp.asarray(params.static_cost_cycles, dtype=I64)
+    static_cycles = cost_table[jnp.clip(op, 0, 19)]
+
+    bp_index = (pc % params.bp_size).astype(jnp.int32)
+    bp_pred = jnp.take_along_axis(core.bp_bits, bp_index[:, None], axis=1)[:, 0]
+    taken = ((flags & FLAG_BRANCH_TAKEN) != 0).astype(jnp.uint8)
+    bp_correct_now = bp_pred == taken
+    if params.bp_enabled:
+        branch_cycles = jnp.where(bp_correct_now, 1, params.bp_mispredict_penalty)
+    else:
+        branch_cycles = jnp.ones((T,), I64)
+
+    cycles = jnp.where(is_branch, branch_cycles, static_cycles)
+    cost_ps = cycles_to_ps(cycles, core.freq_mhz.astype(I64))
+    cost_ps = jnp.where(is_dynamic, dyn_ps, cost_ps)
+    cost_ps = jnp.where(op < 20, cost_ps, 0)  # events carry no direct cost
+    cost_ps = jnp.where(enabled, cost_ps, 0)
+
+    # --- SEND: push into (dst, src) mailbox ring -------------------------
+    dst = jnp.clip(aux0, 0, T - 1)
+    send_now = active & is_send
+    lat_ps = route_latency_ps(params.net, tiles, dst, aux1, enabled)
+    arrival_ps = core.clock_ps + lat_ps
+    slot = (net.head[dst, tiles] % D).astype(jnp.int32)
+    # Write under mask: redirect masked-off lanes to their own (t, t) cell
+    # at a dummy slot; since each lane writes a distinct src column, no
+    # collisions occur either way.
+    w_dst = jnp.where(send_now, dst, tiles)
+    time_ps_new = net.time_ps.at[w_dst, tiles, slot].set(
+        jnp.where(send_now, arrival_ps, net.time_ps[w_dst, tiles, slot])
+    )
+    lat_arr_new = net.lat_ps.at[w_dst, tiles, slot].set(
+        jnp.where(send_now, lat_ps.astype(jnp.int32),
+                  net.lat_ps[w_dst, tiles, slot])
+    )
+    head_new = net.head.at[w_dst, tiles].add(jnp.where(send_now, 1, 0))
+
+    # --- RECV: match earliest in-flight packet ---------------------------
+    tail = ((net.head - net.count) % D).astype(jnp.int32)  # [T, T]
+    tail_times = jnp.take_along_axis(net.time_ps, tail[:, :, None], axis=2)[:, :, 0]
+    tail_lats = jnp.take_along_axis(net.lat_ps, tail[:, :, None], axis=2)[:, :, 0]
+    avail = net.count > 0
+    masked_times = jnp.where(avail, tail_times, FAR_FUTURE_PS)
+    any_src = jnp.argmin(masked_times, axis=1).astype(jnp.int32)     # [T]
+    want_src = jnp.where(aux0 == ANY_SENDER, any_src, jnp.clip(aux0, 0, T - 1))
+    recv_time = masked_times[tiles, want_src]
+    recv_lat = tail_lats[tiles, want_src]
+    matched = recv_time < FAR_FUTURE_PS
+    recv_now = active & is_recv & matched
+    recv_wait_ps = jnp.maximum(recv_time - core.clock_ps, 0)
+    # pop (count -1); sends above add +1 — combine as two scatter-adds
+    count_new = (
+        net.count.at[w_dst, tiles].add(jnp.where(send_now, 1, 0))
+        .at[tiles, want_src].add(jnp.where(recv_now, -1, 0))
+    )
+    overflow = net.overflow | jnp.any(count_new > D)
+
+    # --- BARRIER ---------------------------------------------------------
+    # Masked scatter-updates below use the add-a-delta idiom: masked-off
+    # lanes contribute +0, so duplicate dummy indices cannot clobber a live
+    # update (a plain masked .set would).
+    bar = jnp.clip(aux0, 0, sync.barrier_count.shape[0] - 1)
+    binit_now = active & is_binit
+    barrier_count = sync.barrier_count.at[bar].add(
+        jnp.where(binit_now, aux1 - sync.barrier_count[bar], 0)
+    )
+    new_arrival = active & is_bwait & ~sync.barrier_waiting
+    arr_tgt = jnp.where(new_arrival, bar, 0)
+    barrier_arrived = sync.barrier_arrived.at[arr_tgt].add(
+        jnp.where(new_arrival, 1, 0)
+    )
+    barrier_time = sync.barrier_time_ps.at[arr_tgt].max(
+        jnp.where(new_arrival, core.clock_ps, 0)
+    )
+    release_bar = (barrier_count > 0) & (barrier_arrived >= barrier_count)
+    participant = is_bwait & (sync.barrier_waiting | new_arrival) & ~done
+    released = participant & release_bar[bar]
+    release_time = barrier_time[bar]
+    barrier_waiting = (sync.barrier_waiting | new_arrival) & ~released
+    # reset released barriers
+    barrier_arrived = jnp.where(release_bar, 0, barrier_arrived)
+    barrier_time = jnp.where(release_bar, 0, barrier_time)
+    barrier_wait_ps = jnp.maximum(release_time - core.clock_ps, 0)
+
+    # --- MUTEX -----------------------------------------------------------
+    NM = sync.mutex_locked.shape[0]
+    mux = jnp.clip(aux0, 0, NM - 1)
+    minit_now = active & is_minit
+    mutex_locked = sync.mutex_locked.at[mux].add(
+        jnp.where(minit_now, -sync.mutex_locked[mux], 0)
+    )
+    # candidates: tiles at MUTEX_LOCK (waiting from before, or arriving now)
+    lock_candidate = is_mlock & ~done & (sync.mutex_waiting | active)
+    cand_mux = jnp.where(lock_candidate, mux, NM)  # NM = "no mutex" bucket
+    grant_key = core.clock_ps * jnp.asarray(T, I64) + tiles.astype(I64)
+    masked_key = jnp.where(lock_candidate, grant_key, jnp.asarray(2**62, I64))
+    best_key = (
+        jnp.full((NM + 1,), 2**62, I64).at[cand_mux].min(masked_key)
+    )[:NM]
+    grantable = mutex_locked == 0
+    granted = lock_candidate & grantable[mux] & (masked_key == best_key[mux])
+    mutex_grab_time = sync.mutex_time_ps[mux]
+    mutex_wait_ps = jnp.maximum(mutex_grab_time - core.clock_ps, 0)
+    mutex_wait_ps = jnp.where(granted, mutex_wait_ps, 0)
+    # grant is unique per mutex (key includes tile id), unlock unique per
+    # mutex (single owner), so add-deltas below cannot double-apply
+    mutex_locked = mutex_locked.at[mux].add(jnp.where(granted, 1, 0))
+    mutex_owner = sync.mutex_owner.at[mux].add(
+        jnp.where(granted, tiles - sync.mutex_owner[mux], 0)
+    )
+    mutex_waiting = (lock_candidate & ~granted) | (
+        sync.mutex_waiting & ~is_mlock
+    )
+    # unlock: free + stamp handoff time (`sync_server.cc:211-240`)
+    unlock_now = active & is_munlock
+    mutex_locked = mutex_locked.at[mux].add(jnp.where(unlock_now, -1, 0))
+    mutex_owner = mutex_owner.at[mux].add(
+        jnp.where(unlock_now, -1 - mutex_owner[mux], 0)
+    )
+    mutex_time = sync.mutex_time_ps.at[mux].add(
+        jnp.where(unlock_now, core.clock_ps - sync.mutex_time_ps[mux], 0)
+    )
+
+    # --- JOIN ------------------------------------------------------------
+    join_target = jnp.clip(aux0, 0, T - 1)
+    target_idx = jnp.minimum(core.idx[join_target], trace.length - 1)
+    target_done = state.done[join_target] | (
+        trace.op[join_target, target_idx] == Op.THREAD_EXIT
+    )
+    join_now = active & is_join & target_done
+    join_time = jnp.maximum(core.clock_ps, core.clock_ps[join_target])
+
+    # --- commit: advance mask, clocks, counters --------------------------
+    advance = active & (
+        is_static | is_branch | (is_dynamic & ~is_spawn_instr)
+        | is_simple_event | is_send
+    )
+    advance = advance | recv_now | released | (active & is_spawn_instr)
+    advance = advance | granted | join_now
+
+    clock = core.clock_ps
+    clock = jnp.where(advance & (is_static | is_branch
+                                 | (is_dynamic & ~is_spawn_instr)
+                                 | is_simple_event | is_send),
+                      clock + cost_ps, clock)
+    clock = jnp.where(active & is_spawn_instr,
+                      jnp.maximum(clock, dyn_ps), clock)
+    clock = jnp.where(recv_now, jnp.maximum(clock, recv_time), clock)
+    clock = jnp.where(released, jnp.maximum(clock, release_time), clock)
+    clock = jnp.where(granted, clock + mutex_wait_ps, clock)
+    clock = jnp.where(join_now, join_time, clock)
+
+    # DVFS_SET on the CORE domain (domain 0) retunes this tile's clock;
+    # the full DVFSManager (voltage levels, remote get/set over the DVFS
+    # network, `dvfs_manager.h:19-88`) is layered on in models/dvfs.
+    dvfs_set_now = active & (op == Op.DVFS_SET) & (aux0 == 0) & (aux1 > 0)
+    freq_mhz = jnp.where(dvfs_set_now, aux1, core.freq_mhz)
+
+    instr_now = advance & (is_static | is_branch
+                           | (is_dynamic & ~is_spawn_instr))
+    recv_charged = recv_now & (recv_wait_ps > 0) & enabled
+    sync_charged = (released & (barrier_wait_ps > 0) | granted
+                    & (mutex_wait_ps > 0)) & enabled
+
+    new_core = core.replace(
+        clock_ps=clock,
+        freq_mhz=freq_mhz,
+        idx=core.idx + advance.astype(jnp.int32),
+        instruction_count=core.instruction_count
+        + (instr_now & enabled).astype(I64)
+        + recv_charged.astype(I64)
+        + sync_charged.astype(I64),
+        execution_stall_ps=core.execution_stall_ps
+        + jnp.where(advance & (is_static | is_branch), cost_ps, 0),
+        recv_instructions=core.recv_instructions + recv_charged.astype(I64),
+        recv_stall_ps=core.recv_stall_ps
+        + jnp.where(recv_charged, recv_wait_ps, 0),
+        sync_instructions=core.sync_instructions + sync_charged.astype(I64),
+        sync_stall_ps=core.sync_stall_ps
+        + jnp.where(released & enabled, barrier_wait_ps, 0)
+        + jnp.where(granted & enabled, mutex_wait_ps, 0),
+        bp_bits=core.bp_bits.at[tiles, bp_index].set(
+            jnp.where(active & is_branch & enabled, taken,
+                      core.bp_bits[tiles, bp_index])
+        ),
+        bp_correct=core.bp_correct
+        + (active & is_branch & bp_correct_now & enabled).astype(I64),
+        bp_incorrect=core.bp_incorrect
+        + (active & is_branch & ~bp_correct_now & enabled).astype(I64),
+    )
+    new_net = net.replace(
+        time_ps=time_ps_new,
+        lat_ps=lat_arr_new,
+        head=head_new,
+        count=count_new,
+        overflow=overflow,
+        packets_sent=net.packets_sent + send_now.astype(I64),
+        packets_received=net.packets_received + recv_now.astype(I64),
+        total_latency_ps=net.total_latency_ps
+        + jnp.where(recv_now, recv_lat.astype(I64), 0),
+    )
+    new_sync = sync.replace(
+        barrier_count=barrier_count,
+        barrier_arrived=barrier_arrived,
+        barrier_time_ps=barrier_time,
+        barrier_waiting=barrier_waiting,
+        mutex_locked=mutex_locked,
+        mutex_owner=mutex_owner,
+        mutex_time_ps=mutex_time,
+        mutex_waiting=mutex_waiting,
+    )
+    enable_now = jnp.any(active & (op == Op.ENABLE_MODELS))
+    disable_now = jnp.any(active & (op == Op.DISABLE_MODELS))
+    models_enabled = jnp.where(
+        disable_now, False, jnp.where(enable_now, True, state.models_enabled)
+    )
+    new_state = SimState(
+        core=new_core,
+        net=new_net,
+        sync=new_sync,
+        models_enabled=models_enabled,
+        done=done,
+    )
+    return new_state, jnp.sum(advance, dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def run_quantum(
+    params: EngineParams, trace: DeviceTrace, state: SimState, qend: jax.Array
+) -> SimState:
+    """Run one lax-barrier quantum as a single compiled XLA region.
+
+    Runs blocks of `inner_block` subquantum iterations under a while_loop
+    until no tile makes progress (all done, all past the quantum boundary,
+    or — transiently — all blocked on messages that can only arrive next
+    quantum).  This is the quantum of `clock_skew_management/lax_barrier`
+    (`carbon_sim.cfg:92-97`).  Module-level jit with static params so all
+    Simulator instances with identical topology share one compilation.
+    """
+
+    def block(state: SimState):
+        def body(carry, _):
+            st, prog = carry
+            st, adv = subquantum_iteration(params, trace, st, qend)
+            return (st, prog + adv), None
+
+        (state, progress), _ = lax.scan(
+            body, (state, jnp.asarray(0, jnp.int32)), None,
+            length=params.inner_block,
+        )
+        return state, progress
+
+    def cond(carry):
+        _, prog = carry
+        return prog > 0
+
+    def body(carry):
+        st, _ = carry
+        return block(st)
+
+    state, _ = lax.while_loop(cond, body, (state, jnp.asarray(1, jnp.int32)))
+    return state
+
+
+def make_quantum_step(params: EngineParams, trace: DeviceTrace):
+    """Bind params/trace for the Simulator's host loop."""
+
+    def step(state: SimState, qend: jax.Array) -> SimState:
+        return run_quantum(params, trace, state, qend)
+
+    return step

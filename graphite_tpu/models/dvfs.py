@@ -1,0 +1,75 @@
+"""DVFS domains: frequency/voltage per module class.
+
+Reference: `common/system/dvfs_manager.{h,cc}` (`dvfs_manager.h:19-88`),
+config `[dvfs] domains` (`carbon_sim.cfg:147-155`), per-technology V/f
+tables `technology/dvfs_levels_*.cfg`.
+
+Round-1 scope: domain parsing + initial frequencies (consumed by the core
+and network models) and the synchronization delay at asynchronous boundary
+crossings.  Runtime set_frequency (the DVFS network + voltage scaling +
+level tables) is layered on in the DVFSManager engine module.
+"""
+
+from __future__ import annotations
+
+import re
+
+from graphite_tpu.config.config_file import ConfigFile
+from graphite_tpu.time_types import ghz_to_mhz
+
+# Module classes (`dvfs.h` / `dvfs_manager.cc` domain map)
+DVFS_MODULES = (
+    "CORE",
+    "L1_ICACHE",
+    "L1_DCACHE",
+    "L2_CACHE",
+    "DIRECTORY",
+    "NETWORK_USER",
+    "NETWORK_MEMORY",
+)
+
+
+def parse_dvfs_domains(cfg: ConfigFile) -> list[tuple[int, list[str]]]:
+    """Parse `[dvfs] domains` tuples `<freq_ghz, MODULE, ...>`.
+
+    Returns [(freq_mhz, [modules]), ...] (`carbon_sim.cfg:148-151`).
+    """
+    text = cfg.get_string(
+        "dvfs/domains",
+        "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE, DIRECTORY, "
+        "NETWORK_USER, NETWORK_MEMORY>",
+    )
+    domains: list[tuple[int, list[str]]] = []
+    for tup in re.finditer(r"<([^<>]*)>", text):
+        fields = [f.strip() for f in tup.group(1).split(",") if f.strip()]
+        if not fields:
+            continue
+        freq_mhz = ghz_to_mhz(float(fields[0]))
+        modules = [m.upper() for m in fields[1:]]
+        for m in modules:
+            if m not in DVFS_MODULES:
+                raise ValueError(f"unknown DVFS module {m!r} in domains")
+        domains.append((freq_mhz, modules))
+    if not domains:
+        raise ValueError("no DVFS domains parsed")
+    # every module must belong to exactly one domain
+    seen: set[str] = set()
+    for _, modules in domains:
+        for m in modules:
+            if m in seen:
+                raise ValueError(f"DVFS module {m} in two domains")
+            seen.add(m)
+    return domains
+
+
+def module_freq_mhz(cfg: ConfigFile, module: str) -> int:
+    """Initial frequency of the domain containing `module`, default 1 GHz."""
+    for freq_mhz, modules in parse_dvfs_domains(cfg):
+        if module.upper() in modules:
+            return freq_mhz
+    return 1000
+
+
+def synchronization_delay_cycles(cfg: ConfigFile) -> int:
+    """Delay crossing asynchronous domain boundaries (`carbon_sim.cfg:153-155`)."""
+    return cfg.get_int("dvfs/synchronization_delay", 2)

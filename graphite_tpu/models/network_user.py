@@ -1,0 +1,107 @@
+"""USER-network latency models: magic and emesh_hop_counter (vectorized).
+
+Reference semantics:
+ - magic (`network_model_magic.cc:15-22`): every packet takes exactly 1
+   network-clock cycle, regardless of model enable; flit_width = -1 so no
+   serialization is ever added (`network_model.cc:203-211`).
+ - emesh_hop_counter (`network_model_emesh_hop_counter.cc:142-157`):
+   zero-load latency = manhattan_hops * (router_delay + link_delay) cycles
+   when the model is enabled, else 0; no contention.  At the receive side
+   ceil(packet_bits / flit_width) cycles of serialization are added when the
+   model is enabled and sender != receiver
+   (`network_model.cc:119-149 __processReceivedPacket`).
+ - user-packet modeled length = (sizeof(NetPacket) + payload) * 8 bits
+   (`network_model.cc:186-199`, `network.cc:705-708`); sizeof(NetPacket) is
+   64 bytes on x86-64 (`network.h:27-53`).
+
+Latencies are returned in picoseconds at the network's DVFS frequency
+(`network_model.cc:472-487`; domain NETWORK_USER, `carbon_sim.cfg:147-151`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from graphite_tpu.config.simconfig import SimConfig
+from graphite_tpu.models.network_emesh import mesh_dims
+from graphite_tpu.time_types import cycles_to_ps
+
+NET_PACKET_HEADER_BYTES = 64  # sizeof(NetPacket), `network.h:27-53`
+
+
+@dataclasses.dataclass(frozen=True)
+class UserNetworkParams:
+    kind: str                 # "magic" | "emesh_hop_counter"
+    freq_mhz: int             # NETWORK_USER domain frequency
+    mesh_width: int = 0
+    hop_latency_cycles: int = 2   # router.delay + link.delay
+    flit_width_bits: int = -1     # -1 => no serialization (magic)
+
+    @classmethod
+    def from_config(cls, cfg: SimConfig, network: str = "user") -> "UserNetworkParams":
+        kind = cfg.network_types[0 if network == "user" else 1]
+        freq_mhz = _network_domain_freq_mhz(cfg)
+        if kind == "magic":
+            return cls(kind="magic", freq_mhz=freq_mhz)
+        if kind in ("emesh_hop_counter", "emesh_hop_by_hop"):
+            # hop_by_hop zero-load reduces to hop_counter math; contention is
+            # layered on separately (models/network_emesh_hop_by_hop).
+            section = f"network/{kind}"
+            router = cfg.cfg.get_int(f"{section}/router/delay", 1)
+            link = cfg.cfg.get_int(f"{section}/link/delay", 1)
+            flit = cfg.cfg.get_int(f"{section}/flit_width", 64)
+            w, _ = mesh_dims(cfg.application_tiles)
+            return cls(
+                kind="emesh_hop_counter",
+                freq_mhz=freq_mhz,
+                mesh_width=w,
+                hop_latency_cycles=router + link,
+                flit_width_bits=flit,
+            )
+        raise ValueError(f"unsupported user network model: {kind}")
+
+
+def _network_domain_freq_mhz(cfg: SimConfig) -> int:
+    """First DVFS domain containing NETWORK_USER (`carbon_sim.cfg:147-151`)."""
+    from graphite_tpu.models.dvfs import parse_dvfs_domains
+
+    for freq_mhz, modules in parse_dvfs_domains(cfg.cfg):
+        if "NETWORK_USER" in modules:
+            return freq_mhz
+    return 1000
+
+
+def num_flits(length_bits, flit_width_bits: int):
+    """`network_model.cc:203-211`: ceil, or 0 when flit_width == -1."""
+    if flit_width_bits <= 0:
+        return jnp.zeros_like(jnp.asarray(length_bits))
+    return (jnp.asarray(length_bits) + flit_width_bits - 1) // flit_width_bits
+
+
+def user_packet_bits(payload_bytes):
+    return (NET_PACKET_HEADER_BYTES + payload_bytes) * 8
+
+
+def route_latency_ps(params: UserNetworkParams, src, dst, payload_bytes, enabled):
+    """Zero-load arrival delay (route + receive serialization), elementwise.
+
+    src/dst/payload_bytes are int arrays of the same shape; enabled is a
+    bool scalar (models enabled).  Returns int64 ps.
+    """
+    src = jnp.asarray(src)
+    dst = jnp.asarray(dst)
+    if params.kind == "magic":
+        cycles = jnp.ones_like(src, dtype=jnp.int64)  # unconditional 1 cycle
+        return cycles_to_ps(cycles, params.freq_mhz)
+    # emesh_hop_counter
+    w = params.mesh_width
+    hops = jnp.abs(src % w - dst % w) + jnp.abs(src // w - dst // w)
+    route_cycles = hops.astype(jnp.int64) * params.hop_latency_cycles
+    ser_cycles = num_flits(
+        user_packet_bits(jnp.asarray(payload_bytes)), params.flit_width_bits
+    ).astype(jnp.int64)
+    ser_cycles = jnp.where(src == dst, 0, ser_cycles)  # self-sends skip recv-side
+    cycles = jnp.where(enabled, route_cycles + ser_cycles, 0)
+    return cycles_to_ps(cycles, params.freq_mhz)

@@ -1,0 +1,27 @@
+"""Multi-chip distribution: the tile axis sharded over the device mesh.
+
+Graphite distributes by striping target tiles across host processes
+connected by TCP (`common/misc/config.cc:198-228`, `[process_map]`
+`carbon_sim.cfg:119-139`, `common/transport/socktransport.cc`).  The
+TPU-native equivalent (SURVEY §2.10): the SoA tile axis is sharded over a
+`jax.sharding.Mesh`; coherence/user messages become sharded scatter/gather
+(XLA inserts the ICI collectives); the emesh block process-mapping
+(`network_model_emesh_hop_by_hop.cc:366-433`) becomes the sharding layout
+that keeps neighbor exchanges on adjacent devices.
+"""
+
+from graphite_tpu.parallel.mesh import (
+    TILE_AXIS,
+    make_tile_mesh,
+    shard_sim,
+    state_shardings,
+    trace_shardings,
+)
+
+__all__ = [
+    "TILE_AXIS",
+    "make_tile_mesh",
+    "shard_sim",
+    "state_shardings",
+    "trace_shardings",
+]

@@ -1,0 +1,87 @@
+"""Device-mesh construction and state/trace sharding rules.
+
+Sharding policy: every array whose leading dimension is the tile count is
+sharded on that axis (`PartitionSpec("tiles")`); everything else (sync-object
+tables, scalars) is replicated.  The mailbox tensor [dst, src, depth] is
+sharded on dst — a tile's inbox lives with its shard, like Graphite's
+per-tile `_netQueue` living in the owning process (`network.cc:358-460`) —
+and cross-shard sends become XLA scatter collectives over ICI, replacing the
+full-mesh TCP of `socktransport.cc`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from graphite_tpu.engine.state import DeviceTrace, SimState
+
+TILE_AXIS = "tiles"
+
+
+def make_tile_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1D mesh over the tile axis.
+
+    On a real multi-chip slice this is the ICI ring/torus; in tests it is
+    the virtual 8-device CPU platform.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (TILE_AXIS,))
+
+
+def _tile_spec(leaf: jax.Array) -> P:
+    return P(TILE_AXIS, *([None] * (leaf.ndim - 1)))
+
+
+# Fields whose leading axis is NOT the tile axis and must be replicated:
+# the sync-object tables (the MCP SyncServer analog, `sync_server.h:86-114`)
+# and global scalars.
+_REPLICATED_STATE_FIELDS = {
+    "barrier_count", "barrier_arrived", "barrier_time_ps",
+    "mutex_locked", "mutex_owner", "mutex_time_ps",
+    "models_enabled", "overflow",
+}
+
+
+def state_shardings(state: SimState, mesh: Mesh, n_tiles: int):
+    def spec_for(path, leaf):
+        name = path[-1].name if path else ""
+        if name in _REPLICATED_STATE_FIELDS or leaf.ndim == 0:
+            return NamedSharding(mesh, P())
+        assert leaf.shape[0] == n_tiles, (name, leaf.shape)
+        return NamedSharding(mesh, _tile_spec(leaf))
+
+    return jax.tree_util.tree_map_with_path(spec_for, state)
+
+
+def trace_shardings(trace: DeviceTrace, mesh: Mesh, n_tiles: int):
+    return jax.tree.map(
+        lambda leaf: NamedSharding(mesh, _tile_spec(leaf)), trace
+    )
+
+
+def shard_sim(
+    state: SimState, trace: DeviceTrace, mesh: Mesh
+) -> tuple[SimState, DeviceTrace]:
+    """Place state + trace on the mesh, tile axis sharded.
+
+    The tile count must divide the mesh size.  Returns device-placed
+    pytrees; subsequent jitted steps follow the input shardings, with XLA
+    inserting the cross-shard collectives for mailbox scatters (the
+    TPU-native replacement for SockTransport's TCP full mesh).
+    """
+    n_tiles = state.core.clock_ps.shape[0]
+    n_dev = mesh.devices.size
+    if n_tiles % n_dev != 0:
+        raise ValueError(
+            f"tile count {n_tiles} not divisible by mesh size {n_dev}"
+        )
+    state = jax.device_put(state, state_shardings(state, mesh, n_tiles))
+    trace = jax.device_put(trace, trace_shardings(trace, mesh, n_tiles))
+    return state, trace

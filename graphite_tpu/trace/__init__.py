@@ -1,0 +1,21 @@
+"""Trace layer — the frontend of the TPU simulator.
+
+Graphite's Pin frontend (`pin/`) executes x86 binaries and feeds decoded
+instructions + memory references + thread/sync events into the timing models
+(`pin/instruction_modeling.cc:13-21`, `pin/routine_replace.cc:37-101`).  On
+TPU hosts Pin is out of scope; the frontend is a *trace producer*: programs
+are recorded (or synthesized) as fixed-layout micro-op streams, streamed
+host→HBM, and replayed through the full timing stack.  A trace record carries
+exactly what the reference's Instruction + DynamicMemoryInfo +
+DynamicBranchInfo + user-API calls carried.
+"""
+
+from graphite_tpu.trace.schema import (
+    Op,
+    TraceBatch,
+    TraceBuilder,
+    MAX_MEM_OPS,
+)
+from graphite_tpu.trace import synthetic
+
+__all__ = ["Op", "TraceBatch", "TraceBuilder", "MAX_MEM_OPS", "synthetic"]

@@ -1,0 +1,270 @@
+"""Trace record schema: the instruction/event stream consumed by the engine.
+
+The op space unifies two reference concepts:
+ - `InstructionType` (`common/tile/core/instruction.h:20-43`): the static
+   instruction classes whose costs come from
+   `[core/static_instruction_costs]` (`carbon_sim.cfg:189-200`), plus the
+   dynamic classes (recv/sync/spawn/stall, `instruction.h:149-198`);
+ - the user-API calls that Pin's routine replacement intercepts
+   (`pin/routine_replace.cc:37-101`): CAPI send/recv (`capi.h:18-24`),
+   mutex/cond/barrier (`sync_api.h:19-34`), thread spawn/join
+   (`thread_support.h:66-71`), DVFS get/set (`dvfs.h:42-48`), model toggles
+   (`performance_counter_support.h:8-9`).
+
+Record layout (struct-of-arrays, leading axes [n_tiles, T]):
+
+    op        uint8   opcode (Op enum below)
+    flags     uint8   bit0-1: mem-op slot valid; bit2-3: slot is-write;
+                      bit4: branch taken; bit5: atomic
+    pc        uint32  instruction address (icache + branch predictor index)
+    addr0/1   uint32  memory operand addresses (slot 0 / slot 1)
+    size0/1   uint8   memory operand sizes in bytes
+    aux0      int32   partner tile / sync-object id / dvfs domain
+    aux1      int32   message size / barrier count / frequency (MHz)
+    dyn_ps    int64   dynamic-instruction cost in ps (Op.SPAWN: absolute time)
+
+32 bytes per record; a 1024-tile x 1M-instruction trace is 32 GB streamed in
+windows, or generated on device.  Memory operands are pre-split at cache-line
+boundaries by producers (the reference splits in
+`core.cc:140-267 initiateMemoryAccess`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import numpy as np
+
+
+MAX_MEM_OPS = 2  # matches Pin operand scan (`pin/instruction_modeling.cc:33-124`)
+
+# flags bits
+FLAG_MEM0_VALID = 1 << 0
+FLAG_MEM1_VALID = 1 << 1
+FLAG_MEM0_WRITE = 1 << 2
+FLAG_MEM1_WRITE = 1 << 3
+FLAG_BRANCH_TAKEN = 1 << 4
+FLAG_ATOMIC = 1 << 5
+
+
+class Op(enum.IntEnum):
+    """Unified opcode space.
+
+    0-19 mirror `InstructionType` (`instruction.h:20-43`) in order, so the
+    static-cost table indexes directly.  32+ are user-API events.
+    """
+
+    GENERIC = 0
+    MOV = 1
+    IALU = 2
+    IMUL = 3
+    IDIV = 4
+    FALU = 5
+    FMUL = 6
+    FDIV = 7
+    XMM_SS = 8
+    XMM_SD = 9
+    XMM_PS = 10
+    BRANCH = 11
+    LFENCE = 12
+    SFENCE = 13
+    MFENCE = 14
+    DYNAMIC_MISC = 15
+    RECV = 16
+    SYNC = 17
+    SPAWN = 18
+    STALL = 19
+    # --- user-API events (L7 surface) ---
+    SEND = 32          # CAPI_message_send_w:   aux0=dest tile, aux1=bytes
+    NET_RECV = 33      # CAPI_message_receive_w: aux0=sender tile, aux1=bytes
+    MUTEX_INIT = 34    # aux0=mutex id
+    MUTEX_LOCK = 35    # aux0=mutex id
+    MUTEX_UNLOCK = 36  # aux0=mutex id
+    COND_INIT = 37     # aux0=cond id
+    COND_WAIT = 38     # aux0=cond id, aux1=mutex id
+    COND_SIGNAL = 39   # aux0=cond id
+    COND_BROADCAST = 40  # aux0=cond id
+    BARRIER_INIT = 41  # aux0=barrier id, aux1=count
+    BARRIER_WAIT = 42  # aux0=barrier id
+    THREAD_SPAWN = 43  # aux0=target tile
+    THREAD_JOIN = 44   # aux0=target tile
+    THREAD_EXIT = 45   # end of this tile's stream
+    ENABLE_MODELS = 46
+    DISABLE_MODELS = 47
+    DVFS_SET = 48      # aux0=domain, aux1=frequency in MHz
+    DVFS_GET = 49      # aux0=domain
+    NOP = 255          # padding past THREAD_EXIT
+
+
+N_STATIC_INSTRUCTION_TYPES = 20  # MAX_INSTRUCTION_COUNT (`instruction.h:42`)
+
+STATIC_COST_KEYS = (
+    # `INSTRUCTION_NAMES` (`instruction.h:45-46`); costs read from
+    # core/static_instruction_costs/<name> with default 0
+    # (`core_model.cc:65-76`).
+    "generic", "mov", "ialu", "imul", "idiv", "falu", "fmul", "fdiv",
+    "xmm_ss", "xmm_sd", "xmm_ps", "branch", "lfence", "sfence", "mfence",
+    "dynamic_misc", "recv", "sync", "spawn", "stall",
+)
+
+_FIELDS = (
+    ("op", np.uint8),
+    ("flags", np.uint8),
+    ("pc", np.uint32),
+    ("addr0", np.uint32),
+    ("addr1", np.uint32),
+    ("size0", np.uint8),
+    ("size1", np.uint8),
+    ("aux0", np.int32),
+    ("aux1", np.int32),
+    ("dyn_ps", np.int64),
+)
+
+
+@dataclasses.dataclass
+class TraceBatch:
+    """A padded batch of per-tile traces, shape [n_tiles, length] per field."""
+
+    op: np.ndarray
+    flags: np.ndarray
+    pc: np.ndarray
+    addr0: np.ndarray
+    addr1: np.ndarray
+    size0: np.ndarray
+    size1: np.ndarray
+    aux0: np.ndarray
+    aux1: np.ndarray
+    dyn_ps: np.ndarray
+
+    @property
+    def n_tiles(self) -> int:
+        return self.op.shape[0]
+
+    @property
+    def length(self) -> int:
+        return self.op.shape[1]
+
+    def save(self, path: str) -> None:
+        np.savez_compressed(path, **dataclasses.asdict(self))
+
+    @classmethod
+    def load(cls, path: str) -> "TraceBatch":
+        with np.load(path) as data:
+            return cls(**{name: data[name] for name, _ in _FIELDS})
+
+    @classmethod
+    def from_builders(cls, builders: "list[TraceBuilder]") -> "TraceBatch":
+        """Pad per-tile streams to a common length with THREAD_EXIT + NOP."""
+        for b in builders:
+            if not b._op or b._op[-1] != Op.THREAD_EXIT:
+                b.exit()
+        length = max(len(b._op) for b in builders)
+        n = len(builders)
+        arrays = {
+            name: np.zeros((n, length), dtype=dtype) for name, dtype in _FIELDS
+        }
+        arrays["op"][:] = int(Op.NOP)
+        for t, b in enumerate(builders):
+            for name, _ in _FIELDS:
+                col = getattr(b, "_" + name)
+                arrays[name][t, : len(col)] = col
+        return cls(**arrays)
+
+
+class TraceBuilder:
+    """Append-records-for-one-tile helper used by generators and tests."""
+
+    def __init__(self) -> None:
+        for name, _ in _FIELDS:
+            setattr(self, "_" + name, [])
+
+    def _append(self, op, flags=0, pc=0, addr0=0, addr1=0, size0=0, size1=0,
+                aux0=0, aux1=0, dyn_ps=0) -> "TraceBuilder":
+        self._op.append(int(op))
+        self._flags.append(flags)
+        self._pc.append(pc)
+        self._addr0.append(addr0)
+        self._addr1.append(addr1)
+        self._size0.append(size0)
+        self._size1.append(size1)
+        self._aux0.append(aux0)
+        self._aux1.append(aux1)
+        self._dyn_ps.append(dyn_ps)
+        return self
+
+    # --- instructions ----------------------------------------------------
+
+    def instr(self, op: Op, pc: int = 0) -> "TraceBuilder":
+        """A compute instruction with no memory operands."""
+        return self._append(op, pc=pc)
+
+    def load(self, addr: int, size: int = 4, pc: int = 0,
+             op: Op = Op.MOV) -> "TraceBuilder":
+        return self._append(op, flags=FLAG_MEM0_VALID, pc=pc,
+                            addr0=addr, size0=size)
+
+    def store(self, addr: int, size: int = 4, pc: int = 0,
+              op: Op = Op.MOV) -> "TraceBuilder":
+        return self._append(op, flags=FLAG_MEM0_VALID | FLAG_MEM0_WRITE,
+                            pc=pc, addr0=addr, size0=size)
+
+    def load_store(self, raddr: int, waddr: int, size: int = 4,
+                   pc: int = 0, op: Op = Op.GENERIC) -> "TraceBuilder":
+        flags = (FLAG_MEM0_VALID | FLAG_MEM1_VALID | FLAG_MEM1_WRITE)
+        return self._append(op, flags=flags, pc=pc, addr0=raddr,
+                            addr1=waddr, size0=size, size1=size)
+
+    def branch(self, taken: bool, pc: int = 0) -> "TraceBuilder":
+        flags = FLAG_BRANCH_TAKEN if taken else 0
+        return self._append(Op.BRANCH, flags=flags, pc=pc)
+
+    def dynamic(self, op: Op, cost_ps: int) -> "TraceBuilder":
+        return self._append(op, dyn_ps=cost_ps)
+
+    # --- user-API events -------------------------------------------------
+
+    def send(self, dest: int, size: int = 8) -> "TraceBuilder":
+        return self._append(Op.SEND, aux0=dest, aux1=size)
+
+    def recv(self, sender: int, size: int = 8) -> "TraceBuilder":
+        return self._append(Op.NET_RECV, aux0=sender, aux1=size)
+
+    def mutex_init(self, mux: int) -> "TraceBuilder":
+        return self._append(Op.MUTEX_INIT, aux0=mux)
+
+    def mutex_lock(self, mux: int) -> "TraceBuilder":
+        return self._append(Op.MUTEX_LOCK, aux0=mux)
+
+    def mutex_unlock(self, mux: int) -> "TraceBuilder":
+        return self._append(Op.MUTEX_UNLOCK, aux0=mux)
+
+    def cond_init(self, cond: int) -> "TraceBuilder":
+        return self._append(Op.COND_INIT, aux0=cond)
+
+    def cond_wait(self, cond: int, mux: int) -> "TraceBuilder":
+        return self._append(Op.COND_WAIT, aux0=cond, aux1=mux)
+
+    def cond_signal(self, cond: int) -> "TraceBuilder":
+        return self._append(Op.COND_SIGNAL, aux0=cond)
+
+    def cond_broadcast(self, cond: int) -> "TraceBuilder":
+        return self._append(Op.COND_BROADCAST, aux0=cond)
+
+    def barrier_init(self, bar: int, count: int) -> "TraceBuilder":
+        return self._append(Op.BARRIER_INIT, aux0=bar, aux1=count)
+
+    def barrier_wait(self, bar: int) -> "TraceBuilder":
+        return self._append(Op.BARRIER_WAIT, aux0=bar)
+
+    def thread_spawn(self, target_tile: int) -> "TraceBuilder":
+        return self._append(Op.THREAD_SPAWN, aux0=target_tile)
+
+    def thread_join(self, target_tile: int) -> "TraceBuilder":
+        return self._append(Op.THREAD_JOIN, aux0=target_tile)
+
+    def exit(self) -> "TraceBuilder":
+        return self._append(Op.THREAD_EXIT)
+
+    def dvfs_set(self, domain: int, freq_mhz: int) -> "TraceBuilder":
+        return self._append(Op.DVFS_SET, aux0=domain, aux1=freq_mhz)

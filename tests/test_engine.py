@@ -1,0 +1,422 @@
+"""End-to-end engine tests: core timing, messaging, sync, quantum loop.
+
+Expected values are hand-derived from the reference semantics:
+ - static costs + 1-IPC accumulation (`simple_core_model.cc:37-97`,
+   `carbon_sim.cfg:189-200`);
+ - one-bit branch predictor (`one_bit_branch_predictor.cc:13-24`) with
+   14-cycle mispredict penalty (`carbon_sim.cfg:202-205`);
+ - magic network = 1 cycle/packet (`network_model_magic.cc:15-22`);
+ - emesh_hop_counter = hops*(router+link) + flits serialization
+   (`network_model_emesh_hop_counter.cc:142-157`, `network_model.cc:143-149`);
+ - netRecv clock = max(clock, arrival), RecvInstruction only when waiting
+   (`network.cc:443-453`);
+ - SimBarrier releases at max arrival time (`sync_server.cc:133-160`);
+ - SimMutex handoff at unlock time (`sync_server.cc:27-57,185-240`).
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine import Simulator
+from graphite_tpu.engine.simulator import DeadlockError
+from graphite_tpu.trace import synthetic
+from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+
+def make_config(n_tiles=4, user_net="magic", scheme="lax_barrier", extra=""):
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+[network]
+user = {user_net}
+memory = magic
+[core/static_instruction_costs]
+generic = 1
+mov = 1
+ialu = 1
+imul = 3
+idiv = 18
+falu = 3
+fmul = 5
+fdiv = 6
+[branch_predictor]
+type = one_bit
+mispredict_penalty = 14
+size = 1024
+[clock_skew_management]
+scheme = {scheme}
+[clock_skew_management/lax_barrier]
+quantum = 1000
+[network/emesh_hop_counter]
+flit_width = 64
+[network/emesh_hop_counter/router]
+delay = 1
+[network/emesh_hop_counter/link]
+delay = 1
+{extra}
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+def run(sc, builders_or_batch, **kw):
+    batch = (
+        builders_or_batch
+        if isinstance(builders_or_batch, TraceBatch)
+        else TraceBatch.from_builders(builders_or_batch)
+    )
+    return Simulator(sc, batch, **kw).run()
+
+
+class TestCoreTiming:
+    def test_static_costs_accumulate(self):
+        # 10 ialu(1) + 2 imul(3) = 16 cycles @ 1 GHz = 16000 ps
+        bs = []
+        for t in range(4):
+            b = TraceBuilder()
+            for _ in range(10):
+                b.instr(Op.IALU)
+            for _ in range(2):
+                b.instr(Op.IMUL)
+            bs.append(b)
+        r = run(make_config(), bs)
+        assert r.clock_ps.tolist() == [16000] * 4
+        assert r.instruction_count.tolist() == [12] * 4
+        assert r.execution_stall_ps.tolist() == [16000] * 4
+
+    def test_all_cost_classes(self):
+        costs = {Op.GENERIC: 1, Op.MOV: 1, Op.IALU: 1, Op.IMUL: 3,
+                 Op.IDIV: 18, Op.FALU: 3, Op.FMUL: 5, Op.FDIV: 6}
+        b = TraceBuilder()
+        for op in costs:
+            b.instr(op)
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        r = run(make_config(), bs)
+        assert r.clock_ps[0] == sum(costs.values()) * 1000
+
+    def test_dynamic_stall_cost(self):
+        b = TraceBuilder().dynamic(Op.STALL, cost_ps=12345)
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        r = run(make_config(), bs)
+        assert r.clock_ps[0] == 12345
+        assert r.instruction_count[0] == 1  # dynamic instrs count
+
+    def test_core_frequency_scales_costs(self):
+        # CORE domain at 2 GHz: 1 cycle = 500 ps
+        sc = make_config(extra='[dvfs]\ndomains = "<2.0, CORE, L1_ICACHE, '
+                         'L1_DCACHE, L2_CACHE, DIRECTORY, NETWORK_USER, '
+                         'NETWORK_MEMORY>"\n')
+        bs = [TraceBuilder().instr(Op.IALU) for _ in range(4)]
+        r = run(sc, bs)
+        assert r.clock_ps.tolist() == [500] * 4
+
+
+class TestBranchPredictor:
+    def test_one_bit_first_mispredicts(self):
+        # table initialized 0 = predict not-taken; first taken mispredicts
+        b = TraceBuilder()
+        for _ in range(5):
+            b.branch(True, pc=0x100)
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        r = run(make_config(), bs)
+        assert r.clock_ps[0] == (14 + 4) * 1000
+        assert int(r.bp_correct[0]) == 4
+        assert int(r.bp_incorrect[0]) == 1
+
+    def test_alternating_always_mispredicts(self):
+        b = TraceBuilder()
+        for i in range(6):
+            b.branch(i % 2 == 0, pc=0x40)
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        r = run(make_config(), bs)
+        assert int(r.bp_incorrect[0]) == 6
+        assert r.clock_ps[0] == 6 * 14 * 1000
+
+
+class TestUserNetwork:
+    def test_ping_pong_magic(self):
+        sc = make_config(n_tiles=2)
+        r = run(sc, synthetic.ping_pong_trace(2, n_rounds=3))
+        # each direction costs 1000 ps (magic 1 cycle @ 1GHz)
+        assert r.clock_ps.tolist() == [6000, 5000]
+        assert r.packets_sent.tolist() == [3, 3]
+        assert r.packets_received.tolist() == [3, 3]
+        assert r.recv_stall_ps.tolist() == [6000, 5000]
+        # every recv waited → counted as RecvInstruction (`network.cc:445-453`)
+        assert r.recv_instructions.tolist() == [3, 3]
+
+    def test_no_wait_recv_costs_nothing(self):
+        # receiver arrives late: packet already there, no recv instruction
+        sc = make_config(n_tiles=2)
+        b0 = TraceBuilder().send(1, 8)
+        b1 = TraceBuilder()
+        for _ in range(10):
+            b1.instr(Op.IALU)
+        b1.recv(0)
+        r = run(sc, [b0, b1])
+        assert r.clock_ps[1] == 10000  # no added cost
+        assert r.recv_instructions[1] == 0
+        assert r.recv_stall_ps[1] == 0
+
+    def test_emesh_hop_counter_latency(self):
+        # 4 tiles = 2x2 mesh. tile0 -> tile3: hops = 2, hop_latency = 2 cyc
+        # serialization: (64 hdr + 8 payload)*8 = 576 bits / 64 = 9 flits
+        # total = 2*2 + 9 = 13 cycles = 13000 ps @ 1GHz
+        sc = make_config(user_net="emesh_hop_counter")
+        b0 = TraceBuilder().send(3, 8)
+        b3 = TraceBuilder().recv(0)
+        bs = [b0, TraceBuilder(), TraceBuilder(), b3]
+        r = run(sc, bs)
+        assert r.clock_ps[3] == 13000
+        assert r.recv_stall_ps[3] == 13000
+
+    def test_recv_any_takes_earliest(self):
+        sc = make_config(n_tiles=4)
+        # tiles 1,2 send to 0 at different times; ANY recv takes earliest
+        b1 = TraceBuilder().send(0, 8)                       # arrives 1000
+        b2 = TraceBuilder()
+        for _ in range(5):
+            b2.instr(Op.IALU)
+        b2.send(0, 8)                                        # arrives 6000
+        b0 = TraceBuilder().recv(-1).recv(-1)
+        r = run(sc, [b0, b1, b2, TraceBuilder()])
+        assert r.clock_ps[0] == 6000
+        assert r.recv_stall_ps[0] == 1000 + 5000
+
+    def test_mailbox_queue_in_order(self):
+        sc = make_config(n_tiles=2)
+        b0 = TraceBuilder()
+        for _ in range(5):
+            b0.send(1, 8)
+        b1 = TraceBuilder()
+        for _ in range(5):
+            b1.recv(0)
+        r = run(sc, [b0, b1])
+        assert r.packets_received[1] == 5
+        assert r.clock_ps[1] == 1000  # all arrive at 1000 (sends are free)
+
+
+class TestSync:
+    def test_barrier_releases_at_max_time(self):
+        bs = []
+        for t in range(4):
+            b = TraceBuilder()
+            if t == 0:
+                b.barrier_init(0, 4)
+            for _ in range((t + 1) * 2):
+                b.instr(Op.IALU)
+            b.barrier_wait(0)
+            b.instr(Op.IALU)
+            bs.append(b)
+        r = run(make_config(), bs)
+        assert r.clock_ps.tolist() == [9000] * 4
+        assert r.sync_stall_ps.tolist() == [6000, 4000, 2000, 0]
+        # last arriver pays nothing → not a sync instruction
+        assert r.sync_instructions.tolist() == [1, 1, 1, 0]
+
+    def test_barrier_reusable(self):
+        # two rounds on the same barrier (SimBarrier resets after release)
+        bs = []
+        for t in range(2):
+            b = TraceBuilder()
+            if t == 0:
+                b.barrier_init(0, 2)
+            b.instr(Op.IALU)
+            b.barrier_wait(0)
+            for _ in range(t + 1):
+                b.instr(Op.IALU)
+            b.barrier_wait(0)
+            bs.append(b)
+        r = run(make_config(n_tiles=2), bs)
+        assert r.clock_ps.tolist() == [3000, 3000]
+
+    def test_mutex_contention_serializes(self):
+        b0 = TraceBuilder().mutex_init(0).mutex_lock(0)
+        for _ in range(10):
+            b0.instr(Op.IALU)
+        b0.mutex_unlock(0)
+        b1 = TraceBuilder().instr(Op.IALU).mutex_lock(0).instr(Op.IALU)
+        b1.mutex_unlock(0)
+        bs = [b0, b1, TraceBuilder(), TraceBuilder()]
+        r = run(make_config(), bs)
+        # t1 blocks at 1000, granted at t0's unlock (10000), +1 cycle
+        assert r.clock_ps[0] == 10000
+        assert r.clock_ps[1] == 11000
+        assert r.sync_stall_ps[1] == 9000
+        assert r.sync_instructions[1] == 1
+
+    def test_mutex_grant_order_by_time(self):
+        # three contenders; grants must go in simulated-time order
+        b0 = TraceBuilder().mutex_init(0).mutex_lock(0)
+        for _ in range(4):
+            b0.instr(Op.IALU)
+        b0.mutex_unlock(0)  # unlock @4000
+        b1 = TraceBuilder().instr(Op.IALU).mutex_lock(0)          # req @1000
+        b1.instr(Op.IALU).mutex_unlock(0)
+        b2 = TraceBuilder().instr(Op.IALU).instr(Op.IALU).mutex_lock(0)  # @2000
+        b2.instr(Op.IALU).mutex_unlock(0)
+        r = run(make_config(), [b0, b1, b2, TraceBuilder()])
+        # t1 granted at 4000 → done 5000; t2 granted at 5000 → done 6000
+        assert r.clock_ps[1] == 5000
+        assert r.clock_ps[2] == 6000
+
+
+class TestThreads:
+    def test_join_waits_for_target_exit(self):
+        b0 = TraceBuilder().thread_spawn(1).thread_join(1).instr(Op.IALU)
+        b1 = TraceBuilder()
+        for _ in range(7):
+            b1.instr(Op.IALU)
+        r = run(make_config(n_tiles=2), [b0, b1])
+        assert r.clock_ps[0] == 8000  # joined at 7000 + 1 cycle
+
+
+class TestModelToggles:
+    def test_disabled_models_cost_nothing(self):
+        b = TraceBuilder()
+        b.dynamic(Op.DISABLE_MODELS, 0)
+        for _ in range(5):
+            b.instr(Op.IALU)
+        bs = [b] + [TraceBuilder() for _ in range(3)]
+        # DISABLE event via builder._append path
+        bs[0]._op[0] = int(Op.DISABLE_MODELS)
+        r = run(make_config(), bs)
+        assert r.clock_ps[0] == 0
+        assert r.instruction_count[0] == 0
+
+
+class TestSpawnAndDvfs:
+    def test_spawn_sets_absolute_time(self):
+        # SpawnInstruction sets the clock to the given absolute time
+        # (`instruction.cc:72-83`), it does not add to it
+        b = TraceBuilder()
+        for _ in range(3):
+            b.instr(Op.IALU)          # clock 3000
+        b.dynamic(Op.SPAWN, 5000)     # max(3000, 5000) = 5000
+        b.instr(Op.IALU)
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        r = run(make_config(), bs)
+        assert r.clock_ps[0] == 6000
+
+    def test_spawn_in_past_keeps_clock(self):
+        b = TraceBuilder()
+        for _ in range(3):
+            b.instr(Op.IALU)
+        b.dynamic(Op.SPAWN, 1000)     # behind current clock → no-op
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        r = run(make_config(), bs)
+        assert r.clock_ps[0] == 3000
+
+    def test_dvfs_set_core_retunes_frequency(self):
+        b = TraceBuilder().instr(Op.IALU)       # 1000 ps @ 1 GHz
+        b.dvfs_set(0, 2000)                     # CORE domain → 2 GHz
+        b.instr(Op.IALU)                        # 500 ps
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        r = run(make_config(), bs)
+        assert r.clock_ps[0] == 1500
+
+
+class TestQuantumLoop:
+    def test_lax_barrier_many_quanta(self):
+        # 10000 cycles of work = 10 quanta of 1000ns... (1 cycle = 1ns)
+        b = TraceBuilder()
+        for _ in range(2500):
+            b.instr(Op.IDIV)  # 18 cycles each -> 45000 ns total
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        r = run(make_config(scheme="lax_barrier"), bs)
+        assert r.clock_ps[0] == 2500 * 18 * 1000
+        assert r.n_quanta >= 45
+
+    def test_lax_single_quantum(self):
+        b = TraceBuilder()
+        for _ in range(100):
+            b.instr(Op.IDIV)
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        r = run(make_config(scheme="lax"), bs)
+        assert r.clock_ps[0] == 100 * 18 * 1000
+        assert r.n_quanta == 1
+
+    def test_deadlock_detected(self):
+        # tile 0 recvs from tile 1, which never sends
+        b0 = TraceBuilder().recv(1)
+        bs = [b0] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        with pytest.raises(DeadlockError):
+            run(make_config(), bs)
+
+    def test_long_stall_fast_forwards_quanta(self):
+        # a tile 5000 quanta ahead must not trigger a false deadlock, and
+        # empty quanta must be skipped, not iterated (`simulator.run`)
+        b = TraceBuilder().dynamic(Op.STALL, 5_000_000_000).instr(Op.IALU)
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        r = run(make_config(scheme="lax_barrier"), bs)
+        assert r.clock_ps[0] == 5_000_001_000
+        assert r.n_quanta < 10
+
+    def test_late_sender_does_not_false_deadlock(self):
+        # sender crosses many quanta with one long stall, then sends; the
+        # blocked receiver must wait, not deadlock
+        sc = make_config(n_tiles=2)
+        b0 = TraceBuilder().dynamic(Op.STALL, 5_000_000).send(1, 8)
+        b1 = TraceBuilder().recv(0)
+        r = run(sc, [b0, b1])
+        assert r.clock_ps[1] == 5_001_000
+
+    def test_cross_quantum_messaging(self):
+        # sender does 5000 cycles of work (5 quanta) before sending
+        sc = make_config(n_tiles=2)
+        b0 = TraceBuilder()
+        for _ in range(5000):
+            b0.instr(Op.IALU)
+        b0.send(1, 8)
+        b1 = TraceBuilder().recv(0)
+        r = run(sc, [b0, b1])
+        assert r.clock_ps[1] == 5001 * 1000
+
+
+class TestSyntheticTraces:
+    @pytest.mark.parametrize("pattern", list(synthetic.TRAFFIC_PATTERNS))
+    def test_traffic_patterns_complete(self, pattern):
+        sc = make_config(n_tiles=16, scheme="lax")
+        tb = synthetic.network_traffic_trace(
+            16, pattern, total_packets=8, offered_load=1.0
+        )
+        r = Simulator(sc, tb, mailbox_depth=32).run()
+        assert int(r.packets_sent.sum()) == 16 * 8
+        assert int(r.packets_received.sum()) == 16 * 8
+
+    def test_uniform_random_matrix_is_permutation_schedule(self):
+        m = synthetic.uniform_random_matrix(8)
+        assert m.shape == (8, 8)
+
+    def test_memory_stress_trace_builds(self):
+        tb = synthetic.memory_stress_trace(4, n_accesses=50)
+        assert tb.n_tiles == 4
+
+    def test_compute_mix_runs(self):
+        sc = make_config(n_tiles=4, scheme="lax")
+        r = run(sc, synthetic.compute_mix_trace(4, n_instructions=200))
+        assert (r.instruction_count == 200).all()
+        assert (r.clock_ps > 0).all()
+
+
+class TestDeterminism:
+    def test_bitwise_reproducible(self):
+        sc = make_config(n_tiles=16, scheme="lax")
+        tb = synthetic.network_traffic_trace(16, "uniform_random",
+                                             total_packets=5, seed=3)
+        r1 = Simulator(sc, tb, mailbox_depth=32).run()
+        r2 = Simulator(sc, tb, mailbox_depth=32).run()
+        assert r1.clock_ps.tolist() == r2.clock_ps.tolist()
+        assert r1.instruction_count.tolist() == r2.instruction_count.tolist()
+        assert r1.total_packet_latency_ps.tolist() == r2.total_packet_latency_ps.tolist()
+
+
+def test_summary_renders():
+    sc = make_config(n_tiles=2)
+    r = run(sc, synthetic.ping_pong_trace(2, n_rounds=2))
+    text = r.summary()
+    assert "Tile 0 Summary" in text
+    assert "Total Instructions" in text
+    assert "Average Packet Latency" in text
