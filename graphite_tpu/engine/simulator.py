@@ -57,6 +57,9 @@ class SimResults:
     packets_received: np.ndarray
     total_packet_latency_ps: np.ndarray
     n_quanta: int
+    # memory-subsystem counters (per-tile arrays), None when no memory model
+    mem_counters: "dict | None" = None
+    func_errors: int = 0
 
     @property
     def total_instructions(self) -> int:
@@ -139,6 +142,31 @@ class Simulator:
             for k in STATIC_COST_KEYS
         )
         bp_type = cfg.get_string("branch_predictor/type", "one_bit")
+
+        # Memory subsystem: built when shared memory is enabled AND the
+        # trace actually touches memory (`general/enable_shared_mem`,
+        # `carbon_sim.cfg:40-44`; protocol factory `memory_manager.cc:31-48`).
+        from graphite_tpu.trace.schema import FLAG_MEM0_VALID, FLAG_MEM1_VALID
+
+        has_mem = bool(
+            np.any(trace.flags & (FLAG_MEM0_VALID | FLAG_MEM1_VALID))
+        )
+        mem_params = None
+        if config.enable_shared_mem and has_mem:
+            from graphite_tpu.memory import MemParams
+
+            mem_params = MemParams.from_config(config)
+            if mem_params.protocol != "pr_l1_pr_l2_dram_directory_msi":
+                raise NotImplementedError(
+                    f"caching protocol {mem_params.protocol!r} pending "
+                    "(pr_l1_pr_l2_dram_directory_msi available)"
+                )
+        # Full hop-by-hop USER NoC with per-port contention
+        user_hbh = None
+        if config.network_types[0] == "emesh_hop_by_hop":
+            from graphite_tpu.models.network_hop_by_hop import HopByHopParams
+
+            user_hbh = HopByHopParams.from_config(config, "user")
         self.params = EngineParams(
             n_tiles=n_tiles,
             static_cost_cycles=costs,
@@ -150,6 +178,8 @@ class Simulator:
             ),
             mailbox_depth=mailbox_depth,
             inner_block=inner_block,
+            mem=mem_params,
+            user_hbh=user_hbh,
         )
         # Clock-skew scheme (`carbon_sim.cfg:85-108`): lax_barrier uses the
         # config quantum; lax runs one unbounded quantum; lax_p2p is
@@ -179,6 +209,14 @@ class Simulator:
             n_mutexes=n_mutexes,
             models_enabled=models_on,
         )
+        if mem_params is not None:
+            from graphite_tpu.memory import init_mem_state
+
+            self.state = self.state.replace(mem=init_mem_state(mem_params))
+        if user_hbh is not None:
+            from graphite_tpu.models.network_hop_by_hop import init_noc_state
+
+            self.state = self.state.replace(noc_user=init_noc_state(user_hbh))
         self.device_trace = DeviceTrace.from_batch(trace)
         if mesh is not None:
             # Shard the tile axis over the device mesh (SURVEY §2.10): the
@@ -258,6 +296,16 @@ class Simulator:
     def _results(self, state: SimState, n_quanta: int) -> SimResults:
         core, net = state.core, state.net
         clock = np.asarray(core.clock_ps)
+        mem_counters = None
+        func_errors = 0
+        if state.mem is not None:
+            import dataclasses as _dc
+
+            mem_counters = {
+                f.name: np.asarray(getattr(state.mem.counters, f.name))
+                for f in _dc.fields(state.mem.counters)
+            }
+            func_errors = int(np.asarray(state.mem.func_errors))
         return SimResults(
             n_tiles=self.params.n_tiles,
             completion_time_ps=int(clock.max()),
@@ -275,4 +323,6 @@ class Simulator:
             packets_received=np.asarray(net.packets_received),
             total_packet_latency_ps=np.asarray(net.total_latency_ps),
             n_quanta=n_quanta,
+            mem_counters=mem_counters,
+            func_errors=func_errors,
         )

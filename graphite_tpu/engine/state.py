@@ -87,6 +87,12 @@ class SimState:
     sync: SyncState
     models_enabled: jax.Array    # bool[] — CarbonEnableModels/DisableModels
     done: jax.Array              # bool[T] — thread exited (THREAD_EXIT)
+    # memory subsystem (None when enable_shared_mem=false, the reference's
+    # `general/enable_shared_mem` knob — `carbon_sim.cfg:40-44`)
+    mem: "object" = None
+    # USER-network hop-by-hop port-contention state (None unless
+    # network/user = emesh_hop_by_hop)
+    noc_user: "object" = None
 
 
 @struct.dataclass

@@ -70,6 +70,11 @@ class EngineParams:
     bp_mispredict_penalty: int = 14
     mailbox_depth: int = 8
     inner_block: int = 32      # trace records per tile per scan
+    # memory subsystem (None = enable_shared_mem false: memory operands
+    # cost nothing, like the reference's disabled shared-mem knob)
+    mem: "object" = None       # MemParams | None
+    # USER network full hop-by-hop model with per-port contention
+    user_hbh: "object" = None  # HopByHopParams | None
 
 
 def _gather_field(field: jax.Array, idx: jax.Array) -> jax.Array:
@@ -101,6 +106,30 @@ def subquantum_iteration(
     enabled = state.models_enabled
     done = state.done | (op == Op.NOP) | (op == Op.THREAD_EXIT)
     active = (~done) & (core.clock_ps < quantum_end_ps)
+
+    # --- memory subsystem (caches + coherence protocol) ------------------
+    # Runs every iteration: requester lanes start/advance their record's
+    # memory slots; home/sharer machinery serves protocol messages even for
+    # tiles past the quantum boundary (like the reference's sim threads).
+    if params.mem is not None:
+        from graphite_tpu.memory.engine import RecView, memory_engine_step
+
+        addr0 = _gather_field(trace.addr0, idx)
+        addr1 = _gather_field(trace.addr1, idx)
+        rec = RecView(op=op, flags=flags, pc=pc, addr0=addr0, addr1=addr1,
+                      aux0=aux0, aux1=aux1)
+        mem_out = memory_engine_step(
+            params.mem, state.mem, rec, core.clock_ps, core.freq_mhz,
+            active, enabled)
+        mem_state = mem_out.ms
+        mem_ok = mem_out.mem_complete
+        mem_acc_ps = mem_out.acc_ps
+        mem_progress = mem_out.progress
+    else:
+        mem_state = state.mem
+        mem_ok = jnp.ones((T,), jnp.bool_)
+        mem_acc_ps = jnp.zeros((T,), I64)
+        mem_progress = jnp.zeros((), jnp.int32)
 
     # --- classify -------------------------------------------------------
     is_branch = op == Op.BRANCH
@@ -147,8 +176,18 @@ def subquantum_iteration(
     # --- SEND: push into (dst, src) mailbox ring -------------------------
     dst = jnp.clip(aux0, 0, T - 1)
     send_now = active & is_send
-    lat_ps = route_latency_ps(params.net, tiles, dst, aux1, enabled)
-    arrival_ps = core.clock_ps + lat_ps
+    if params.user_hbh is not None:
+        from graphite_tpu.models.network_hop_by_hop import route_hop_by_hop
+        from graphite_tpu.models.network_user import user_packet_bits
+
+        noc_user, arrival_ps, _, _ = route_hop_by_hop(
+            params.user_hbh, state.noc_user, tiles, dst,
+            user_packet_bits(aux1), core.clock_ps, send_now, enabled)
+        lat_ps = arrival_ps - core.clock_ps
+    else:
+        noc_user = state.noc_user
+        lat_ps = route_latency_ps(params.net, tiles, dst, aux1, enabled)
+        arrival_ps = core.clock_ps + lat_ps
     slot = (net.head[dst, tiles] % D).astype(jnp.int32)
     # Write under mask: redirect masked-off lanes to their own (t, t) cell
     # at a dummy slot; since each lane writes a distinct src column, no
@@ -259,18 +298,24 @@ def subquantum_iteration(
     join_time = jnp.maximum(core.clock_ps, core.clock_ps[join_target])
 
     # --- commit: advance mask, clocks, counters --------------------------
+    # Instruction records with memory operands commit only once all their
+    # memory slots completed (`simple_core_model.cc:53-90`: the per-operand
+    # latencies and the execution cost land on the clock together).
+    instr_like = is_static | is_branch
     advance = active & (
-        is_static | is_branch | (is_dynamic & ~is_spawn_instr)
+        (instr_like & mem_ok) | (is_dynamic & ~is_spawn_instr)
         | is_simple_event | is_send
     )
     advance = advance | recv_now | released | (active & is_spawn_instr)
     advance = advance | granted | join_now
 
     clock = core.clock_ps
-    clock = jnp.where(advance & (is_static | is_branch
+    clock = jnp.where(advance & (instr_like
                                  | (is_dynamic & ~is_spawn_instr)
                                  | is_simple_event | is_send),
-                      clock + cost_ps, clock)
+                      clock + cost_ps
+                      + jnp.where(instr_like, mem_acc_ps, 0),
+                      clock)
     clock = jnp.where(active & is_spawn_instr,
                       jnp.maximum(clock, dyn_ps), clock)
     clock = jnp.where(recv_now, jnp.maximum(clock, recv_time), clock)
@@ -298,6 +343,8 @@ def subquantum_iteration(
         + (instr_now & enabled).astype(I64)
         + recv_charged.astype(I64)
         + sync_charged.astype(I64),
+        memory_stall_ps=core.memory_stall_ps
+        + jnp.where(advance & instr_like, mem_acc_ps, 0),
         execution_stall_ps=core.execution_stall_ps
         + jnp.where(advance & (is_static | is_branch), cost_ps, 0),
         recv_instructions=core.recv_instructions + recv_charged.astype(I64),
@@ -342,14 +389,22 @@ def subquantum_iteration(
     models_enabled = jnp.where(
         disable_now, False, jnp.where(enable_now, True, state.models_enabled)
     )
+    if params.mem is not None:
+        # reset the per-record slot machinery on commit
+        mem_state = mem_state.replace(req=mem_state.req.replace(
+            slot=jnp.where(advance, 0, mem_state.req.slot),
+            acc_ps=jnp.where(advance, 0, mem_state.req.acc_ps),
+        ))
     new_state = SimState(
         core=new_core,
         net=new_net,
         sync=new_sync,
         models_enabled=models_enabled,
         done=done,
+        mem=mem_state,
+        noc_user=noc_user,
     )
-    return new_state, jnp.sum(advance, dtype=jnp.int32)
+    return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 
 
 @functools.partial(jax.jit, static_argnums=0)

@@ -1,0 +1,14 @@
+"""Memory subsystem: vectorized caches + directory coherence protocols.
+
+TPU-native re-design of `common/tile/memory_subsystem/` (SURVEY §2.5):
+per-tile C++ cache/directory objects exchanging heap-allocated messages
+become struct-of-arrays tensors over the tile axis advanced by masked
+vectorized FSM steps; the MEMORY network's per-tile queues become dense
+[tile, tile] single-slot matrices (each tile has at most one outstanding
+memory transaction, `l2_cache_cntlr.h` _outstanding_shmem_msg).
+"""
+
+from graphite_tpu.memory.params import MemParams
+from graphite_tpu.memory.state import MemState, init_mem_state
+
+__all__ = ["MemParams", "MemState", "init_mem_state"]

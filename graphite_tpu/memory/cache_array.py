@@ -1,0 +1,171 @@
+"""Vectorized set-associative cache arrays.
+
+The reference `Cache` (`common/tile/memory_subsystem/cache/cache.h:26-135`)
+is a per-tile C++ object: tag store + state + replacement policy, accessed
+one address at a time under a lock.  Here a cache *level* across all tiles
+is three dense tensors
+
+    tags  int32[T, S, W]   cache-line address (full line number, no split
+                           tag/index — avoids reconstruction)
+    state uint8[T, S, W]   CacheState (INVALID/SHARED/MODIFIED/... below)
+    lru   uint8[T, S, W]   LRU rank, 0 = most recently used
+
+and every operation is a masked gather/scatter over the tile axis: one XLA
+op looks up (or updates) one line in *every* tile's cache simultaneously.
+Each lane touches only its own tile's row, so scatters never collide;
+masked-off lanes write back unchanged values.
+
+Set index = line % num_sets, matching the reference `CacheHashFn` modulo
+mapping (`cache/cache_hash_fn.cc`).  Replacement is LRU with
+invalid-way-first victim selection (`cache/lru_replacement_policy.cc`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+# CacheState (`common/tile/memory_subsystem/cache_state.h`).
+INVALID = 0
+SHARED = 1
+MODIFIED = 2
+EXCLUSIVE = 3   # MESI protocols
+OWNED = 4       # MOSI protocols
+
+# readable: S/E/M/O; writable: E/M (`cache_state.h` readable()/writable()).
+_READABLE = (1 << SHARED) | (1 << MODIFIED) | (1 << EXCLUSIVE) | (1 << OWNED)
+_WRITABLE = (1 << MODIFIED) | (1 << EXCLUSIVE)
+
+
+def state_readable(state: jax.Array) -> jax.Array:
+    return ((_READABLE >> state.astype(jnp.int32)) & 1).astype(jnp.bool_)
+
+
+def state_writable(state: jax.Array) -> jax.Array:
+    return ((_WRITABLE >> state.astype(jnp.int32)) & 1).astype(jnp.bool_)
+
+
+@struct.dataclass
+class CacheArrays:
+    tags: jax.Array   # int32[T, S, W]
+    state: jax.Array  # uint8[T, S, W]
+    lru: jax.Array    # uint8[T, S, W]
+
+    @property
+    def num_sets(self) -> int:
+        return self.tags.shape[1]
+
+    @property
+    def num_ways(self) -> int:
+        return self.tags.shape[2]
+
+
+def make_cache(n_tiles: int, num_sets: int, num_ways: int) -> CacheArrays:
+    shape = (n_tiles, num_sets, num_ways)
+    return CacheArrays(
+        tags=jnp.full(shape, -1, jnp.int32),
+        state=jnp.zeros(shape, jnp.uint8),
+        # ranks start as a strict permutation 0..W-1 per set; touch_lru
+        # preserves the permutation (bump-below-rank + zero the way)
+        lru=jnp.broadcast_to(
+            jnp.arange(num_ways, dtype=jnp.uint8), shape
+        ).copy(),
+    )
+
+
+def _rows(cache: CacheArrays, line: jax.Array):
+    """Gather each lane's set row: ([T,W] tags, [T,W] state, [T,W] lru, set)."""
+    T = cache.tags.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    sets = (line % cache.num_sets).astype(jnp.int32)
+    return (
+        cache.tags[tiles, sets],
+        cache.state[tiles, sets],
+        cache.lru[tiles, sets],
+        tiles,
+        sets,
+    )
+
+
+def lookup(cache: CacheArrays, line: jax.Array):
+    """Per-lane lookup: (hit bool[T], way int32[T], state uint8[T]).
+
+    `Cache::getCacheLineInfo` (`cache.h:92`) vectorized: way is valid only
+    where hit; state is INVALID where miss.
+    """
+    tag_row, st_row, _, _, _ = _rows(cache, line)
+    way_hits = (tag_row == line[:, None]) & (st_row != INVALID)
+    hit = way_hits.any(axis=1)
+    way = jnp.argmax(way_hits, axis=1).astype(jnp.int32)
+    st = jnp.where(
+        hit, jnp.take_along_axis(st_row, way[:, None], axis=1)[:, 0], INVALID
+    ).astype(jnp.uint8)
+    return hit, way, st
+
+
+def touch_lru(cache: CacheArrays, line: jax.Array, way: jax.Array,
+              mask: jax.Array) -> CacheArrays:
+    """Make `way` the MRU of its set where mask (LRU ranks shift up)."""
+    _, _, lru_row, tiles, sets = _rows(cache, line)
+    rank = jnp.take_along_axis(lru_row, way[:, None], axis=1)  # [T,1]
+    bumped = lru_row + (lru_row < rank).astype(jnp.uint8)
+    onehot = jnp.arange(cache.num_ways)[None, :] == way[:, None]
+    new_row = jnp.where(onehot, 0, bumped).astype(jnp.uint8)
+    new_row = jnp.where(mask[:, None], new_row, lru_row)
+    return cache.replace(lru=cache.lru.at[tiles, sets].set(new_row))
+
+
+def set_state(cache: CacheArrays, line: jax.Array, way: jax.Array,
+              new_state: jax.Array, mask: jax.Array) -> CacheArrays:
+    """Set the state of (line, way) where mask (`Cache::setCacheLineInfo`)."""
+    tiles = jnp.arange(cache.tags.shape[0], dtype=jnp.int32)
+    sets = (line % cache.num_sets).astype(jnp.int32)
+    cur = cache.state[tiles, sets, way]
+    val = jnp.where(mask, jnp.asarray(new_state, jnp.uint8), cur)
+    return cache.replace(state=cache.state.at[tiles, sets, way].set(val))
+
+
+def invalidate(cache: CacheArrays, line: jax.Array,
+               mask: jax.Array) -> CacheArrays:
+    """Invalidate `line` where mask & present (`Cache::invalidateCacheLine`)."""
+    hit, way, _ = lookup(cache, line)
+    return set_state(cache, line, way, INVALID, mask & hit)
+
+
+def pick_victim(cache: CacheArrays, line: jax.Array):
+    """Victim way per lane: first invalid way, else the LRU (max-rank) way.
+
+    Returns (way int32[T], victim_valid bool[T], victim_line int32[T],
+    victim_state uint8[T]).
+    """
+    tag_row, st_row, lru_row, _, _ = _rows(cache, line)
+    inv = st_row == INVALID
+    any_inv = inv.any(axis=1)
+    inv_way = jnp.argmax(inv, axis=1)
+    lru_way = jnp.argmax(lru_row, axis=1)
+    way = jnp.where(any_inv, inv_way, lru_way).astype(jnp.int32)
+    victim_valid = ~any_inv
+    victim_line = jnp.take_along_axis(tag_row, way[:, None], axis=1)[:, 0]
+    victim_state = jnp.take_along_axis(st_row, way[:, None], axis=1)[:, 0]
+    return way, victim_valid, victim_line, victim_state
+
+
+def insert_at(cache: CacheArrays, line: jax.Array, way: jax.Array,
+              new_state: jax.Array, mask: jax.Array) -> CacheArrays:
+    """Install `line` in `way` with `new_state` where mask, making it MRU.
+
+    `Cache::insertCacheLine` (`cache.h:90`) minus the eviction message
+    (the caller handles the victim it got from pick_victim).
+    """
+    tiles = jnp.arange(cache.tags.shape[0], dtype=jnp.int32)
+    sets = (line % cache.num_sets).astype(jnp.int32)
+    tags = cache.tags.at[tiles, sets, way].set(
+        jnp.where(mask, line, cache.tags[tiles, sets, way])
+    )
+    state = cache.state.at[tiles, sets, way].set(
+        jnp.where(mask, jnp.asarray(new_state, jnp.uint8),
+                  cache.state[tiles, sets, way])
+    )
+    out = cache.replace(tags=tags, state=state)
+    return touch_lru(out, line, way, mask)

@@ -1,0 +1,1068 @@
+"""The vectorized MSI dram-directory protocol engine.
+
+One `memory_engine_step` advances every tile's memory machinery by one
+subquantum iteration.  It is the TPU-native fusion of three reference code
+paths that each ran on their own host thread:
+
+ - the app thread's `L1CacheCntlr::processMemOpFromCore` →
+   `L2CacheCntlr::processShmemRequestFromL1Cache` miss path
+   (`l1_cache_cntlr.cc:90-180`, `l2_cache_cntlr.cc:181-292`);
+ - the home tile's sim thread running the directory FSM
+   (`dram_directory_cntlr.cc:44-559`);
+ - every other tile's sim thread serving INV/FLUSH/WB requests
+   (`l2_cache_cntlr.cc:295-503`).
+
+Concurrency discipline (replaces locks + semaphores + TCP):
+ - each tile lane owns its own row of every cache tensor and at most one
+   mailbox cell per matrix per iteration, so scatters never collide;
+ - a home tile's fan-out (invalidation multicast) is a dense outer-product
+   write into the FWD matrix, of which the home owns a full column (it has
+   one active transaction at a time — the vectorized form of the
+   per-address request queue serialization in `dram_directory_cntlr.cc`);
+ - sharers and homes consume one incoming message per iteration (earliest
+   timestamp first), which makes the engine deterministic — the reference's
+   arrival-order FIFO is host-timing dependent.
+
+Timing follows the reference exactly where stated (cache access cycles,
+synchronization delays at DVFS-domain crossings, directory access cycles,
+DRAM latency + bandwidth serialization, network zero-load + serialization);
+simulated time rides in the messages, never in a global clock.
+
+Known divergences (documented for the parity harness):
+ - a home services one transaction at a time even across different
+   addresses; sim-time is message-carried so this only serializes *wall*
+   progress, plus a same-address completion floor mirrors the reference's
+   per-address queue (`processNextReqFromL2Cache`);
+ - directory NULLIFY picks the min-sharer victim of the set without the
+   "not in request queue" exclusion (our serialization makes it moot);
+ - DRAM queue-model contention is layered on separately (queue_models).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from graphite_tpu.memory import cache_array as ca
+from graphite_tpu.memory.cache_array import (
+    INVALID, MODIFIED, SHARED, state_readable, state_writable,
+)
+from graphite_tpu.memory.params import MemParams
+from graphite_tpu.memory.state import (
+    DIR_MODIFIED, DIR_SHARED, DIR_UNCACHED,
+    MOD_CORE, MOD_DIR, MOD_L1D, MOD_L1I, MOD_L2, MOD_NET_MEM,
+    MSG_EX_REP, MSG_EX_REQ, MSG_FLUSH_REP, MSG_FLUSH_REQ, MSG_INV_REP,
+    MSG_INV_REQ, MSG_NONE, MSG_NULLIFY, MSG_SH_REP, MSG_SH_REQ, MSG_WB_REP,
+    MSG_WB_REQ,
+    PHASE_IDLE, PHASE_WAIT_REPLY,
+    MemState,
+)
+from graphite_tpu.time_types import cycles_to_ps
+from graphite_tpu.trace.schema import (
+    FLAG_CHECK, FLAG_MEM0_VALID, FLAG_MEM0_WRITE, FLAG_MEM1_VALID,
+    FLAG_MEM1_WRITE,
+)
+
+I64 = jnp.int64
+U32 = jnp.uint32
+FAR = jnp.asarray(2**62, I64)
+
+
+# --------------------------------------------------------------------------
+# small helpers
+
+
+def _bit_word(idx):
+    return (idx // 32).astype(jnp.int32), (idx % 32).astype(jnp.uint32)
+
+
+def set_bit(words: jax.Array, idx: jax.Array, mask: jax.Array) -> jax.Array:
+    """words[t, idx[t]//64] |= 1 << idx%64 where mask; words is [T, SW]."""
+    T = words.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    w, b = _bit_word(idx)
+    cur = words[tiles, w]
+    new = cur | (jnp.uint32(1) << b)
+    return words.at[tiles, w].set(jnp.where(mask, new, cur))
+
+
+def clear_bit(words: jax.Array, idx: jax.Array, mask: jax.Array) -> jax.Array:
+    T = words.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    w, b = _bit_word(idx)
+    cur = words[tiles, w]
+    new = cur & ~(jnp.uint32(1) << b)
+    return words.at[tiles, w].set(jnp.where(mask, new, cur))
+
+
+def test_bit(words: jax.Array, idx: jax.Array) -> jax.Array:
+    T = words.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    w, b = _bit_word(idx)
+    return ((words[tiles, w] >> b) & jnp.uint32(1)) != 0
+
+
+def popcount(words: jax.Array) -> jax.Array:
+    """[T, SW] → int32[T]."""
+    return jax.lax.population_count(words).sum(axis=1).astype(jnp.int32)
+
+
+def unpack_sharers(words: jax.Array, n: int) -> jax.Array:
+    """[T, SW] uint32 → bool[T, n] (bit s of row t)."""
+    s = jnp.arange(n)
+    w = (s // 32).astype(jnp.int32)
+    b = (s % 32).astype(jnp.uint32)
+    return ((words[:, w] >> b[None, :]) & jnp.uint32(1)) != 0
+
+
+def _row_earliest(cell_type: jax.Array, cell_time: jax.Array):
+    """Earliest nonzero cell per row: (col int32[T], found bool[T]).
+
+    Deterministic total order on (time, column) — the reference's
+    arrival-order processing is host-timing dependent; this is not.
+    """
+    C = cell_type.shape[1]
+    key = jnp.where(
+        cell_type != MSG_NONE,
+        cell_time * C + jnp.arange(C, dtype=I64)[None, :],
+        FAR,
+    )
+    col = jnp.argmin(key, axis=1).astype(jnp.int32)
+    found = jnp.take_along_axis(key, col[:, None].astype(jnp.int64), axis=1)[:, 0] < FAR
+    return col, found
+
+
+def mem_net_latency_ps(mp: MemParams, src, dst, bits: int, enabled):
+    """MEMORY-network zero-load latency (`network_model_emesh_hop_counter.cc`
+    + receive serialization `network_model.cc:119-149`)."""
+    src = jnp.asarray(src)
+    dst = jnp.asarray(dst)
+    if mp.net_kind == "magic":
+        return cycles_to_ps(jnp.ones_like(src, I64), mp.net_freq_mhz)
+    w = mp.mesh_width
+    hops = jnp.abs(src % w - dst % w) + jnp.abs(src // w - dst // w)
+    flits = (bits + mp.flit_width_bits - 1) // mp.flit_width_bits
+    cycles = hops.astype(I64) * mp.hop_latency_cycles + jnp.where(
+        src == dst, 0, flits
+    )
+    cycles = jnp.where(enabled, cycles, 0)
+    return cycles_to_ps(cycles, mp.net_freq_mhz)
+
+
+@dataclasses.dataclass(frozen=True)
+class RecView:
+    """Current trace record fields needed by the memory engine (all [T])."""
+
+    op: jax.Array
+    flags: jax.Array
+    pc: jax.Array
+    addr0: jax.Array
+    addr1: jax.Array
+    aux0: jax.Array
+    aux1: jax.Array
+
+
+@struct.dataclass
+class MemStepOut:
+    ms: MemState
+    mem_complete: jax.Array  # bool[T] all slots of current record done
+    acc_ps: jax.Array        # int64[T] memory latency of the record so far
+    progress: jax.Array      # int32[] events this iteration
+
+
+# --------------------------------------------------------------------------
+# directory-entry helpers (operate on the [T, DS, DW] arrays per home lane)
+
+
+def _dir_lookup(mp: MemParams, d, line):
+    """Per-home-lane directory set lookup: (set, found, way)."""
+    T = d.tags.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    sets = (line % mp.dir_sets).astype(jnp.int32)
+    tag_row = d.tags[tiles, sets]                     # [T, DW]
+    way_hits = tag_row == line[:, None]
+    found = way_hits.any(axis=1)
+    way = jnp.argmax(way_hits, axis=1).astype(jnp.int32)
+    return sets, found, way
+
+
+def _dir_gather(d, sets, way):
+    T = d.tags.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    return (
+        d.tags[tiles, sets, way],
+        d.dstate[tiles, sets, way],
+        d.owner[tiles, sets, way],
+        d.sharers[tiles, sets, way],   # [T, SW]
+        d.nsharers[tiles, sets, way],
+    )
+
+
+def _dir_update(d, sets, way, mask, *, tags=None, dstate=None, owner=None,
+                sharers=None, nsharers=None):
+    """Masked per-lane write of one directory entry."""
+    T = d.tags.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    out = d
+    if tags is not None:
+        cur = out.tags[tiles, sets, way]
+        out = out.replace(tags=out.tags.at[tiles, sets, way].set(
+            jnp.where(mask, tags, cur)))
+    if dstate is not None:
+        cur = out.dstate[tiles, sets, way]
+        out = out.replace(dstate=out.dstate.at[tiles, sets, way].set(
+            jnp.where(mask, jnp.asarray(dstate, jnp.uint8), cur)))
+    if owner is not None:
+        cur = out.owner[tiles, sets, way]
+        out = out.replace(owner=out.owner.at[tiles, sets, way].set(
+            jnp.where(mask, owner, cur)))
+    if sharers is not None:
+        cur = out.sharers[tiles, sets, way]
+        out = out.replace(sharers=out.sharers.at[tiles, sets, way].set(
+            jnp.where(mask[:, None], sharers, cur)))
+    if nsharers is not None:
+        cur = out.nsharers[tiles, sets, way]
+        out = out.replace(nsharers=out.nsharers.at[tiles, sets, way].set(
+            jnp.where(mask, nsharers, cur)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# the engine step
+
+
+def memory_engine_step(
+    mp: MemParams,
+    ms: MemState,
+    rec: RecView,
+    clock_ps: jax.Array,      # int64[T] core clocks (base of slot accesses)
+    freq_mhz: jax.Array,      # int32[T] per-tile core/cache frequency
+    active: jax.Array,        # bool[T] lane may start new work this iter
+    enabled,                  # bool[] models enabled
+) -> MemStepOut:
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    progress = jnp.zeros((), jnp.int32)
+    fmhz = freq_mhz.astype(I64)
+
+    mc = jnp.asarray(mp.mc_tiles, jnp.int32)
+
+    def home_of(line):
+        return mc[(line % len(mp.mc_tiles)).astype(jnp.int32)]
+
+    def ccycles(n, f=None):
+        """cycles→ps at per-tile cache frequency (or given), model-gated."""
+        n = jnp.asarray(n, I64)
+        ps = cycles_to_ps(n, fmhz if f is None else f)
+        return jnp.where(enabled, ps, 0)
+
+    dram_lat_ps = jnp.where(
+        enabled, (mp.dram_latency_ns + mp.dram_processing_ns) * 1000, 0
+    ).astype(I64)
+    dir_access_ps = jnp.where(
+        enabled, cycles_to_ps(jnp.asarray(mp.dir_access_cycles, I64),
+                              mp.dir_freq_mhz), 0
+    ).astype(I64)
+
+    sync_core_l1d = ccycles(mp.sync_cycles(MOD_CORE, MOD_L1D))
+    sync_core_l1i = ccycles(mp.sync_cycles(MOD_CORE, MOD_L1I))
+    sync_l1d_l2 = ccycles(mp.sync_cycles(MOD_L1D, MOD_L2))
+    sync_l1i_l2 = ccycles(mp.sync_cycles(MOD_L1I, MOD_L2))
+    sync_l2_net = ccycles(mp.sync_cycles(MOD_L2, MOD_NET_MEM))
+    sync_dir_l2 = jnp.where(
+        enabled,
+        cycles_to_ps(jnp.asarray(mp.sync_cycles(MOD_DIR, MOD_L2), I64),
+                     mp.dir_freq_mhz), 0).astype(I64)
+    sync_dir_net = jnp.where(
+        enabled,
+        cycles_to_ps(jnp.asarray(mp.sync_cycles(MOD_DIR, MOD_NET_MEM), I64),
+                     mp.dir_freq_mhz), 0).astype(I64)
+
+    # ---- slot decomposition of the current record -------------------------
+    flags = rec.flags
+    is_instr = rec.op < 20
+    icache_present = (
+        jnp.asarray(mp.icache_modeling)
+        & jnp.asarray(enabled)
+        & is_instr
+    )
+    mem0_present = (flags & FLAG_MEM0_VALID) != 0
+    mem1_present = (flags & FLAG_MEM1_VALID) != 0
+    present = jnp.stack([icache_present, mem0_present, mem1_present], axis=1)
+
+    def next_present(slot):
+        """First present slot index >= slot, else 3."""
+        k = jnp.arange(3)[None, :]
+        cand = jnp.where(present & (k >= slot[:, None]), k, 3)
+        return cand.min(axis=1).astype(jnp.int32)
+
+    # ======================================================================
+    # (1) requester slot starts (app-thread L1/L2 path)
+    # ======================================================================
+    slot = next_present(ms.req.slot)
+    has_slot = slot < 3
+    idle = ms.req.phase == PHASE_IDLE
+    starting = active & idle & has_slot
+
+    # slot attributes
+    s_is_icache = slot == 0
+    s_addr = jnp.where(
+        s_is_icache, rec.pc.astype(jnp.int32),
+        jnp.where(slot == 1, rec.addr0.astype(jnp.int32),
+                  rec.addr1.astype(jnp.int32)))
+    s_line = (s_addr.astype(jnp.uint32) >> mp.line_bits).astype(jnp.int32)
+    s_write = jnp.where(
+        s_is_icache, False,
+        jnp.where(slot == 1, (flags & FLAG_MEM0_WRITE) != 0,
+                  (flags & FLAG_MEM1_WRITE) != 0))
+    s_comp_l1i = s_is_icache
+
+    # instruction-buffer fast path (`core.cc:205-220`): hit = 1 cycle
+    ibuf_hit = starting & s_is_icache & (s_line == ms.req.instr_buf)
+    new_instr_buf = jnp.where(starting & s_is_icache, s_line, ms.req.instr_buf)
+
+    # L1 lookups (both caches, masked by component)
+    l1i_hit, l1i_way, l1i_state = ca.lookup(ms.l1i, s_line)
+    l1d_hit, l1d_way, l1d_state = ca.lookup(ms.l1d, s_line)
+    l1_state = jnp.where(s_comp_l1i, l1i_state, l1d_state)
+    l1_permit = jnp.where(s_write, state_writable(l1_state),
+                          state_readable(l1_state))
+    do_l1 = starting & ~ibuf_hit
+
+    sync_core = jnp.where(s_comp_l1i, sync_core_l1i, sync_core_l1d)
+    l1_dat = jnp.where(
+        s_comp_l1i, ccycles(mp.l1i.data_and_tags_cycles),
+        ccycles(mp.l1d.data_and_tags_cycles))
+    l1_tag = jnp.where(
+        s_comp_l1i, ccycles(mp.l1i.tags_cycles), ccycles(mp.l1d.tags_cycles))
+    sync_l1_l2 = jnp.where(s_comp_l1i, sync_l1i_l2, sync_l1d_l2)
+
+    l1_hit_now = do_l1 & l1_permit
+    l1_miss = do_l1 & ~l1_permit
+
+    # L2 lookup for L1 misses
+    l2_hit, l2_way, l2_state = ca.lookup(ms.l2, s_line)
+    l2_permit = jnp.where(s_write, state_writable(l2_state),
+                          state_readable(l2_state))
+    l2_hit_now = l1_miss & l2_permit
+    l2_miss = l1_miss & ~l2_permit
+
+    # upgrade (write to SHARED L2 line): invalidate L2 + INV_REP to home
+    # (`l2_cache_cntlr.cc:261-282 processExReqFromL1Cache`)
+    upgrade = l2_miss & s_write & (l2_state == SHARED)
+    s_home = home_of(s_line)
+    evict_cell_busy = ms.mail.evict_type[s_home, tiles] != MSG_NONE
+    stall_start = upgrade & evict_cell_busy
+    l2_miss_go = l2_miss & ~stall_start
+
+    # --- apply the L1-hit path -------------------------------------------
+    sclock = clock_ps + sync_core           # processMemOpFromCore entry
+    l1_hit_done_ps = sclock + l1_dat
+
+    l1i_upd = ca.touch_lru(ms.l1i, s_line, l1i_way, l1_hit_now & s_comp_l1i)
+    l1d_upd = ca.touch_lru(ms.l1d, s_line, l1d_way, l1_hit_now & ~s_comp_l1i)
+
+    # --- apply the L2-hit path (fill L1 from L2) -------------------------
+    # timing: L1 tags (miss) + L2 sync + L2 data+tags + L1 data+tags
+    l2_hit_done_ps = sclock + l1_tag + sync_l1_l2 + ccycles(
+        mp.l2.data_and_tags_cycles) + l1_dat
+    # L1 fill state = L2 state (`insertCacheLineInL1`)
+    fill_l1i = l2_hit_now & s_comp_l1i
+    fill_l1d = l2_hit_now & ~s_comp_l1i
+
+    def l1_fill(cache, mask, st):
+        way, v_valid, v_line, _ = ca.pick_victim(cache, s_line)
+        out = ca.insert_at(cache, s_line, way, st, mask)
+        return out, way, v_valid & mask, v_line
+
+    l1i_upd, _, l1i_ev, l1i_ev_line = l1_fill(l1i_upd, fill_l1i, l2_state)
+    l1d_upd, _, l1d_ev, l1d_ev_line = l1_fill(l1d_upd, fill_l1d, l2_state)
+    # L1 victims: clear their cached-loc in L2 (line stays valid in L2)
+    l1_ev = l1i_ev | l1d_ev
+    l1_ev_line = jnp.where(l1i_ev, l1i_ev_line, l1d_ev_line)
+    ev_hit, ev_way, _ = ca.lookup(ms.l2, l1_ev_line)
+    ev_sets = (l1_ev_line % mp.l2.num_sets).astype(jnp.int32)
+    l2_cloc = ms.l2_cloc.at[tiles, ev_sets, ev_way].set(
+        jnp.where(l1_ev & ev_hit, 0, ms.l2_cloc[tiles, ev_sets, ev_way]))
+    # record new cached-loc for the filled line
+    f_sets = (s_line % mp.l2.num_sets).astype(jnp.int32)
+    new_cloc = jnp.where(s_comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8)
+    l2_cloc = l2_cloc.at[tiles, f_sets, l2_way].set(
+        jnp.where(l2_hit_now, new_cloc, l2_cloc[tiles, f_sets, l2_way]))
+    l2_upd = ca.touch_lru(ms.l2, s_line, l2_way, l2_hit_now)
+
+    # --- apply the L2-miss path (send request) ---------------------------
+    # `processExReqFromL1Cache`/`processShReqFromL1Cache`: request time =
+    # entry sync + L1 tags + L2 tags
+    req_send_ps = sclock + l1_tag + ccycles(mp.l2.tags_cycles)
+    # L1 line invalidated on miss before going to L2 (`l1_cache_cntlr.cc:137`)
+    l1i_upd = ca.invalidate(l1i_upd, s_line, l1_miss & s_comp_l1i)
+    l1d_upd = ca.invalidate(l1d_upd, s_line, l1_miss & ~s_comp_l1i)
+    # upgrade: invalidate L2 + INV_REP eviction message
+    l2_upd = ca.invalidate(l2_upd, s_line, upgrade & ~stall_start)
+    mail = ms.mail
+    up_go = upgrade & ~stall_start
+    w_home = jnp.where(up_go, s_home, 0)
+    mail = mail.replace(
+        evict_type=mail.evict_type.at[w_home, tiles].set(
+            jnp.where(up_go, MSG_INV_REP, mail.evict_type[w_home, tiles])),
+        evict_line=mail.evict_line.at[w_home, tiles].set(
+            jnp.where(up_go, s_line, mail.evict_line[w_home, tiles])),
+        evict_time=mail.evict_time.at[w_home, tiles].set(
+            jnp.where(
+                up_go,
+                req_send_ps + mem_net_latency_ps(
+                    mp, tiles, s_home, mp.req_bits, enabled),
+                mail.evict_time[w_home, tiles])),
+    )
+    rq_type = jnp.where(s_write, MSG_EX_REQ, MSG_SH_REQ).astype(jnp.uint8)
+    rq_home = jnp.where(l2_miss_go, s_home, 0)
+    rq_arrival = req_send_ps + mem_net_latency_ps(
+        mp, tiles, s_home, mp.req_bits, enabled)
+    mail = mail.replace(
+        req_type=mail.req_type.at[rq_home, tiles].set(
+            jnp.where(l2_miss_go, rq_type, mail.req_type[rq_home, tiles])),
+        req_line=mail.req_line.at[rq_home, tiles].set(
+            jnp.where(l2_miss_go, s_line, mail.req_line[rq_home, tiles])),
+        req_time=mail.req_time.at[rq_home, tiles].set(
+            jnp.where(l2_miss_go, rq_arrival, mail.req_time[rq_home, tiles])),
+    )
+
+    # --- requester bookkeeping for this iteration's starts ----------------
+    slot_done_now = ibuf_hit | l1_hit_now | l2_hit_now
+    slot_done_ps = jnp.where(
+        ibuf_hit, clock_ps + ccycles(1),
+        jnp.where(l1_hit_now, l1_hit_done_ps, l2_hit_done_ps))
+
+    req_state = ms.req.replace(
+        phase=jnp.where(l2_miss_go, PHASE_WAIT_REPLY, ms.req.phase),
+        line=jnp.where(l2_miss_go, s_line, ms.req.line),
+        is_write=jnp.where(l2_miss_go, s_write, ms.req.is_write),
+        component=jnp.where(
+            l2_miss_go, jnp.where(s_comp_l1i, MOD_L1I, MOD_L1D),
+            ms.req.component).astype(jnp.uint8),
+        clock_ps=jnp.where(l2_miss_go, req_send_ps, ms.req.clock_ps),
+        acc_ps=ms.req.acc_ps
+        + jnp.where(slot_done_now, slot_done_ps - clock_ps, 0),
+        instr_buf=new_instr_buf,
+        # slot advances on completion; on miss it stays (the reply path
+        # advances it); skipped-over absent slots jump to the live one
+        slot=jnp.where(slot_done_now, slot + 1,
+                       jnp.where(starting, slot, ms.req.slot)),
+    )
+
+    counters = ms.counters.replace(
+        l1i_hits=ms.counters.l1i_hits
+        + ((l1_hit_now | ibuf_hit) & s_comp_l1i & enabled).astype(I64),
+        l1i_misses=ms.counters.l1i_misses
+        + (l1_miss & s_comp_l1i & enabled).astype(I64),
+        l1d_read_hits=ms.counters.l1d_read_hits
+        + (l1_hit_now & ~s_comp_l1i & ~s_write & enabled).astype(I64),
+        l1d_read_misses=ms.counters.l1d_read_misses
+        + (l1_miss & ~s_comp_l1i & ~s_write & enabled).astype(I64),
+        l1d_write_hits=ms.counters.l1d_write_hits
+        + (l1_hit_now & ~s_comp_l1i & s_write & enabled).astype(I64),
+        l1d_write_misses=ms.counters.l1d_write_misses
+        + (l1_miss & ~s_comp_l1i & s_write & enabled).astype(I64),
+        l2_hits=ms.counters.l2_hits + (l2_hit_now & enabled).astype(I64),
+        l2_misses=ms.counters.l2_misses + (l2_miss_go & enabled).astype(I64),
+    )
+    progress = progress + jnp.sum(slot_done_now | l2_miss_go, dtype=jnp.int32)
+
+    ms = ms.replace(
+        l1i=l1i_upd, l1d=l1d_upd, l2=l2_upd, l2_cloc=l2_cloc,
+        mail=mail, req=req_state, counters=counters,
+    )
+
+    # functional effect of slots completed via L1/L2 (loads/stores)
+    ms = _apply_functional(mp, ms, rec, slot, s_addr, s_write,
+                           slot_done_now & ~s_is_icache)
+
+    # ======================================================================
+    # (2) sharers consume one FWD per iteration
+    # ======================================================================
+    ms, progress = _sharer_step(mp, ms, fmhz, enabled, progress,
+                                sync_l2_net, sync_l1d_l2)
+
+    # ======================================================================
+    # (3) homes consume one EVICT per iteration
+    # ======================================================================
+    ms, progress = _home_evictions(mp, ms, dir_access_ps, enabled, progress)
+
+    # ======================================================================
+    # (4) homes consume ACKs, finish transactions
+    # ======================================================================
+    ms, progress = _home_acks_and_finish(mp, ms, dram_lat_ps, dir_access_ps,
+                                         enabled, progress)
+
+    # ======================================================================
+    # (5) homes start transactions (pop request / resume saved)
+    # ======================================================================
+    ms, progress = _home_starts(mp, ms, dram_lat_ps, dir_access_ps,
+                                sync_dir_l2, sync_dir_net, enabled, progress)
+
+    # ======================================================================
+    # (6) requesters consume replies (fill L2+L1, complete slot)
+    # ======================================================================
+    ms, progress = _requester_fill(mp, ms, rec, clock_ps, fmhz, enabled,
+                                   progress, sync_l2_net)
+
+    # ---- completion signal ----------------------------------------------
+    final_slot = next_present(ms.req.slot)
+    mem_complete = (ms.req.phase == PHASE_IDLE) & (final_slot >= 3)
+    return MemStepOut(
+        ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
+        progress=progress,
+    )
+
+
+# --------------------------------------------------------------------------
+# functional memory
+
+
+def _apply_functional(mp, ms: MemState, rec: RecView, slot, s_addr, s_write,
+                      mask):
+    if mp.func_mem_words <= 0:
+        return ms
+    word = ((s_addr.astype(jnp.uint32) >> 2) % mp.func_mem_words).astype(
+        jnp.int32)
+    value = jnp.where(slot == 1, rec.aux0, rec.aux1).astype(jnp.uint32)
+    wr = mask & s_write
+    # masked-off lanes write a dedicated scratch slot (the last word) so a
+    # dummy write can never clobber a live one
+    tgt = jnp.where(wr, word, mp.func_mem_words)
+    fm = ms.func_mem.at[tgt].set(jnp.where(wr, value, 0))
+    check = mask & ~s_write & (slot == 1) & ((rec.flags & FLAG_CHECK) != 0)
+    loaded = fm[word]
+    errs = jnp.sum(check & (loaded != rec.aux0.astype(jnp.uint32)),
+                   dtype=I64)
+    return ms.replace(func_mem=fm, func_errors=ms.func_errors + errs)
+
+
+# --------------------------------------------------------------------------
+# sharer-side FWD service (`l2_cache_cntlr.cc:295-503`)
+
+
+def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
+                 sync_l2_net, sync_l1d_l2):
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    mail = ms.mail
+
+    def ccyc(n):
+        ps = cycles_to_ps(jnp.asarray(n, I64), fmhz)
+        return jnp.where(enabled, ps, 0)
+
+    h, found = _row_earliest(mail.fwd_type, mail.fwd_time)
+    ftype = mail.fwd_type[tiles, h]
+    fline = mail.fwd_line[tiles, h]
+    ftime = mail.fwd_time[tiles, h]
+
+    l2_hit, l2_way, l2_state = ca.lookup(ms.l2, fline)
+    serve = found & l2_hit & (l2_state != INVALID)
+    silent = found & ~serve  # already evicted; eviction msg satisfies home
+
+    # time: network sync + L2 access + L1 tag access + domain syncs
+    # (`processInvReqFromDramDirectory` / Flush / Wb)
+    is_inv = ftype == MSG_INV_REQ
+    l2_cost = jnp.where(is_inv, ccyc(mp.l2.tags_cycles),
+                        ccyc(mp.l2.data_and_tags_cycles))
+    l1_cost = ccyc(mp.l1d.tags_cycles)
+    done_ps = ftime + sync_l2_net + l2_cost + l1_cost + 2 * sync_l1d_l2
+
+    # invalidate / downgrade L1 (whichever L1 holds it, by cached-loc)
+    sets = (fline % mp.l2.num_sets).astype(jnp.int32)
+    cloc = ms.l2_cloc[tiles, sets, l2_way]
+    inv_l1 = serve & (ftype != MSG_WB_REQ)
+    wb_l1 = serve & (ftype == MSG_WB_REQ)
+    l1i = ca.invalidate(ms.l1i, fline, inv_l1 & (cloc == MOD_L1I))
+    l1d = ca.invalidate(ms.l1d, fline, inv_l1 & (cloc == MOD_L1D))
+    l1i_hit, l1i_way, _ = ca.lookup(l1i, fline)
+    l1d_hit, l1d_way, _ = ca.lookup(l1d, fline)
+    l1i = ca.set_state(l1i, fline, l1i_way, SHARED,
+                       wb_l1 & (cloc == MOD_L1I) & l1i_hit)
+    l1d = ca.set_state(l1d, fline, l1d_way, SHARED,
+                       wb_l1 & (cloc == MOD_L1D) & l1d_hit)
+
+    # L2: invalidate (INV/FLUSH) or downgrade to SHARED (WB)
+    l2 = ca.invalidate(ms.l2, fline, inv_l1)
+    l2 = ca.set_state(l2, fline, l2_way, SHARED, wb_l1)
+    l2_cloc = ms.l2_cloc.at[tiles, sets, l2_way].set(
+        jnp.where(inv_l1, 0, ms.l2_cloc[tiles, sets, l2_way]))
+
+    # ack message back to the home
+    ack = jnp.where(
+        ftype == MSG_INV_REQ, MSG_INV_REP,
+        jnp.where(ftype == MSG_FLUSH_REQ, MSG_FLUSH_REP, MSG_WB_REP),
+    ).astype(jnp.uint8)
+    ack_bits_rep = mp.rep_bits  # FLUSH/WB carry the line
+    ack_bits = jnp.where(is_inv, mp.req_bits, ack_bits_rep)
+    # serialization differs per type; compute both and select
+    lat_req = mem_net_latency_ps(mp, tiles, h, mp.req_bits, enabled)
+    lat_rep = mem_net_latency_ps(mp, tiles, h, mp.rep_bits, enabled)
+    ack_lat = jnp.where(is_inv, lat_req, lat_rep)
+    del ack_bits
+    wh = jnp.where(serve, h, 0)
+    mail = mail.replace(
+        ack_type=mail.ack_type.at[wh, tiles].set(
+            jnp.where(serve, ack, mail.ack_type[wh, tiles])),
+        ack_line=mail.ack_line.at[wh, tiles].set(
+            jnp.where(serve, fline, mail.ack_line[wh, tiles])),
+        ack_time=mail.ack_time.at[wh, tiles].set(
+            jnp.where(serve, done_ps + ack_lat, mail.ack_time[wh, tiles])),
+    )
+    # consume the fwd cell
+    ch = jnp.where(found, h, 0)
+    mail = mail.replace(
+        fwd_type=mail.fwd_type.at[tiles, ch].set(
+            jnp.where(found, MSG_NONE, mail.fwd_type[tiles, ch])),
+    )
+    counters = ms.counters.replace(
+        invalidations=ms.counters.invalidations
+        + (serve & is_inv & enabled).astype(I64),
+    )
+    progress = progress + jnp.sum(found, dtype=jnp.int32)
+    return ms.replace(l1i=l1i, l1d=l1d, l2=l2, l2_cloc=l2_cloc, mail=mail,
+                      counters=counters), progress
+
+
+# --------------------------------------------------------------------------
+# home-side: evictions (`processInvRepFromL2Cache` / `processFlushRep...`
+# "just an eviction" branches)
+
+
+def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress):
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    mail = ms.mail
+
+    src, found = _row_earliest(mail.evict_type, mail.evict_time)
+    etype = mail.evict_type[tiles, src]
+    eline = mail.evict_line[tiles, src]
+    etime = mail.evict_time[tiles, src]
+
+    d = ms.directory
+    sets, dfound, way = _dir_lookup(mp, d, eline)
+    apply = found & dfound
+    _, dstate, owner, sharers, nsh = _dir_gather(d, sets, way)
+
+    was_sharer = test_bit(sharers, src)
+    new_sharers = clear_bit(sharers, src, apply)
+    new_nsh = nsh - (apply & was_sharer).astype(jnp.int32)
+    is_flush = etype == MSG_FLUSH_REP
+    new_owner = jnp.where(apply & is_flush, -1, owner)
+    new_dstate = jnp.where(
+        apply & (is_flush | (new_nsh == 0)), DIR_UNCACHED, dstate
+    ).astype(jnp.uint8)
+    d = _dir_update(d, sets, way, apply, dstate=new_dstate, owner=new_owner,
+                    sharers=new_sharers, nsharers=new_nsh)
+
+    # active same-line transaction: treat the eviction as the ack
+    txn = ms.txn
+    txn_match = txn.active & found & (txn.line == eline)
+    txn = txn.replace(
+        pending=clear_bit(txn.pending, src, txn_match),
+        time_ps=jnp.where(txn_match,
+                          jnp.maximum(txn.time_ps, etime + dir_access_ps),
+                          txn.time_ps),
+        data_cached=txn.data_cached | (txn_match & is_flush),
+    )
+
+    csrc = jnp.where(found, src, 0)
+    mail = mail.replace(
+        evict_type=mail.evict_type.at[tiles, csrc].set(
+            jnp.where(found, MSG_NONE, mail.evict_type[tiles, csrc])),
+    )
+    counters = ms.counters.replace(
+        evictions=ms.counters.evictions + (found & enabled).astype(I64),
+        dram_writes=ms.counters.dram_writes
+        + (found & is_flush & enabled).astype(I64),
+    )
+    progress = progress + jnp.sum(found, dtype=jnp.int32)
+    return ms.replace(directory=d, txn=txn, mail=mail,
+                      counters=counters), progress
+
+
+# --------------------------------------------------------------------------
+# home-side: ack consumption + transaction finish
+
+
+def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
+                          enabled, progress):
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    mail = ms.mail
+    txn = ms.txn
+
+    # consume ALL matching acks per home row at once (row-wise reduction;
+    # each ack clears a distinct pending bit, times are max-reduced)
+    match = (mail.ack_type != MSG_NONE) & txn.active[:, None] & (
+        mail.ack_line == txn.line[:, None])
+    any_match = match.any(axis=1)
+    max_ack = jnp.where(match, mail.ack_time, 0).max(axis=1)
+    got_data = (match & ((mail.ack_type == MSG_FLUSH_REP)
+                         | (mail.ack_type == MSG_WB_REP))).any(axis=1)
+    wb_any = (match & (mail.ack_type == MSG_WB_REP)).any(axis=1)
+
+    # clear pending bits for acked sharers: pack match row back to words
+    SW = mp.sharer_words
+    pad = SW * 32 - T
+    mpad = jnp.pad(match, ((0, 0), (0, pad)))
+    acked_words = (
+        mpad.reshape(T, SW, 32).astype(U32)
+        << jnp.arange(32, dtype=U32)[None, None, :]
+    ).sum(axis=2, dtype=U32)
+    txn = txn.replace(
+        pending=txn.pending & ~acked_words,
+        time_ps=jnp.where(any_match,
+                          jnp.maximum(txn.time_ps, max_ack + dir_access_ps),
+                          txn.time_ps),
+        data_cached=txn.data_cached | got_data,
+    )
+    # drop every ack cell (matched = consumed; stale = dropped)
+    mail = mail.replace(ack_type=jnp.where(
+        mail.ack_type != MSG_NONE, MSG_NONE, mail.ack_type))
+
+    # ---- finish transactions whose pending set is empty ------------------
+    no_pending = (txn.pending == 0).all(axis=1)
+    finish = txn.active & no_pending
+    is_ex = txn.mtype == MSG_EX_REQ
+    is_sh = txn.mtype == MSG_SH_REQ
+    is_nullify = txn.mtype == MSG_NULLIFY
+
+    d = ms.directory
+    sets, dfound, way = _dir_lookup(mp, d, txn.line)
+    r = txn.requester
+    rbit_words = jnp.zeros((T, mp.sharer_words), U32)
+    rbit_words = set_bit(rbit_words, r, finish)
+
+    # EX finish: M, owner=r, sharers={r} (`processExReqFromL2Cache` UNCACHED
+    # branch after invalidations)
+    exf = finish & is_ex & dfound
+    d = _dir_update(d, sets, way, exf, dstate=DIR_MODIFIED, owner=r,
+                    sharers=rbit_words, nsharers=jnp.ones(T, jnp.int32))
+    # SH finish after WB: SHARED, add r (`processWbRepFromL2Cache` +
+    # `processShReqFromL2Cache` SHARED branch)
+    _, _, _, cur_sharers, cur_nsh = _dir_gather(d, sets, way)
+    shf = finish & is_sh & dfound
+    had = test_bit(cur_sharers, r)
+    d = _dir_update(
+        d, sets, way, shf, dstate=DIR_SHARED,
+        owner=jnp.full(T, -1, jnp.int32),
+        sharers=set_bit(cur_sharers, r, shf),
+        nsharers=cur_nsh + (~had).astype(jnp.int32))
+    # NULLIFY finish: the entry was already replaced at allocation; nothing
+    # directory-side remains (`processNullifyReq` UNCACHED branch)
+
+    # reply to requester (dram read only if the data did not come back
+    # cached via FLUSH/WB — `retrieveDataAndSendToL2Cache`)
+    need_dram = finish & ~txn.data_cached & ~is_nullify
+    rep_ready_ps = txn.time_ps + jnp.where(need_dram, dram_lat_ps, 0)
+    rep_lat = mem_net_latency_ps(mp, tiles, r, mp.rep_bits, enabled)
+    rep_msg = jnp.where(is_ex, MSG_EX_REP, MSG_SH_REP).astype(jnp.uint8)
+    rep_go = finish & ~is_nullify
+    # add-delta scatter: target cells are zero (the requester resets both
+    # fields on consumption), so masked-off dummy writes to cell 0 add 0
+    # and can never clobber a live reply
+    wr = jnp.where(rep_go, r, 0)
+    mail = mail.replace(
+        rep_type=mail.rep_type.at[wr].add(
+            jnp.where(rep_go, rep_msg, 0).astype(jnp.uint8)),
+        rep_time=mail.rep_time.at[wr].add(
+            jnp.where(rep_go, rep_ready_ps + rep_lat, 0)),
+    )
+    # clear our FWD column so stale multicasts cannot leak into the next
+    # transaction (see module docstring)
+    mail = mail.replace(
+        fwd_type=jnp.where(finish[None, :], MSG_NONE, mail.fwd_type))
+
+    txn = txn.replace(
+        active=txn.active & ~finish,
+        last_line=jnp.where(finish, txn.line, txn.last_line),
+        last_done_ps=jnp.where(finish, rep_ready_ps, txn.last_done_ps),
+    )
+    counters = ms.counters.replace(
+        dram_reads=ms.counters.dram_reads + (need_dram & enabled).astype(I64),
+        dram_writes=ms.counters.dram_writes
+        + (wb_any & enabled).astype(I64),
+        dram_total_lat_ps=ms.counters.dram_total_lat_ps
+        + jnp.where(need_dram & enabled, dram_lat_ps, 0),
+    )
+    progress = progress + jnp.sum(finish, dtype=jnp.int32) + jnp.sum(
+        any_match, dtype=jnp.int32)
+    return ms.replace(directory=d, txn=txn, mail=mail,
+                      counters=counters), progress
+
+
+# --------------------------------------------------------------------------
+# home-side: transaction start (pop request or resume saved original)
+
+
+def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
+                 sync_dir_l2, sync_dir_net, enabled, progress):
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    mail = ms.mail
+    txn = ms.txn
+
+    can_start = ~txn.active
+    # source 1: saved original request (after a NULLIFY completed)
+    use_saved = can_start & txn.saved_valid
+    # source 2: earliest pending request cell
+    r_col, r_found = _row_earliest(mail.req_type, mail.req_time)
+    use_pop = can_start & ~use_saved & r_found
+
+    starting = use_saved | use_pop
+    rtype = jnp.where(use_saved, txn.saved_type,
+                      mail.req_type[tiles, r_col]).astype(jnp.uint8)
+    rline = jnp.where(use_saved, txn.saved_line, mail.req_line[tiles, r_col])
+    rreq = jnp.where(use_saved, txn.saved_requester, r_col)
+    rtime = jnp.where(use_saved, txn.saved_time_ps,
+                      mail.req_time[tiles, r_col])
+    # message sync at the directory (`handleMsgFromL2Cache` entry)
+    rtime = rtime + jnp.where(rreq == tiles, sync_dir_l2, sync_dir_net)
+    # same-address serialization floor (`processNextReqFromL2Cache` time
+    # update for queued same-address requests)
+    rtime = jnp.where(starting & (rline == txn.last_line),
+                      jnp.maximum(rtime, txn.last_done_ps), rtime)
+
+    # consume the popped cell
+    cr = jnp.where(use_pop, r_col, 0)
+    mail = mail.replace(
+        req_type=mail.req_type.at[tiles, cr].set(
+            jnp.where(use_pop, MSG_NONE, mail.req_type[tiles, cr])))
+    txn = txn.replace(saved_valid=txn.saved_valid & ~use_saved)
+
+    # ---- directory entry lookup / allocation -----------------------------
+    d = ms.directory
+    sets, dfound, way = _dir_lookup(mp, d, rline)
+    # free way if no match (tags == -1)
+    tag_row = d.tags[tiles, sets]                          # [T, DW]
+    free_ways = tag_row == -1
+    any_free = free_ways.any(axis=1)
+    free_way = jnp.argmax(free_ways, axis=1).astype(jnp.int32)
+    # victim: min sharers (`processDirectoryEntryAllocationReq`)
+    nsh_row = d.nsharers[tiles, sets]
+    victim_way = jnp.argmin(nsh_row, axis=1).astype(jnp.int32)
+    alloc_way = jnp.where(dfound, way, jnp.where(any_free, free_way,
+                                                 victim_way)).astype(jnp.int32)
+    need_nullify = starting & ~dfound & ~any_free
+
+    # victim entry contents (for the NULLIFY transaction)
+    v_line, v_dstate, v_owner, v_sharers, v_nsh = _dir_gather(d, sets, alloc_way)
+
+    # install the new entry (always, even when a NULLIFY must run first —
+    # `replaceDirectoryEntry` swaps immediately)
+    is_new = starting & ~dfound
+    d = _dir_update(
+        d, sets, alloc_way, is_new,
+        tags=rline, dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
+        owner=jnp.full(T, -1, jnp.int32),
+        sharers=jnp.zeros((T, mp.sharer_words), U32),
+        nsharers=jnp.zeros(T, jnp.int32))
+
+    # ---- NULLIFY path ----------------------------------------------------
+    # save the original request; run the nullify on the victim line
+    nullify_live = need_nullify & (v_dstate != DIR_UNCACHED)
+    txn = txn.replace(
+        saved_valid=jnp.where(nullify_live, True, txn.saved_valid),
+        saved_type=jnp.where(nullify_live, rtype, txn.saved_type),
+        saved_line=jnp.where(nullify_live, rline, txn.saved_line),
+        saved_requester=jnp.where(nullify_live, rreq, txn.saved_requester),
+        saved_time_ps=jnp.where(nullify_live, rtime, txn.saved_time_ps),
+    )
+
+    # ---- state branch for the (non-nullify) request ----------------------
+    run_req = starting & ~nullify_live
+    dstate = jnp.where(dfound, v_dstate, DIR_UNCACHED).astype(jnp.uint8)
+    # entry state for nullify runs is the *victim's*
+    eff_line = jnp.where(nullify_live, v_line, rline)
+    eff_type = jnp.where(nullify_live, MSG_NULLIFY, rtype).astype(jnp.uint8)
+    eff_dstate = jnp.where(nullify_live, v_dstate, dstate).astype(jnp.uint8)
+    eff_time = rtime + dir_access_ps
+
+    is_ex = eff_type == MSG_EX_REQ
+    is_sh = eff_type == MSG_SH_REQ
+
+    uncached = eff_dstate == DIR_UNCACHED
+    shared = eff_dstate == DIR_SHARED
+    modified = eff_dstate == DIR_MODIFIED
+
+    # (a) immediate finishes: UNCACHED requests, SHARED+SH
+    imm_ex = run_req & is_ex & uncached
+    imm_sh = run_req & is_sh & (uncached | shared)
+    imm = imm_ex | imm_sh
+    rbit = set_bit(jnp.zeros((T, mp.sharer_words), U32), rreq, imm)
+    cur_sh = jnp.where(imm_sh[:, None] & shared[:, None], v_sharers,
+                       jnp.zeros_like(v_sharers))
+    had = test_bit(cur_sh, rreq)
+    d = _dir_update(
+        d, sets, alloc_way, imm,
+        dstate=jnp.where(imm_ex, DIR_MODIFIED, DIR_SHARED).astype(jnp.uint8),
+        owner=jnp.where(imm_ex, rreq, -1),
+        sharers=cur_sh | rbit,
+        nsharers=jnp.where(imm_ex, 1,
+                           popcount(cur_sh) + (~had).astype(jnp.int32)))
+    rep_ready = eff_time + dram_lat_ps  # UNCACHED/SHARED reads hit DRAM
+    rep_lat = mem_net_latency_ps(mp, tiles, rreq, mp.rep_bits, enabled)
+    # add-delta scatter (cells zero before a live write; see finish path)
+    wr = jnp.where(imm, rreq, 0)
+    mail = mail.replace(
+        rep_type=mail.rep_type.at[wr].add(
+            jnp.where(imm, jnp.where(imm_ex, MSG_EX_REP, MSG_SH_REP), 0
+                      ).astype(jnp.uint8)),
+        rep_time=mail.rep_time.at[wr].add(
+            jnp.where(imm, rep_ready + rep_lat, 0)),
+    )
+    txn = txn.replace(
+        last_line=jnp.where(imm, eff_line, txn.last_line),
+        last_done_ps=jnp.where(imm, rep_ready, txn.last_done_ps),
+    )
+
+    # (b) fan-out transactions: EX/NULLIFY on SHARED (INV multicast),
+    #     anything on MODIFIED (FLUSH/WB to owner)
+    fan_inv = (run_req & is_ex & shared) | (nullify_live & shared)
+    fan_owner = ((run_req | nullify_live) & modified)
+    fan = fan_inv | fan_owner
+    owner_bits = set_bit(jnp.zeros((T, mp.sharer_words), U32),
+                         jnp.clip(v_owner, 0, T - 1), fan_owner)
+    pending = jnp.where(fan_inv[:, None], v_sharers, owner_bits)
+    fwd_msg = jnp.where(
+        fan_inv, MSG_INV_REQ,
+        jnp.where(is_sh, MSG_WB_REQ, MSG_FLUSH_REQ)).astype(jnp.uint8)
+
+    txn = txn.replace(
+        active=txn.active | fan,
+        mtype=jnp.where(fan, eff_type, txn.mtype).astype(jnp.uint8),
+        line=jnp.where(fan, eff_line, txn.line),
+        requester=jnp.where(fan, rreq, txn.requester),
+        time_ps=jnp.where(fan, eff_time, txn.time_ps),
+        pending=jnp.where(fan[:, None], pending, txn.pending),
+        data_cached=jnp.where(fan, False, txn.data_cached),
+    )
+
+    # dense multicast into the FWD matrix: [sharer, home]
+    targets = unpack_sharers(pending, T)          # [home, sharer]
+    send = fan[:, None] & targets                 # [home, sharer]
+    send_t = send.T                               # [sharer, home]
+    fwd_lat = mem_net_latency_ps(
+        mp, tiles[:, None], tiles[None, :], mp.req_bits, enabled
+    )  # [src=home? careful] — computed as [row, col] = (home, sharer)
+    arrive = eff_time[:, None] + fwd_lat          # [home, sharer]
+    mail = mail.replace(
+        fwd_type=jnp.where(send_t, fwd_msg[None, :], mail.fwd_type),
+        fwd_line=jnp.where(send_t, eff_line[None, :], mail.fwd_line),
+        fwd_time=jnp.where(send_t, arrive.T, mail.fwd_time),
+    )
+
+    counters = ms.counters.replace(
+        dir_accesses=ms.counters.dir_accesses
+        + (starting & enabled).astype(I64),
+        dram_reads=ms.counters.dram_reads + (imm & enabled).astype(I64),
+        dram_total_lat_ps=ms.counters.dram_total_lat_ps
+        + jnp.where(imm & enabled, dram_lat_ps, 0),
+    )
+    progress = progress + jnp.sum(starting, dtype=jnp.int32)
+    return ms.replace(directory=d, txn=txn, mail=mail,
+                      counters=counters), progress
+
+
+# --------------------------------------------------------------------------
+# requester-side reply fill (`handleMsgFromDramDirectory` EX_REP/SH_REP +
+# `insertCacheLineInHierarchy`)
+
+
+def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
+                    progress, sync_l2_net):
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    mail = ms.mail
+
+    def ccyc(n):
+        ps = cycles_to_ps(jnp.asarray(n, I64), fmhz)
+        return jnp.where(enabled, ps, 0)
+
+    have_rep = (ms.req.phase == PHASE_WAIT_REPLY) & (mail.rep_type != MSG_NONE)
+    line = ms.req.line
+    comp_l1i = ms.req.component == MOD_L1I
+
+    # L2 victim for the fill; a valid victim emits an eviction message that
+    # needs its (home, us) EVICT cell free — else stall this iteration
+    way, v_valid, v_line, v_state = ca.pick_victim(ms.l2, line)
+    v_home_all = jnp.asarray(mp.mc_tiles, jnp.int32)[
+        (v_line % len(mp.mc_tiles)).astype(jnp.int32)]
+    need_evict = have_rep & v_valid
+    evict_busy = mail.evict_type[v_home_all, tiles] != MSG_NONE
+    fill = have_rep & ~(need_evict & evict_busy)
+    evict_go = need_evict & fill
+
+    new_state = jnp.where(mail.rep_type == MSG_EX_REP, MODIFIED, SHARED)
+    l2 = ca.insert_at(ms.l2, line, way, new_state, fill)
+    sets = (line % mp.l2.num_sets).astype(jnp.int32)
+    l2_cloc = ms.l2_cloc.at[tiles, sets, way].set(
+        jnp.where(fill,
+                  jnp.where(comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8),
+                  ms.l2_cloc[tiles, sets, way]))
+
+    # eviction message (FLUSH_REP if dirty, INV_REP if shared —
+    # `insertCacheLine`, `l2_cache_cntlr.cc:75-116`)
+    e_msg = jnp.where(v_state == MODIFIED, MSG_FLUSH_REP,
+                      MSG_INV_REP).astype(jnp.uint8)
+    e_bits_lat = jnp.where(
+        v_state == MODIFIED,
+        mem_net_latency_ps(mp, tiles, v_home_all, mp.rep_bits, enabled),
+        mem_net_latency_ps(mp, tiles, v_home_all, mp.req_bits, enabled))
+    # fill timing: reply arrival + net sync + L2 insert (data+tags), then
+    # second L1 pass: L2 sync + L1 data+tags (`processMemOpFromCore` loop)
+    fill_l2_ps = mail.rep_time + sync_l2_net + ccyc(mp.l2.data_and_tags_cycles)
+    l1_dat = jnp.where(comp_l1i, ccyc(mp.l1i.data_and_tags_cycles),
+                       ccyc(mp.l1d.data_and_tags_cycles))
+    done_ps = fill_l2_ps + l1_dat
+
+    wh = jnp.where(evict_go, v_home_all, 0)
+    mail = mail.replace(
+        evict_type=mail.evict_type.at[wh, tiles].set(
+            jnp.where(evict_go, e_msg, mail.evict_type[wh, tiles])),
+        evict_line=mail.evict_line.at[wh, tiles].set(
+            jnp.where(evict_go, v_line, mail.evict_line[wh, tiles])),
+        evict_time=mail.evict_time.at[wh, tiles].set(
+            jnp.where(evict_go, fill_l2_ps + e_bits_lat,
+                      mail.evict_time[wh, tiles])),
+        # reset BOTH fields so home-side add-delta reply writes stay exact
+        rep_type=jnp.where(fill, MSG_NONE, mail.rep_type),
+        rep_time=jnp.where(fill, 0, mail.rep_time),
+    )
+
+    # L1 fill
+    l1_state = new_state  # L1 gets the L2 state (`insertCacheLineInL1`)
+    l1i_way, l1i_vv, l1i_vline, _ = ca.pick_victim(ms.l1i, line)
+    l1d_way, l1d_vv, l1d_vline, _ = ca.pick_victim(ms.l1d, line)
+    l1i = ca.insert_at(ms.l1i, line, l1i_way, l1_state, fill & comp_l1i)
+    l1d = ca.insert_at(ms.l1d, line, l1d_way, l1_state, fill & ~comp_l1i)
+    # clear cached-loc of L1 victims in L2
+    l1_ev = (fill & comp_l1i & l1i_vv) | (fill & ~comp_l1i & l1d_vv)
+    l1_ev_line = jnp.where(comp_l1i, l1i_vline, l1d_vline)
+    ev_hit, ev_way, _ = ca.lookup(l2, l1_ev_line)
+    ev_sets = (l1_ev_line % mp.l2.num_sets).astype(jnp.int32)
+    l2_cloc = l2_cloc.at[tiles, ev_sets, ev_way].set(
+        jnp.where(l1_ev & ev_hit, 0, l2_cloc[tiles, ev_sets, ev_way]))
+
+    req = ms.req.replace(
+        phase=jnp.where(fill, PHASE_IDLE, ms.req.phase),
+        slot=jnp.where(fill, ms.req.slot + 1, ms.req.slot),
+        acc_ps=ms.req.acc_ps + jnp.where(fill, done_ps - clock_ps, 0),
+    )
+    ms = ms.replace(l1i=l1i, l1d=l1d, l2=l2, l2_cloc=l2_cloc, mail=mail,
+                    req=req)
+    # functional effect of the completed slot
+    s_addr = jnp.where(ms.req.slot - 1 == 1, rec.addr0.astype(jnp.int32),
+                       rec.addr1.astype(jnp.int32))
+    ms = _apply_functional(mp, ms, rec, ms.req.slot - 1, s_addr,
+                           ms.req.is_write, fill)
+    counters = ms.counters.replace(
+        evictions=ms.counters.evictions + (evict_go & enabled).astype(I64))
+    progress = progress + jnp.sum(fill, dtype=jnp.int32)
+    return ms.replace(counters=counters), progress

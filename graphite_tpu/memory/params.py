@@ -1,0 +1,278 @@
+"""Compile-time memory-subsystem parameters resolved from the config.
+
+Mirrors the constructor plumbing in
+`pr_l1_pr_l2_dram_directory_msi/memory_manager.cc:50-170`: cache geometries
+from `[l1_icache/<type>]`/`[l1_dcache/<type>]`/`[l2_cache/<type>]`, the
+directory from `[dram_directory]` (auto-sizing per
+`cache/directory_cache.cc:244-330`), DRAM from `[dram]`, memory-controller
+placement per `memory_manager.cc:214-278`, and the home lookup
+(`address_home_lookup.cc`, ahl_param = log2(cache_line_size)).
+
+Everything here is hashable (tuples only) so it can ride inside the jitted
+step's static EngineParams.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from graphite_tpu.config.simconfig import SimConfig
+
+# ShmemMsg modeled lengths (`memory_subsystem/shmem_msg.h:8`,
+# `pr_l1_pr_l2_dram_directory_msi/shmem_msg.h:81`, `shmem_msg.cc:100-125`).
+NUM_MSG_TYPE_BITS = 4
+NUM_PHYSICAL_ADDRESS_BITS = 48
+# DRAM timing is computed in cycles at a fixed 1 GHz (DRAM_FREQUENCY,
+# `dram_perf_model.cc:80-115`), i.e. 1 cycle = 1 ns.
+DRAM_FREQ_MHZ = 1000
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheLevelParams:
+    """One cache level (`carbon_sim.cfg:207-230` [l1_icache/T1] etc.)."""
+
+    num_sets: int
+    num_ways: int
+    data_access_cycles: int
+    tags_access_cycles: int
+    sequential: bool          # perf_model_type (parallel|sequential)
+    track_miss_types: bool = False
+
+    # CachePerfModel::getLatency (`cache_perf_model_{parallel,sequential}.h`)
+    @property
+    def tags_cycles(self) -> int:
+        return self.tags_access_cycles
+
+    @property
+    def data_and_tags_cycles(self) -> int:
+        if self.sequential:
+            return self.data_access_cycles + self.tags_access_cycles
+        return self.data_access_cycles
+
+    # Defaults per level = the T1 configuration (`carbon_sim.cfg:207-230`)
+    _DEFAULTS = {
+        "l1_icache": dict(size_kb=16, assoc=4, data=1, tags=1),
+        "l1_dcache": dict(size_kb=32, assoc=4, data=1, tags=1),
+        "l2_cache": dict(size_kb=512, assoc=8, data=8, tags=3),
+    }
+
+    @classmethod
+    def from_config(cls, cfg, section: str, line_size: int) -> "CacheLevelParams":
+        level = section.split("/")[0]
+        d = cls._DEFAULTS.get(level, cls._DEFAULTS["l1_dcache"])
+        size_kb = cfg.get_int(f"{section}/cache_size", d["size_kb"])
+        assoc = cfg.get_int(f"{section}/associativity", d["assoc"])
+        num_lines = size_kb * 1024 // line_size
+        num_sets = max(1, num_lines // assoc)
+        if num_sets * assoc != num_lines:
+            raise ValueError(
+                f"[{section}] cache_size/associativity does not tile: "
+                f"{num_lines} lines / {assoc} ways"
+            )
+        return cls(
+            num_sets=num_sets,
+            num_ways=assoc,
+            data_access_cycles=cfg.get_int(f"{section}/data_access_time",
+                                           d["data"]),
+            tags_access_cycles=cfg.get_int(f"{section}/tags_access_time",
+                                           d["tags"]),
+            sequential=cfg.get_string(f"{section}/perf_model_type", "parallel")
+            == "sequential",
+            track_miss_types=cfg.get_bool(f"{section}/track_miss_types", False),
+        )
+
+
+def _auto_directory_access_cycles(directory_size_bytes: int) -> int:
+    """`directory_cache.cc:293-330` size→cycles staircase."""
+    kb = math.ceil(directory_size_bytes / 1024)
+    for limit, cycles in ((16, 1), (32, 2), (64, 4), (128, 6), (256, 8),
+                          (512, 10), (1024, 13), (2048, 16)):
+        if kb <= limit:
+            return cycles
+    return 20
+
+
+@dataclasses.dataclass(frozen=True)
+class MemParams:
+    n_tiles: int
+    line_size: int
+    line_bits: int            # log2(line_size)
+    protocol: str             # caching_protocol/type
+    l1i: CacheLevelParams
+    l1d: CacheLevelParams
+    l2: CacheLevelParams
+    # directory slice per home tile (`[dram_directory]`)
+    dir_sets: int
+    dir_ways: int
+    dir_access_cycles: int
+    dir_type: str             # full_map | ackwise | limited_* | limitless
+    max_hw_sharers: int
+    limitless_trap_cycles: int
+    # dram (`[dram]`)
+    dram_latency_ns: int
+    dram_processing_ns: int   # line_size / bandwidth + 1 (`dram_perf_model.cc:91`)
+    dram_queue_type: str      # "disabled" | basic | history_list | ...
+    mc_tiles: tuple           # tiles with memory controllers (home slices)
+    # memory-network zero-load model (hop-counter math; contention separate)
+    net_kind: str             # magic | emesh_hop_counter
+    net_freq_mhz: int
+    mesh_width: int
+    hop_latency_cycles: int
+    flit_width_bits: int
+    dir_freq_mhz: int         # DIRECTORY domain frequency
+    # DVFS domain ids per module for synchronization delay
+    # (CORE, L1_ICACHE, L1_DCACHE, L2_CACHE, DIRECTORY, NETWORK_MEMORY)
+    module_domains: tuple
+    sync_delay_cycles: int    # [dvfs] synchronization_delay
+    # engine knobs
+    icache_modeling: bool
+    func_mem_words: int       # functional memory size (0 = disabled)
+
+    @property
+    def req_bits(self) -> int:
+        return NUM_MSG_TYPE_BITS + NUM_PHYSICAL_ADDRESS_BITS
+
+    @property
+    def rep_bits(self) -> int:
+        return self.req_bits + self.line_size * 8
+
+    @property
+    def sharer_words(self) -> int:
+        return (self.n_tiles + 31) // 32
+
+    @classmethod
+    def from_config(cls, sc: SimConfig) -> "MemParams":
+        cfg = sc.cfg
+        T = sc.application_tiles
+        spec = sc.tile_spec(0)
+        for s in sc.tile_specs[:T]:
+            if (s.l1_icache_type, s.l1_dcache_type, s.l2_cache_type) != (
+                spec.l1_icache_type, spec.l1_dcache_type, spec.l2_cache_type
+            ):
+                raise NotImplementedError(
+                    "heterogeneous cache types per tile not supported yet"
+                )
+        l1i_sec = f"l1_icache/{spec.l1_icache_type}"
+        l1d_sec = f"l1_dcache/{spec.l1_dcache_type}"
+        l2_sec = f"l2_cache/{spec.l2_cache_type}"
+        line = cfg.get_int(f"{l1d_sec}/cache_line_size", 64)
+        line_bits = line.bit_length() - 1
+        if 1 << line_bits != line:
+            raise ValueError(f"cache_line_size {line} is not a power of 2")
+        l1i = CacheLevelParams.from_config(cfg, l1i_sec, line)
+        l1d = CacheLevelParams.from_config(cfg, l1d_sec, line)
+        l2 = CacheLevelParams.from_config(cfg, l2_sec, line)
+
+        # --- memory controllers (`memory_manager.cc:214-278`) -------------
+        num_mc_str = cfg.get_string("dram/num_controllers", "ALL")
+        positions = cfg.get_string("dram/controller_positions", "").strip()
+        if num_mc_str == "ALL":
+            mc_tiles = tuple(range(T))
+        else:
+            num_mc = int(num_mc_str)
+            if positions:
+                mc_tiles = tuple(
+                    int(x) for x in positions.replace('"', "").split(",") if x.strip()
+                )
+                if len(mc_tiles) != num_mc:
+                    raise ValueError(
+                        "dram/controller_positions length != num_controllers"
+                    )
+            else:
+                # Even striping (NetworkModel::computeMemoryControllerPositions
+                # default: evenly spaced over the tile array).
+                stride = T // num_mc
+                mc_tiles = tuple((i * stride) for i in range(num_mc))
+
+        # --- directory slice sizing (`directory_cache.cc:244-264`) --------
+        dir_ways = cfg.get_int("dram_directory/associativity", 16)
+        entries_str = cfg.get_string("dram_directory/total_entries", "auto")
+        n_slices = len(mc_tiles)
+        l2_size_kb = cfg.get_int(f"{l2_sec}/cache_size", 512)
+        if entries_str == "auto":
+            num_sets = math.ceil(
+                2.0 * l2_size_kb * 1024 * T / (line * dir_ways * n_slices)
+            )
+            num_sets = 1 << max(0, (num_sets - 1).bit_length())  # ceil pow2
+            total_entries = num_sets * dir_ways
+        else:
+            total_entries = int(entries_str)
+        dir_sets = max(1, total_entries // dir_ways)
+
+        dir_type = cfg.get_string("dram_directory/directory_type", "full_map")
+        # Directory entry size for the access-time staircase: reference uses
+        # max_hw_sharers-dependent sizes (`directory_cache.cc:50`); full_map
+        # entry ~ T bits + owner + state.
+        entry_bytes = max(8, sc.application_tiles // 8)
+        access_str = cfg.get_string("dram_directory/access_time", "auto")
+        if access_str == "auto":
+            dir_access = _auto_directory_access_cycles(total_entries * entry_bytes)
+        else:
+            dir_access = int(access_str)
+
+        # --- dram timing (`dram_perf_model.cc:80-115`) ---------------------
+        dram_latency_ns = int(cfg.get_float("dram/latency", 100))
+        bw = cfg.get_float("dram/per_controller_bandwidth", 5.0)  # GB/s == B/ns
+        dram_processing_ns = int(line / bw) + 1
+        dram_queue_enabled = cfg.get_bool("dram/queue_model/enabled", True)
+        dram_queue_type = (
+            cfg.get_string("dram/queue_model/type", "history_tree")
+            if dram_queue_enabled
+            else "disabled"
+        )
+
+        # --- memory network zero-load params -------------------------------
+        from graphite_tpu.models.network_user import UserNetworkParams
+
+        netp = UserNetworkParams.from_config(sc, "memory")
+
+        # --- DVFS domains for synchronization delay ------------------------
+        from graphite_tpu.models.dvfs import module_domain_index, module_freq_mhz
+
+        modules = ("CORE", "L1_ICACHE", "L1_DCACHE", "L2_CACHE", "DIRECTORY",
+                   "NETWORK_MEMORY")
+        module_domains = tuple(module_domain_index(cfg, m) for m in modules)
+        dir_freq_mhz = module_freq_mhz(cfg, "DIRECTORY")
+
+        return cls(
+            dir_freq_mhz=dir_freq_mhz,
+            n_tiles=T,
+            line_size=line,
+            line_bits=line_bits,
+            protocol=cfg.get_string(
+                "caching_protocol/type", "pr_l1_pr_l2_dram_directory_msi"
+            ),
+            l1i=l1i,
+            l1d=l1d,
+            l2=l2,
+            dir_sets=dir_sets,
+            dir_ways=dir_ways,
+            dir_access_cycles=dir_access,
+            dir_type=dir_type,
+            max_hw_sharers=cfg.get_int("dram_directory/max_hw_sharers", 64),
+            limitless_trap_cycles=cfg.get_int(
+                "limitless/software_trap_penalty", 200
+            ),
+            dram_latency_ns=dram_latency_ns,
+            dram_processing_ns=dram_processing_ns,
+            dram_queue_type=dram_queue_type,
+            mc_tiles=mc_tiles,
+            net_kind=netp.kind,
+            net_freq_mhz=netp.freq_mhz,
+            mesh_width=netp.mesh_width,
+            hop_latency_cycles=netp.hop_latency_cycles,
+            flit_width_bits=netp.flit_width_bits,
+            module_domains=module_domains,
+            sync_delay_cycles=cfg.get_int("dvfs/synchronization_delay", 2),
+            icache_modeling=cfg.get_bool("general/enable_icache_modeling", False),
+            func_mem_words=cfg.get_int("general/functional_memory_kb", 256) * 256,
+        )
+
+    def sync_cycles(self, module_a: int, module_b: int) -> int:
+        """`Cache::getSynchronizationDelay` (`cache.cc:559-567`): the [dvfs]
+        synchronization_delay when the two modules sit in different DVFS
+        domains, else 0.  Module indices follow `module_domains` order."""
+        if self.module_domains[module_a] == self.module_domains[module_b]:
+            return 0
+        return self.sync_delay_cycles

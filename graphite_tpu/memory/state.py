@@ -1,0 +1,249 @@
+"""Memory-subsystem state: caches, directory, protocol mailboxes, DRAM.
+
+Layout notes (all leading axis = tile):
+ - The per-(home, requester) REQUEST matrix has a single slot per pair
+   because each tile has exactly one outstanding L2 miss
+   (`l2_cache_cntlr.h` _outstanding_shmem_msg) — the dense analog of the
+   per-address request queue in `dram_directory_cntlr.cc:59-96`.
+ - FWD cells [sharer, home] carry INV/FLUSH/WB requests from a home's
+   active transaction; a home owns its column (one transaction at a time)
+   and clears it when the transaction ends, so stale messages cannot leak
+   into a later transaction.
+ - ACK cells [home, sharer] carry INV/FLUSH/WB replies; a sharer owns its
+   cell.
+ - EVICT cells [home, src] carry unsolicited evictions (INV_REP/FLUSH_REP
+   from `l2_cache_cntlr.cc:75-116 insertCacheLine`); the L2 fill that would
+   emit a second eviction to the same home blocks until the cell frees
+   (back-pressure; homes drain one eviction per subquantum iteration).
+ - The functional store is a single word-addressed array: the coherence
+   protocol serializes conflicting accesses, so applying values at access
+   completion preserves the observable semantics of the reference's
+   in-cache data + DRAM map (`dram_cntlr.h:37`) without moving bytes
+   through the mailboxes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from graphite_tpu.memory.cache_array import CacheArrays, make_cache
+from graphite_tpu.memory.params import MemParams
+
+I64 = jnp.int64
+
+# message types (subset of `shmem_msg.h:12-30`)
+MSG_NONE = 0
+MSG_SH_REQ = 1
+MSG_EX_REQ = 2
+MSG_INV_REQ = 3
+MSG_FLUSH_REQ = 4
+MSG_WB_REQ = 5
+MSG_INV_REP = 6
+MSG_FLUSH_REP = 7
+MSG_WB_REP = 8
+MSG_SH_REP = 9
+MSG_EX_REP = 10
+MSG_NULLIFY = 11
+
+# directory states (`directory_state.h`)
+DIR_UNCACHED = 0
+DIR_SHARED = 1
+DIR_MODIFIED = 2
+DIR_OWNED = 3    # MOSI
+
+# requester phases
+PHASE_IDLE = 0
+PHASE_WAIT_REPLY = 1
+
+# memory components (indices into MemParams.module_domains)
+MOD_CORE = 0
+MOD_L1I = 1
+MOD_L1D = 2
+MOD_L2 = 3
+MOD_DIR = 4
+MOD_NET_MEM = 5
+
+
+@struct.dataclass
+class DirectoryArrays:
+    """Per-home-slice directory cache (`cache/directory_cache.h:20-68`)."""
+
+    tags: jax.Array      # int32[T, DS, DW] line address, -1 = free
+    dstate: jax.Array    # uint8[T, DS, DW]
+    owner: jax.Array     # int32[T, DS, DW]
+    sharers: jax.Array   # uint32[T, DS, DW, SW] full-map bitvector
+    nsharers: jax.Array  # int32[T, DS, DW] cached popcount
+
+
+@struct.dataclass
+class TxnState:
+    """One active directory transaction per home tile.
+
+    The dense form of the front-of-queue request being serviced
+    (`dram_directory_cntlr.cc:44-130`); `saved_*` holds the original
+    request while a NULLIFY (directory-entry replacement,
+    `processDirectoryEntryAllocationReq`) runs first.
+    """
+
+    active: jax.Array        # bool[T]
+    mtype: jax.Array         # uint8[T] MSG_SH_REQ/MSG_EX_REQ/MSG_NULLIFY
+    line: jax.Array          # int32[T]
+    requester: jax.Array     # int32[T]
+    time_ps: jax.Array       # int64[T] running ShmemPerfModel clock
+    pending: jax.Array       # uint32[T, SW] outstanding INV/FLUSH/WB acks
+    data_cached: jax.Array   # bool[T] reply data arrived via FLUSH/WB_REP
+    saved_valid: jax.Array   # bool[T]
+    saved_type: jax.Array    # uint8[T]
+    saved_line: jax.Array    # int32[T]
+    saved_requester: jax.Array  # int32[T]
+    saved_time_ps: jax.Array    # int64[T]
+    last_line: jax.Array     # int32[T]  same-address serialization floor
+    last_done_ps: jax.Array  # int64[T]
+
+
+@struct.dataclass
+class MemMailboxes:
+    req_type: jax.Array    # uint8[T(home), T(requester)]
+    req_line: jax.Array    # int32[T, T]
+    req_time: jax.Array    # int64[T, T]
+    evict_type: jax.Array  # uint8[T(home), T(src)]
+    evict_line: jax.Array  # int32[T, T]
+    evict_time: jax.Array  # int64[T, T]
+    fwd_type: jax.Array    # uint8[T(sharer), T(home)]
+    fwd_line: jax.Array    # int32[T, T]
+    fwd_time: jax.Array    # int64[T, T]
+    ack_type: jax.Array    # uint8[T(home), T(sharer)]
+    ack_line: jax.Array    # int32[T, T]
+    ack_time: jax.Array    # int64[T, T]
+    rep_type: jax.Array    # uint8[T(requester)]
+    rep_time: jax.Array    # int64[T]
+
+
+@struct.dataclass
+class RequesterState:
+    phase: jax.Array       # int32[T] PHASE_*
+    slot: jax.Array        # int32[T] current memory slot of the record
+    acc_ps: jax.Array      # int64[T] accumulated memory latency this record
+    clock_ps: jax.Array    # int64[T] running shmem clock of current slot
+    line: jax.Array        # int32[T] line being fetched
+    is_write: jax.Array    # bool[T]
+    component: jax.Array   # uint8[T] MOD_L1I or MOD_L1D
+    instr_buf: jax.Array   # int32[T] instruction-buffer line (`core.cc:207-219`)
+
+
+@struct.dataclass
+class MemCounters:
+    l1i_hits: jax.Array        # int64[T]
+    l1i_misses: jax.Array
+    l1d_read_hits: jax.Array
+    l1d_read_misses: jax.Array
+    l1d_write_hits: jax.Array
+    l1d_write_misses: jax.Array
+    l2_hits: jax.Array
+    l2_misses: jax.Array
+    evictions: jax.Array
+    invalidations: jax.Array   # INV_REQs served with a valid line
+    dir_accesses: jax.Array
+    dram_reads: jax.Array
+    dram_writes: jax.Array
+    dram_total_lat_ps: jax.Array
+
+
+@struct.dataclass
+class MemState:
+    l1i: CacheArrays
+    l1d: CacheArrays
+    l2: CacheArrays
+    l2_cloc: jax.Array       # uint8[T, S2, W2] which L1 holds it (0/MOD_L1I/MOD_L1D)
+    directory: DirectoryArrays
+    txn: TxnState
+    mail: MemMailboxes
+    req: RequesterState
+    counters: MemCounters
+    func_mem: jax.Array      # uint32[mem_words] functional word store
+    func_errors: jax.Array   # int64[] failed FLAG_CHECK loads
+
+
+def init_mem_state(mp: MemParams) -> MemState:
+    T = mp.n_tiles
+    SW = mp.sharer_words
+    DS, DW = mp.dir_sets, mp.dir_ways
+
+    def zi64():
+        return jnp.zeros(T, I64)
+
+    directory = DirectoryArrays(
+        tags=jnp.full((T, DS, DW), -1, jnp.int32),
+        dstate=jnp.zeros((T, DS, DW), jnp.uint8),
+        owner=jnp.full((T, DS, DW), -1, jnp.int32),
+        sharers=jnp.zeros((T, DS, DW, SW), jnp.uint32),
+        nsharers=jnp.zeros((T, DS, DW), jnp.int32),
+    )
+    txn = TxnState(
+        active=jnp.zeros(T, jnp.bool_),
+        mtype=jnp.zeros(T, jnp.uint8),
+        line=jnp.zeros(T, jnp.int32),
+        requester=jnp.zeros(T, jnp.int32),
+        time_ps=zi64(),
+        pending=jnp.zeros((T, SW), jnp.uint32),
+        data_cached=jnp.zeros(T, jnp.bool_),
+        saved_valid=jnp.zeros(T, jnp.bool_),
+        saved_type=jnp.zeros(T, jnp.uint8),
+        saved_line=jnp.zeros(T, jnp.int32),
+        saved_requester=jnp.zeros(T, jnp.int32),
+        saved_time_ps=zi64(),
+        last_line=jnp.full(T, -1, jnp.int32),
+        last_done_ps=zi64(),
+    )
+    mail = MemMailboxes(
+        req_type=jnp.zeros((T, T), jnp.uint8),
+        req_line=jnp.zeros((T, T), jnp.int32),
+        req_time=jnp.zeros((T, T), I64),
+        evict_type=jnp.zeros((T, T), jnp.uint8),
+        evict_line=jnp.zeros((T, T), jnp.int32),
+        evict_time=jnp.zeros((T, T), I64),
+        fwd_type=jnp.zeros((T, T), jnp.uint8),
+        fwd_line=jnp.zeros((T, T), jnp.int32),
+        fwd_time=jnp.zeros((T, T), I64),
+        ack_type=jnp.zeros((T, T), jnp.uint8),
+        ack_line=jnp.zeros((T, T), jnp.int32),
+        ack_time=jnp.zeros((T, T), I64),
+        rep_type=jnp.zeros(T, jnp.uint8),
+        rep_time=zi64(),
+    )
+    req = RequesterState(
+        phase=jnp.zeros(T, jnp.int32),
+        slot=jnp.zeros(T, jnp.int32),
+        acc_ps=zi64(),
+        clock_ps=zi64(),
+        line=jnp.zeros(T, jnp.int32),
+        is_write=jnp.zeros(T, jnp.bool_),
+        component=jnp.zeros(T, jnp.uint8),
+        instr_buf=jnp.full(T, -1, jnp.int32),
+    )
+    counters = MemCounters(
+        l1i_hits=zi64(), l1i_misses=zi64(),
+        l1d_read_hits=zi64(), l1d_read_misses=zi64(),
+        l1d_write_hits=zi64(), l1d_write_misses=zi64(),
+        l2_hits=zi64(), l2_misses=zi64(),
+        evictions=zi64(), invalidations=zi64(),
+        dir_accesses=zi64(),
+        dram_reads=zi64(), dram_writes=zi64(),
+        dram_total_lat_ps=zi64(),
+    )
+    return MemState(
+        l1i=make_cache(T, mp.l1i.num_sets, mp.l1i.num_ways),
+        l1d=make_cache(T, mp.l1d.num_sets, mp.l1d.num_ways),
+        l2=make_cache(T, mp.l2.num_sets, mp.l2.num_ways),
+        l2_cloc=jnp.zeros((T, mp.l2.num_sets, mp.l2.num_ways), jnp.uint8),
+        directory=directory,
+        txn=txn,
+        mail=mail,
+        req=req,
+        counters=counters,
+        # +1 scratch word absorbing masked-off dummy writes
+        func_mem=jnp.zeros(max(mp.func_mem_words, 1) + 1, jnp.uint32),
+        func_errors=jnp.zeros((), I64),
+    )

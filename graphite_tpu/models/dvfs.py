@@ -70,6 +70,18 @@ def module_freq_mhz(cfg: ConfigFile, module: str) -> int:
     return 1000
 
 
+def module_domain_index(cfg: ConfigFile, module: str) -> int:
+    """Index of the domain containing `module` (-1 if unlisted).
+
+    Used for `hasSameDVFSDomain` checks (`dvfs_manager.cc` domain map):
+    synchronization delay applies only across different domains.
+    """
+    for i, (_, modules) in enumerate(parse_dvfs_domains(cfg)):
+        if module.upper() in modules:
+            return i
+    return -1
+
+
 def synchronization_delay_cycles(cfg: ConfigFile) -> int:
     """Delay crossing asynchronous domain boundaries (`carbon_sim.cfg:153-155`)."""
     return cfg.get_int("dvfs/synchronization_delay", 2)

@@ -1,0 +1,178 @@
+"""emesh_hop_by_hop: full per-hop 2D-mesh NoC with per-port contention.
+
+Reference: `common/network/models/network_model_emesh_hop_by_hop.{h,cc}`
+(SURVEY §2.6) + `components/router/router_model.cc:52-108`.
+
+Per-packet semantics mirrored exactly (`routePacket`,
+`network_model_emesh_hop_by_hop.cc:146-265`):
+ - injection router at the sender (1 output port): router delay +
+   injection-port contention;
+ - XY routing (x first, then y); at every intermediate tile the mesh
+   router adds router delay + output-port contention (queue model with
+   processing = num_flits) and the output link adds link delay;
+ - delivery goes through the destination's SELF port + SELF link;
+ - the receiver adds num_flits serialization cycles
+   (`network_model.cc:119-149`).
+
+TPU-native form: instead of per-tile router objects called hop-by-hop on
+the receiving process's sim thread, ALL in-flight packets advance one hop
+per `lax.fori_loop` step; port occupancies live in one flat QueueArrays
+[n_tiles*6 + scratch] updated with scatter-max/add (see
+`scatter_queue_delay` for the conflict-approximation contract).
+
+Ports: 0=RIGHT 1=LEFT 2=UP 3=DOWN 4=SELF 5=INJECT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from graphite_tpu.models.queue_models import (
+    QueueArrays, QueueParams, make_queues, scatter_queue_delay,
+)
+from graphite_tpu.time_types import cycles_to_ps, ps_to_cycles
+
+I64 = jnp.int64
+NUM_PORTS = 6
+PORT_RIGHT, PORT_LEFT, PORT_UP, PORT_DOWN, PORT_SELF, PORT_INJECT = range(6)
+
+
+@dataclasses.dataclass(frozen=True)
+class HopByHopParams:
+    n_tiles: int
+    mesh_width: int
+    mesh_height: int
+    router_delay: int          # cycles
+    link_delay: int            # cycles
+    flit_width_bits: int
+    freq_mhz: int
+    queue: QueueParams
+    contention_enabled: bool = True
+    broadcast_tree: bool = True
+
+    @classmethod
+    def from_config(cls, sc, network: str) -> "HopByHopParams":
+        from graphite_tpu.models.network_emesh import mesh_dims
+        from graphite_tpu.models.network_user import _network_domain_freq_mhz
+
+        cfg = sc.cfg
+        sec = "network/emesh_hop_by_hop"
+        w, h = mesh_dims(sc.application_tiles)
+        qenabled = cfg.get_bool(f"{sec}/queue_model/enabled", True)
+        qtype = cfg.get_string(f"{sec}/queue_model/type", "history_tree")
+        return cls(
+            n_tiles=sc.application_tiles,
+            mesh_width=w,
+            mesh_height=h,
+            router_delay=cfg.get_int(f"{sec}/router/delay", 1),
+            link_delay=cfg.get_int(f"{sec}/link/delay", 1),
+            flit_width_bits=cfg.get_int(f"{sec}/flit_width", 64),
+            freq_mhz=_network_domain_freq_mhz(
+                sc, "NETWORK_USER" if network == "user" else "NETWORK_MEMORY"),
+            queue=QueueParams.from_config(cfg, qtype, 1),
+            contention_enabled=qenabled,
+            broadcast_tree=cfg.get_bool(f"{sec}/broadcast_tree_enabled", True),
+        )
+
+    @property
+    def max_hops(self) -> int:
+        return self.mesh_width + self.mesh_height  # (w-1)+(h-1)+SELF+slack
+
+
+@struct.dataclass
+class NocState:
+    queues: QueueArrays   # [n_tiles*6 + 1] port queues (+ scratch)
+
+
+def init_noc_state(p: HopByHopParams) -> NocState:
+    return NocState(queues=make_queues(p.n_tiles * NUM_PORTS + 1, p.queue))
+
+
+def _xy_next(p: HopByHopParams, cur: jax.Array, dst: jax.Array):
+    """XY route step: (next_tile, port).  x first, then y, else SELF."""
+    w = p.mesh_width
+    cx, cy = cur % w, cur // w
+    dx, dy = dst % w, dst // w
+    port = jnp.where(
+        cx > dx, PORT_LEFT,
+        jnp.where(cx < dx, PORT_RIGHT,
+                  jnp.where(cy > dy, PORT_DOWN,
+                            jnp.where(cy < dy, PORT_UP, PORT_SELF))))
+    nxt = jnp.where(
+        port == PORT_LEFT, cur - 1,
+        jnp.where(port == PORT_RIGHT, cur + 1,
+                  jnp.where(port == PORT_DOWN, cur - w,
+                            jnp.where(port == PORT_UP, cur + w, cur))))
+    return nxt.astype(jnp.int32), port.astype(jnp.int32)
+
+
+def route_hop_by_hop(
+    p: HopByHopParams,
+    nst: NocState,
+    src: jax.Array,        # int32[L]
+    dst: jax.Array,        # int32[L]
+    bits,                  # int | int64[L] modeled packet length
+    t_send_ps: jax.Array,  # int64[L]
+    mask: jax.Array,       # bool[L]
+    enabled,               # bool[] models enabled
+):
+    """Route one packet per lane; returns (nst, arrival_ps, zero_load_ps,
+    contention_ps)."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    live = mask & jnp.asarray(enabled, bool)
+    flits = jnp.maximum(
+        (jnp.asarray(bits, I64) + p.flit_width_bits - 1)
+        // p.flit_width_bits, 1)
+    t0 = ps_to_cycles(t_send_ps, p.freq_mhz)  # network-clock cycles
+
+    # injection router (`routePacket` SEND_TILE branch)
+    inj_qid = src * NUM_PORTS + PORT_INJECT
+    if p.contention_enabled:
+        queues, inj_delay = scatter_queue_delay(
+            p.queue, nst.queues, inj_qid, t0, flits, live)
+    else:
+        queues, inj_delay = nst.queues, jnp.zeros_like(t0)
+    t = t0 + p.router_delay + inj_delay
+    zero_load = jnp.full_like(t0, p.router_delay)
+    contention = inj_delay
+
+    def hop(_, carry):
+        queues, t, cur, delivered, zero_load, contention = carry
+        nxt, port = _xy_next(p, cur, dst)
+        go = live & ~delivered
+        qid = cur * NUM_PORTS + port
+        if p.contention_enabled:
+            queues, cdelay = scatter_queue_delay(
+                p.queue, queues, qid, t, flits, go)
+        else:
+            cdelay = jnp.zeros_like(t)
+        step_zero = p.router_delay + p.link_delay
+        t = jnp.where(go, t + step_zero + cdelay, t)
+        zero_load = jnp.where(go, zero_load + step_zero, zero_load)
+        contention = jnp.where(go, contention + cdelay, contention)
+        delivered = delivered | (go & (port == PORT_SELF))
+        cur = jnp.where(go, nxt, cur)
+        return queues, t, cur, delivered, zero_load, contention
+
+    delivered = ~live  # masked lanes are "done" from the start
+    queues, t, cur, delivered, zero_load, contention = lax.fori_loop(
+        0, p.max_hops, hop,
+        (queues, t, src, delivered, zero_load, contention))
+
+    # receiver serialization (`__processReceivedPacket`), skipped for
+    # self-sends like the zero-load models
+    ser = jnp.where(src == dst, 0, flits)
+    t = t + ser
+    zero_load = zero_load + ser
+
+    arrival_ps = jnp.where(
+        live, cycles_to_ps(t, p.freq_mhz), t_send_ps)
+    zero_load_ps = jnp.where(live, cycles_to_ps(zero_load, p.freq_mhz), 0)
+    contention_ps = jnp.where(live, cycles_to_ps(contention, p.freq_mhz), 0)
+    return nst.replace(queues=queues), arrival_ps, zero_load_ps, contention_ps

@@ -63,12 +63,12 @@ class UserNetworkParams:
         raise ValueError(f"unsupported user network model: {kind}")
 
 
-def _network_domain_freq_mhz(cfg: SimConfig) -> int:
-    """First DVFS domain containing NETWORK_USER (`carbon_sim.cfg:147-151`)."""
+def _network_domain_freq_mhz(cfg: SimConfig, module: str = "NETWORK_USER") -> int:
+    """DVFS domain frequency of a network module (`carbon_sim.cfg:147-151`)."""
     from graphite_tpu.models.dvfs import parse_dvfs_domains
 
     for freq_mhz, modules in parse_dvfs_domains(cfg.cfg):
-        if "NETWORK_USER" in modules:
+        if module in modules:
             return freq_mhz
     return 1000
 

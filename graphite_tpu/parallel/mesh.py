@@ -46,6 +46,9 @@ _REPLICATED_STATE_FIELDS = {
     "barrier_count", "barrier_arrived", "barrier_time_ps",
     "mutex_locked", "mutex_owner", "mutex_time_ps",
     "models_enabled", "overflow",
+    # functional word store: a global address space, replicated (the
+    # coherence protocol serializes conflicting writes)
+    "func_mem", "func_errors",
 }
 
 

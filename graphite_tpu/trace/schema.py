@@ -46,6 +46,11 @@ FLAG_MEM0_WRITE = 1 << 2
 FLAG_MEM1_WRITE = 1 << 3
 FLAG_BRANCH_TAKEN = 1 << 4
 FLAG_ATOMIC = 1 << 5
+# Self-checking-test hook (the engine's analog of the reference unit tests'
+# assert-based checking, e.g. `tests/unit/shared_mem_test1`): a load with
+# FLAG_CHECK compares the loaded word against aux0 and bumps a global
+# functional-error counter on mismatch.
+FLAG_CHECK = 1 << 6
 
 
 class Op(enum.IntEnum):
@@ -208,6 +213,19 @@ class TraceBuilder:
               op: Op = Op.MOV) -> "TraceBuilder":
         return self._append(op, flags=FLAG_MEM0_VALID | FLAG_MEM0_WRITE,
                             pc=pc, addr0=addr, size0=size)
+
+    def store_value(self, addr: int, value: int, size: int = 4, pc: int = 0,
+                    op: Op = Op.MOV) -> "TraceBuilder":
+        """Store with a functional value (engine writes `value` to the word)."""
+        return self._append(op, flags=FLAG_MEM0_VALID | FLAG_MEM0_WRITE,
+                            pc=pc, addr0=addr, size0=size, aux0=value)
+
+    def load_check(self, addr: int, expect: int, size: int = 4,
+                   pc: int = 0, op: Op = Op.MOV) -> "TraceBuilder":
+        """Self-checking load: bumps the functional-error counter unless the
+        loaded word equals `expect` (FLAG_CHECK)."""
+        return self._append(op, flags=FLAG_MEM0_VALID | FLAG_CHECK, pc=pc,
+                            addr0=addr, size0=size, aux0=expect)
 
     def load_store(self, raddr: int, waddr: int, size: int = 4,
                    pc: int = 0, op: Op = Op.GENERIC) -> "TraceBuilder":

@@ -8,7 +8,11 @@ Must run before jax initializes its backends, hence env vars here.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for the test suite (override any ambient tunnel platform like
+# "axon"): tests validate semantics + sharding on the virtual 8-device CPU
+# mesh; benches/entry points run on the real chip.  Set
+# GRAPHITE_TESTS_PLATFORM to override.
+os.environ["JAX_PLATFORMS"] = os.environ.get("GRAPHITE_TESTS_PLATFORM", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,348 @@
+"""Memory-subsystem tests: cache arrays + MSI dram-directory protocol.
+
+Modeled on the reference's Pin-less shared-memory unit tests
+(`tests/unit/shared_mem_test1/shared_mem_test1.cc:21-59`: write on core 0,
+read on core 1, values must propagate through the coherence protocol) plus
+cycle-accounting checks that document the exact latency algebra of the
+reference's timing path (`l1_cache_cntlr.cc:90-180`,
+`dram_directory_cntlr.cc:44-559`, `dram_perf_model.cc:80-115`).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine.simulator import Simulator
+from graphite_tpu.memory import MemParams
+from graphite_tpu.memory import cache_array as ca
+from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+
+def make_config(n_tiles=2, **over):
+    extra = "\n".join(f"{k} = {v}" for k, v in over.items())
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = true
+{extra}
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+generic = 1
+mov = 1
+ialu = 1
+[branch_predictor]
+type = one_bit
+mispredict_penalty = 14
+size = 1024
+[clock_skew_management]
+scheme = lax_barrier
+[clock_skew_management/lax_barrier]
+quantum = 1000
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+def run(sc, builders, **kw):
+    batch = TraceBatch.from_builders(builders)
+    sim = Simulator(sc, batch, **kw)
+    return sim.run()
+
+
+# --------------------------------------------------------------------------
+# cache-array unit tests
+
+
+class TestCacheArrays:
+    def test_lookup_miss_then_insert_hit(self):
+        c = ca.make_cache(2, 4, 2)
+        line = jnp.asarray([5, 9], jnp.int32)
+        hit, way, st = ca.lookup(c, line)
+        assert not bool(hit.any())
+        mask = jnp.asarray([True, True])
+        way, v_valid, _, _ = ca.pick_victim(c, line)
+        assert not bool(v_valid.any())
+        c = ca.insert_at(c, line, way, ca.SHARED, mask)
+        hit, _, st = ca.lookup(c, line)
+        assert bool(hit.all())
+        assert st.tolist() == [ca.SHARED, ca.SHARED]
+        # tile 1 never inserted line 5
+        hit5, _, _ = ca.lookup(c, jnp.asarray([9, 5], jnp.int32))
+        assert hit5.tolist() == [False, False]
+
+    def test_lru_eviction_order(self):
+        # 1 set x 2 ways: inserting 3 lines evicts the least recently used
+        c = ca.make_cache(1, 1, 2)
+        m = jnp.asarray([True])
+        for line in (1, 2):
+            ln = jnp.asarray([line], jnp.int32)
+            way, _, _, _ = ca.pick_victim(c, ln)
+            c = ca.insert_at(c, ln, way, ca.MODIFIED, m)
+        # touch line 1 -> line 2 becomes LRU
+        hit, way, _ = ca.lookup(c, jnp.asarray([1], jnp.int32))
+        assert bool(hit.all())
+        c = ca.touch_lru(c, jnp.asarray([1], jnp.int32), way, m)
+        ln = jnp.asarray([3], jnp.int32)
+        way, v_valid, v_line, v_state = ca.pick_victim(c, ln)
+        assert bool(v_valid.all())
+        assert v_line.tolist() == [2]
+        assert v_state.tolist() == [ca.MODIFIED]
+
+    def test_state_predicates(self):
+        st = jnp.asarray(
+            [ca.INVALID, ca.SHARED, ca.MODIFIED, ca.EXCLUSIVE, ca.OWNED],
+            jnp.uint8)
+        assert ca.state_readable(st).tolist() == [False, True, True, True, True]
+        assert ca.state_writable(st).tolist() == [False, False, True, True, False]
+
+    def test_invalidate(self):
+        c = ca.make_cache(1, 2, 2)
+        ln = jnp.asarray([4], jnp.int32)
+        way, _, _, _ = ca.pick_victim(c, ln)
+        c = ca.insert_at(c, ln, way, ca.SHARED, jnp.asarray([True]))
+        c = ca.invalidate(c, ln, jnp.asarray([True]))
+        hit, _, _ = ca.lookup(c, ln)
+        assert not bool(hit.any())
+
+
+# --------------------------------------------------------------------------
+# MemParams resolution
+
+
+class TestMemParams:
+    def test_default_t1_geometry(self):
+        mp = MemParams.from_config(make_config(4))
+        # T1 caches (`carbon_sim.cfg:207-230`): L1-I 16KB/4w, L1-D 32KB/4w,
+        # L2 512KB/8w, 64B lines
+        assert mp.line_size == 64
+        assert (mp.l1i.num_sets, mp.l1i.num_ways) == (64, 4)
+        assert (mp.l1d.num_sets, mp.l1d.num_ways) == (128, 4)
+        assert (mp.l2.num_sets, mp.l2.num_ways) == (1024, 8)
+        assert mp.l2.tags_cycles == 3
+        assert mp.l2.data_and_tags_cycles == 8  # parallel model
+        assert mp.mc_tiles == (0, 1, 2, 3)
+        assert mp.dram_processing_ns == 13  # 64B / 5GBps + 1
+        # all modules in one default DVFS domain -> no sync delays
+        assert mp.sync_cycles(0, 3) == 0
+
+    def test_sequential_perf_model(self):
+        sc = make_config(2)
+        sc.cfg.set("l2_cache/T1/perf_model_type", "sequential")
+        mp = MemParams.from_config(sc)
+        assert mp.l2.data_and_tags_cycles == 8 + 3
+
+    def test_directory_autosizing(self):
+        mp = MemParams.from_config(make_config(4))
+        # num_sets = ceil(2*512KB*4 / (64*16*4)) = 1024 -> pow2 1024
+        assert mp.dir_sets == 1024
+        assert mp.dir_ways == 16
+
+
+# --------------------------------------------------------------------------
+# protocol end-to-end
+
+
+class TestMSIProtocol:
+    def test_cold_store_exact_latency_single_tile(self):
+        """Documents the full cold-miss latency algebra (1 tile, magic net).
+
+        store: core->L1D sync(0) + L1 tags(1) + L2 tags(3) | net(1) |
+        dir access(6, 128KB auto staircase) + dram(100+13 ns) | net(1) |
+        L2 fill(8) + L1 fill(1)  = 134 ns; +1 cycle mov cost = 135 ns.
+        """
+        sc = make_config(1)
+        b = TraceBuilder()
+        b.store_value(0x1000, 7)
+        res = run(sc, [b])
+        assert res.func_errors == 0
+        assert res.clock_ps[0] == 135_000
+        assert res.memory_stall_ps[0] == 134_000
+        mc = res.mem_counters
+        assert mc["l1d_write_misses"][0] == 1
+        assert mc["l2_misses"][0] == 1
+        assert mc["dram_reads"][0] == 1
+
+    def test_l1_hit_after_fill(self):
+        sc = make_config(1)
+        b = TraceBuilder()
+        b.store_value(0x1000, 7)       # cold: 134 ns stall
+        b.store_value(0x1000, 8)       # L1 hit (M): 1 cycle
+        b.load_check(0x1000, 8)        # L1 hit: 1 cycle
+        res = run(sc, [b])
+        assert res.func_errors == 0
+        # 135 + (1 stall + 1 cost) + (1 + 1) ns
+        assert res.clock_ps[0] == 139_000
+        mc = res.mem_counters
+        assert mc["l1d_write_hits"][0] == 1
+        assert mc["l1d_read_hits"][0] == 1
+
+    def test_producer_consumer_shared_mem_test1(self):
+        """shared_mem_test1 analog: write on tile 0, read on tile 1."""
+        sc = make_config(2)
+        addr = 0x0  # line 0 -> home tile 0
+        b0 = TraceBuilder()
+        b0.barrier_init(0, 2)
+        b0.store_value(addr, 42)
+        b0.barrier_wait(0)
+        b1 = TraceBuilder()
+        b1.barrier_wait(0)
+        b1.load_check(addr, 42)
+        res = run(sc, [b0, b1])
+        assert res.func_errors == 0
+        mc = res.mem_counters
+        # tile 1 missed everywhere; home had to WB the M line from tile 0
+        assert mc["l1d_read_misses"][1] == 1
+        assert mc["l2_misses"][1] == 1
+        assert mc["dram_writes"].sum() >= 1  # WB_REP wrote the line back
+
+    def test_write_invalidation_ping_pong(self):
+        """Alternating writers to one line exercise INV + FLUSH + upgrade."""
+        sc = make_config(2)
+        addr = 0x40  # line 1 -> home tile 1
+        b0 = TraceBuilder()
+        b0.barrier_init(0, 2)
+        b0.store_value(addr, 1)     # EX (cold)
+        b0.barrier_wait(0)
+        b0.barrier_wait(0)
+        b0.load_check(addr, 2)      # tile 1's write must be visible
+        b1 = TraceBuilder()
+        b1.barrier_wait(0)
+        b1.store_value(addr, 2)     # EX: FLUSH tile 0's M copy
+        b1.barrier_wait(0)
+        res = run(sc, [b0, b1])
+        assert res.func_errors == 0
+
+    def test_read_sharers_then_upgrade(self):
+        """Both tiles read (S everywhere), then tile 0 writes: the upgrade
+        sends INV_REP for its own copy + the directory invalidates tile 1
+        (`l2_cache_cntlr.cc:261-282`, `processExReqFromL2Cache` SHARED)."""
+        sc = make_config(2)
+        addr = 0x80
+        b0 = TraceBuilder()
+        b0.barrier_init(0, 2)
+        b0.load_check(addr, 0)
+        b0.barrier_wait(0)
+        b0.store_value(addr, 5)
+        b0.barrier_wait(0)
+        b1 = TraceBuilder()
+        b1.load_check(addr, 0)
+        b1.barrier_wait(0)
+        b1.barrier_wait(0)
+        b1.load_check(addr, 5)
+        res = run(sc, [b0, b1])
+        assert res.func_errors == 0
+        mc = res.mem_counters
+        assert mc["invalidations"].sum() >= 1
+
+    def test_capacity_evictions(self):
+        """March over > L1D capacity worth of lines; protocol stays sound."""
+        sc = make_config(1)
+        b = TraceBuilder()
+        n_lines = 128 * 4 + 8  # L1D lines + a few
+        for i in range(n_lines):
+            b.store_value(i * 64, i)
+        for i in range(0, n_lines, 7):
+            b.load_check(i * 64, i)
+        res = run(sc, [b])
+        assert res.func_errors == 0
+
+    def test_l2_capacity_evictions_tiny_l2(self):
+        """Tiny L2 forces L2 evictions with FLUSH_REP messages to the home."""
+        sc = make_config(1)
+        sc.cfg.set("l2_cache/T1/cache_size", "1")       # 1KB: 16 lines
+        sc.cfg.set("l1_dcache/T1/cache_size", "1")      # 4 sets x 4 ways
+        sc.cfg.set("l1_icache/T1/cache_size", "1")
+        b = TraceBuilder()
+        for i in range(64):
+            b.store_value(i * 64, i)
+        for i in range(64):
+            b.load_check(i * 64, i)
+        res = run(sc, [b])
+        assert res.func_errors == 0
+        assert res.mem_counters["evictions"][0] > 0
+        assert res.mem_counters["dram_writes"][0] > 0
+
+    def test_directory_nullify(self):
+        """A tiny directory forces entry replacement (NULLIFY_REQ path,
+        `processDirectoryEntryAllocationReq`)."""
+        sc = make_config(1)
+        sc.cfg.set("dram_directory/total_entries", "4")
+        sc.cfg.set("dram_directory/associativity", "2")
+        b = TraceBuilder()
+        for i in range(16):
+            b.store_value(i * 64, i)
+        for i in range(16):
+            b.load_check(i * 64, i)
+        res = run(sc, [b])
+        assert res.func_errors == 0
+
+    def test_four_tile_all_to_one_line(self):
+        """Four writers to one hot line, serialized by barriers."""
+        sc = make_config(4)
+        addr = 0x100
+        builders = []
+        for t in range(4):
+            b = TraceBuilder()
+            if t == 0:
+                b.barrier_init(0, 4)
+            for r in range(4):
+                if r == t:
+                    b.store_value(addr, 100 + r)
+                b.barrier_wait(0)
+            b.load_check(addr, 103)
+            builders.append(b)
+        res = run(sc, builders)
+        assert res.func_errors == 0
+
+    def test_models_disabled_zero_latency(self):
+        """trigger_models_within_application: before ENABLE_MODELS the
+        protocol runs functionally with zero latency (`simulator.cc:399-413`)."""
+        sc = make_config(1, trigger_models_within_application="true")
+        b = TraceBuilder()
+        b.store_value(0x40, 9)
+        b.load_check(0x40, 9)
+        res = run(sc, [b])
+        assert res.func_errors == 0
+        assert res.clock_ps[0] == 0
+        assert res.memory_stall_ps[0] == 0
+
+    def test_mem_disabled_when_no_shared_mem(self):
+        sc = make_config(1, enable_shared_mem="false")
+        b = TraceBuilder()
+        b.store_value(0x40, 9)
+        b.instr(Op.IALU)
+        res = run(sc, [b])
+        assert res.mem_counters is None
+        assert res.clock_ps[0] == 2_000  # two 1-cycle instructions only
+
+
+# --------------------------------------------------------------------------
+# icache modeling
+
+
+class TestICache:
+    def test_icache_instruction_buffer(self):
+        """With icache modeling on, same-line fetches hit the instruction
+        buffer (1 cycle, `core.cc:205-220`); the first fetch misses L1-I
+        and walks the protocol."""
+        sc = make_config(1, enable_icache_modeling="true")
+        b = TraceBuilder()
+        b.instr(Op.IALU, pc=0x400)
+        b.instr(Op.IALU, pc=0x404)  # same line: buffer hit
+        b.instr(Op.IALU, pc=0x408)
+        res = run(sc, [b])
+        mc = res.mem_counters
+        assert mc["l1i_misses"][0] == 1
+        assert mc["l1i_hits"][0] == 2
+        # fetch1 cold-miss (134ns) + 3x ialu (1 cyc) + 2x buffer hit (1 cyc)
+        assert res.clock_ps[0] == 134_000 + 3_000 + 2_000
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
