@@ -150,7 +150,7 @@ class Simulator:
 
         has_mem = bool(
             np.any(trace.flags & (FLAG_MEM0_VALID | FLAG_MEM1_VALID))
-        )
+        ) or cfg.get_bool("general/enable_icache_modeling", False)
         mem_params = None
         if config.enable_shared_mem and has_mem:
             from graphite_tpu.memory import MemParams

@@ -39,7 +39,6 @@ Timing semantics per record mirror the reference exactly:
 from __future__ import annotations
 
 import dataclasses
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -54,7 +53,7 @@ from graphite_tpu.trace.schema import (
 from graphite_tpu.time_types import cycles_to_ps
 
 I64 = jnp.int64
-FAR_FUTURE_PS = jnp.asarray(2**62, I64)
+FAR_FUTURE_PS = 2**62  # python int: folds to an inline literal, never a device-constant buffer
 ANY_SENDER = -1
 
 
@@ -407,7 +406,6 @@ def subquantum_iteration(
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 
 
-@functools.partial(jax.jit, static_argnums=0)
 def run_quantum(
     params: EngineParams, trace: DeviceTrace, state: SimState, qend: jax.Array
 ) -> SimState:
@@ -417,8 +415,10 @@ def run_quantum(
     until no tile makes progress (all done, all past the quantum boundary,
     or — transiently — all blocked on messages that can only arrive next
     quantum).  This is the quantum of `clock_skew_management/lax_barrier`
-    (`carbon_sim.cfg:92-97`).  Module-level jit with static params so all
-    Simulator instances with identical topology share one compilation.
+    (`carbon_sim.cfg:92-97`).  Deliberately NOT a module-level
+    `jit(static_argnums=0)`: jitting here with dataclass static args hits a
+    jax-0.9 dispatch bug (constant-buffer miscount after topology changes);
+    callers jit a closure instead (`make_quantum_step`).
     """
 
     def block(state: SimState):
@@ -446,8 +446,9 @@ def run_quantum(
 
 
 def make_quantum_step(params: EngineParams, trace: DeviceTrace):
-    """Bind params/trace for the Simulator's host loop."""
+    """Bind params/trace into a per-instance jitted step for the host loop."""
 
+    @jax.jit
     def step(state: SimState, qend: jax.Array) -> SimState:
         return run_quantum(params, trace, state, qend)
 

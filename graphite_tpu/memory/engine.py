@@ -68,7 +68,7 @@ from graphite_tpu.trace.schema import (
 
 I64 = jnp.int64
 U32 = jnp.uint32
-FAR = jnp.asarray(2**62, I64)
+FAR = 2**62  # python int: folds to an inline literal, never a device-constant buffer
 
 
 # --------------------------------------------------------------------------
@@ -141,7 +141,8 @@ def mem_net_latency_ps(mp: MemParams, src, dst, bits: int, enabled):
     src = jnp.asarray(src)
     dst = jnp.asarray(dst)
     if mp.net_kind == "magic":
-        return cycles_to_ps(jnp.ones_like(src, I64), mp.net_freq_mhz)
+        cycles = jnp.where(enabled, jnp.ones_like(src, I64), 0)
+        return cycles_to_ps(cycles, mp.net_freq_mhz)
     w = mp.mesh_width
     hops = jnp.abs(src % w - dst % w) + jnp.abs(src // w - dst // w)
     flits = (bits + mp.flit_width_bits - 1) // mp.flit_width_bits
@@ -283,7 +284,10 @@ def memory_engine_step(
 
     # ---- slot decomposition of the current record -------------------------
     flags = rec.flags
-    is_instr = rec.op < 20
+    # icache fetches only for static/branch records (op < DYNAMIC_MISC):
+    # step.py commits dynamic ops (15-19) without waiting on mem_ok, so
+    # giving them a fetch slot would leave an in-flight transaction behind
+    is_instr = rec.op < 15
     icache_present = (
         jnp.asarray(mp.icache_modeling)
         & jnp.asarray(enabled)
@@ -454,19 +458,23 @@ def memory_engine_step(
                        jnp.where(starting, slot, ms.req.slot)),
     )
 
+    # count misses only when the miss actually proceeds: a lane stalled on
+    # a busy evict cell (stall_start) retries `starting` every iteration
+    # and must not re-count
+    miss_go = l1_miss & ~stall_start
     counters = ms.counters.replace(
         l1i_hits=ms.counters.l1i_hits
         + ((l1_hit_now | ibuf_hit) & s_comp_l1i & enabled).astype(I64),
         l1i_misses=ms.counters.l1i_misses
-        + (l1_miss & s_comp_l1i & enabled).astype(I64),
+        + (miss_go & s_comp_l1i & enabled).astype(I64),
         l1d_read_hits=ms.counters.l1d_read_hits
         + (l1_hit_now & ~s_comp_l1i & ~s_write & enabled).astype(I64),
         l1d_read_misses=ms.counters.l1d_read_misses
-        + (l1_miss & ~s_comp_l1i & ~s_write & enabled).astype(I64),
+        + (miss_go & ~s_comp_l1i & ~s_write & enabled).astype(I64),
         l1d_write_hits=ms.counters.l1d_write_hits
         + (l1_hit_now & ~s_comp_l1i & s_write & enabled).astype(I64),
         l1d_write_misses=ms.counters.l1d_write_misses
-        + (l1_miss & ~s_comp_l1i & s_write & enabled).astype(I64),
+        + (miss_go & ~s_comp_l1i & s_write & enabled).astype(I64),
         l2_hits=ms.counters.l2_hits + (l2_hit_now & enabled).astype(I64),
         l2_misses=ms.counters.l2_misses + (l2_miss_go & enabled).astype(I64),
     )
@@ -598,13 +606,11 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
         ftype == MSG_INV_REQ, MSG_INV_REP,
         jnp.where(ftype == MSG_FLUSH_REQ, MSG_FLUSH_REP, MSG_WB_REP),
     ).astype(jnp.uint8)
-    ack_bits_rep = mp.rep_bits  # FLUSH/WB carry the line
-    ack_bits = jnp.where(is_inv, mp.req_bits, ack_bits_rep)
-    # serialization differs per type; compute both and select
+    # serialization differs per type (INV acks are header-only, FLUSH/WB
+    # carry the line); compute both and select
     lat_req = mem_net_latency_ps(mp, tiles, h, mp.req_bits, enabled)
     lat_rep = mem_net_latency_ps(mp, tiles, h, mp.rep_bits, enabled)
     ack_lat = jnp.where(is_inv, lat_req, lat_rep)
-    del ack_bits
     wh = jnp.where(serve, h, 0)
     mail = mail.replace(
         ack_type=mail.ack_type.at[wh, tiles].set(
@@ -822,8 +828,12 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     rreq = jnp.where(use_saved, txn.saved_requester, r_col)
     rtime = jnp.where(use_saved, txn.saved_time_ps,
                       mail.req_time[tiles, r_col])
-    # message sync at the directory (`handleMsgFromL2Cache` entry)
-    rtime = rtime + jnp.where(rreq == tiles, sync_dir_l2, sync_dir_net)
+    # message sync at the directory (`handleMsgFromL2Cache` entry) —
+    # charged once per message: saved_time_ps already includes it, so
+    # resumed requests (post-NULLIFY) must not pay it again
+    rtime = rtime + jnp.where(
+        use_saved, 0, jnp.where(rreq == tiles, sync_dir_l2, sync_dir_net)
+    )
     # same-address serialization floor (`processNextReqFromL2Cache` time
     # update for queued same-address requests)
     rtime = jnp.where(starting & (rline == txn.last_line),

@@ -127,15 +127,16 @@ def make_queues(n: int, params: QueueParams) -> QueueArrays:
     )
 
 
-def _mg1_delay(q: QueueArrays, service_time: jax.Array) -> jax.Array:
-    """`queue_model_m_g_1.cc:18-47` waiting-time formula, elementwise."""
-    n = q.n_arrivals.astype(F64)
-    have = q.n_arrivals > 0
+def _mg1_wait(n_arrivals, sum_st, sum_st2, newest_arrival) -> jax.Array:
+    """`queue_model_m_g_1.cc:18-47` waiting-time formula, elementwise over
+    running moments (shared by the lane-per-queue and scatter paths)."""
+    n = n_arrivals.astype(F64)
+    have = n_arrivals > 0
     n_safe = jnp.where(have, n, 1.0)
-    mean_st = q.sum_st.astype(F64) / n_safe
-    var_st = q.sum_st2.astype(F64) / n_safe - mean_st * mean_st
+    mean_st = sum_st.astype(F64) / n_safe
+    var_st = sum_st2.astype(F64) / n_safe - mean_st * mean_st
     service_rate = 1.0 / jnp.maximum(mean_st, 1e-12)
-    arrival_rate = n / jnp.maximum(q.newest_arrival.astype(F64), 1e-12)
+    arrival_rate = n / jnp.maximum(newest_arrival.astype(F64), 1e-12)
     arrival_rate = jnp.minimum(arrival_rate, 0.999 * service_rate)
     wait = 0.5 * service_rate * arrival_rate * (
         1.0 / (service_rate * service_rate) + var_st
@@ -143,11 +144,14 @@ def _mg1_delay(q: QueueArrays, service_time: jax.Array) -> jax.Array:
     return jnp.where(have, jnp.ceil(wait), 0.0).astype(I64)
 
 
+def _mg1_delay(q: QueueArrays) -> jax.Array:
+    return _mg1_wait(q.n_arrivals, q.sum_st, q.sum_st2, q.newest_arrival)
+
+
 def _mg1_update(q: QueueArrays, pkt_time, service_time, wait, mask):
     end = pkt_time + wait + service_time
     return q.replace(
-        sum_st=q.sum_st + jnp.where(mask, service_time * service_time * 0
-                                    + service_time, 0),
+        sum_st=q.sum_st + jnp.where(mask, service_time, 0),
         sum_st2=q.sum_st2 + jnp.where(mask, service_time * service_time, 0),
         n_arrivals=q.n_arrivals + mask.astype(I64),
         newest_arrival=jnp.where(
@@ -194,14 +198,14 @@ def compute_queue_delay(
         analytical = jnp.zeros_like(mask)
 
     elif params.kind == "m_g_1":
-        delay = _mg1_delay(q, proc)
+        delay = _mg1_delay(q)
         q = _mg1_update(q, pkt_time, proc, delay, mask)
         analytical = mask
 
     else:  # history_list / history_tree (windowed tail + M/G/1 fallback)
         too_old = params.analytical_enabled & (
             (pkt_time + proc) < q.window_start)
-        mg1 = _mg1_delay(q, proc)
+        mg1 = _mg1_delay(q)
         tail = jnp.maximum(q.queue_time - pkt_time, 0)
         delay = jnp.where(too_old, mg1, tail)
         in_window = mask & ~too_old
@@ -256,20 +260,9 @@ def scatter_queue_delay(
     if params.kind in ("history_list", "history_tree"):
         too_old = params.analytical_enabled & (
             (pkt_time + proc) < q.window_start[qid])
-        # M/G/1 fallback from the queue's running moments
-        n = q.n_arrivals[qid].astype(F64)
-        have = q.n_arrivals[qid] > 0
-        n_safe = jnp.where(have, n, 1.0)
-        mean_st = q.sum_st[qid].astype(F64) / n_safe
-        var_st = q.sum_st2[qid].astype(F64) / n_safe - mean_st * mean_st
-        srate = 1.0 / jnp.maximum(mean_st, 1e-12)
-        arate = n / jnp.maximum(q.newest_arrival[qid].astype(F64), 1e-12)
-        arate = jnp.minimum(arate, 0.999 * srate)
-        mg1 = jnp.where(
-            have,
-            jnp.ceil(0.5 * srate * arate * (1.0 / (srate * srate) + var_st)
-                     / (srate - arate)),
-            0.0).astype(I64)
+        # M/G/1 fallback from the queue's running moments (gathered view)
+        mg1 = _mg1_wait(q.n_arrivals[qid], q.sum_st[qid], q.sum_st2[qid],
+                        q.newest_arrival[qid])
         tail = jnp.maximum(qt - pkt_time, 0)
         delay = jnp.where(too_old, mg1, tail)
         in_window = mask & ~too_old

@@ -55,9 +55,16 @@ _REPLICATED_STATE_FIELDS = {
 def state_shardings(state: SimState, mesh: Mesh, n_tiles: int):
     def spec_for(path, leaf):
         name = path[-1].name if path else ""
-        if name in _REPLICATED_STATE_FIELDS or leaf.ndim == 0:
+        if (
+            name in _REPLICATED_STATE_FIELDS
+            or leaf.ndim == 0
+            # Anything not tile-major is replicated — e.g. the hop-by-hop
+            # NoC per-port queue arrays, which are [n_tiles*ports+1] flat
+            # (router state is small; replication trades memory for the
+            # scatter locality of contention updates)
+            or leaf.shape[0] != n_tiles
+        ):
             return NamedSharding(mesh, P())
-        assert leaf.shape[0] == n_tiles, (name, leaf.shape)
         return NamedSharding(mesh, _tile_spec(leaf))
 
     return jax.tree_util.tree_map_with_path(spec_for, state)

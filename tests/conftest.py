@@ -21,4 +21,12 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import jax  # noqa: E402
 
+# The ambient TPU-tunnel sitecustomize (axon) registers its backend and
+# flips the platform config at interpreter startup, which wins over the
+# JAX_PLATFORMS env var.  Flip it back explicitly: the suite must run on
+# the virtual 8-device CPU mesh, not over the single-chip tunnel.
+jax.config.update(
+    "jax_platforms", os.environ.get("GRAPHITE_TESTS_PLATFORM", "cpu")
+)
+
 import graphite_tpu  # noqa: E402,F401  (enables x64)
