@@ -11,9 +11,14 @@ import os
 import sys
 import time
 
-N_TILES = int(os.environ.get("BENCH_TILES", "256"))
+N_TILES = int(os.environ.get("BENCH_TILES", "1024"))
 N_ROUNDS = int(os.environ.get("BENCH_ROUNDS", "64"))
 COMPUTE_PER_ROUND = int(os.environ.get("BENCH_COMPUTE", "62"))
+# Basic-block-granularity replay (one BBLOCK record per straight-line run,
+# cycle-identical timing — the engine's native trace granularity).  Set
+# BENCH_COMPRESSED=0 to replay one record per instruction instead, which
+# measures the raw per-record engine rate.
+COMPRESSED = os.environ.get("BENCH_COMPRESSED", "1") != "0"
 BASELINE_INSTR_PER_SEC = 10_000_000  # BASELINE.json north star
 
 
@@ -57,13 +62,13 @@ scheme = lax
 """
     sc = SimConfig(ConfigFile.from_string(cfg_text))
     batch = synthetic.message_ring_batch(
-        N_TILES, n_rounds=N_ROUNDS, compute_per_round=COMPUTE_PER_ROUND
+        N_TILES, n_rounds=N_ROUNDS, compute_per_round=COMPUTE_PER_ROUND,
+        compressed=COMPRESSED,
     )
     sim = Simulator(sc, batch, mailbox_depth=8, inner_block=64)
 
-    # Warm-up: compile the quantum step.
-    warm = sim._run_quantum(sim.state, jnp.asarray(1, jnp.int64))
-    jax.block_until_ready(warm)
+    # Warm-up: compile (and run once) the full device-side simulation loop.
+    sim.warmup()
 
     t0 = time.perf_counter()
     results = sim.run()
@@ -75,7 +80,8 @@ scheme = lax
         json.dumps(
             {
                 "metric": f"simulated instr/s ({N_TILES}-tile emesh, "
-                f"compute+message workload)",
+                f"compute+message workload, "
+                f"{'bblock' if COMPRESSED else 'per-instr'} trace)",
                 "value": round(ips),
                 "unit": "instr/s",
                 "vs_baseline": round(ips / BASELINE_INSTR_PER_SEC, 4),

@@ -13,13 +13,14 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from graphite_tpu.config.config_file import ConfigFile
 from graphite_tpu.config.simconfig import SimConfig
 from graphite_tpu.engine.state import DeviceTrace, SimState, init_state
-from graphite_tpu.engine.step import EngineParams, make_quantum_step
+from graphite_tpu.engine.step import EngineParams
 from graphite_tpu.models.dvfs import module_freq_mhz
 from graphite_tpu.models.network_user import UserNetworkParams
 from graphite_tpu.time_types import ns_to_ps, ps_to_ns
@@ -226,86 +227,91 @@ class Simulator:
             self.state, self.device_trace = shard_sim(
                 self.state, self.device_trace, mesh
             )
-        self._run_quantum = make_quantum_step(self.params, self.device_trace)
+        self._runner = None
+        self._runner_max_quanta = None
 
     def _next_boundary(self, clock_ps: int) -> int:
         """First quantum boundary strictly above clock_ps."""
         q = self.quantum_ps
         return (clock_ps // q + 1) * q
 
+    def _get_runner(self, max_quanta: int):
+        from graphite_tpu.engine.step import make_simulation_runner
+
+        if self._runner is None or self._runner_max_quanta != max_quanta:
+            self._runner = make_simulation_runner(
+                self.params, self.device_trace, self.quantum_ps, max_quanta)
+            self._runner_max_quanta = max_quanta
+        return self._runner
+
+    def warmup(self, max_quanta: int = 1_000_000) -> None:
+        """Compile (and execute once, discarding results) the full runner —
+        for benchmarking so timed runs exclude compilation."""
+        out = self._get_runner(max_quanta)(self.state)
+        jax.block_until_ready(out)
+
     def run(self, max_quanta: int = 1_000_000) -> SimResults:
         """Drive quanta until every tile's trace is exhausted.
 
+        The whole quantum loop runs on device as one compiled region
+        (`run_simulation`): loop control (next boundary above the laggard
+        tile, zero-progress/deadlock detection, overflow) is device-side,
+        so the run costs a single host↔device round trip — each control
+        read over a tunneled chip costs ~100 ms, which made the previous
+        per-quantum host loop 5x slower than the simulation itself.
         Empty quanta are skipped by jumping qend to the next boundary above
         the laggard tile's clock (the reference's barrier only collects
         *running* threads, so idle quanta never happen there either —
         `lax_barrier_sync_server.h:12-36`).  A quantum with zero progress
         while some tile was eligible to run is a genuine deadlock.
         """
-        state = self.state
-        n_quanta = 0
-        prev_sig = None
-        qend = 0
-        while True:
-            done = np.asarray(state.done)
-            clocks = np.asarray(state.core.clock_ps)
-            if done.all():
-                break
-            if self.quantum_ps is None:
-                qend = LAX_INFINITE_QUANTUM_PS
-            else:
-                min_pending = int(clocks[~done].min())
-                qend = max(qend + self.quantum_ps,
-                           self._next_boundary(min_pending))
-            state = self._run_quantum(state, jnp.asarray(qend, jnp.int64))
-            n_quanta += 1
-            if bool(np.asarray(state.net.overflow)):
-                raise MailboxOverflowError(
-                    "a (dst,src) mailbox ring overflowed; re-run with a "
-                    "larger mailbox_depth"
-                )
-            sig = (
-                int(np.asarray(state.core.idx).sum()),
-                int(np.asarray(state.core.clock_ps).sum()),
+        state, n_quanta_dev, deadlock_dev = self._get_runner(max_quanta)(
+            self.state)
+        # ONE batched device→host fetch for control flags + all summary
+        # counters (each separate read over a tunneled chip costs ~100 ms).
+        mem_part = (
+            (state.mem.counters, state.mem.func_errors)
+            if state.mem is not None else None
+        )
+        host = jax.device_get((
+            n_quanta_dev, deadlock_dev, state.net.overflow, state.done,
+            state.core,
+            (state.net.packets_sent, state.net.packets_received,
+             state.net.total_latency_ps),
+            mem_part,
+        ))
+        (n_quanta, deadlock, overflow, done, core_h, net_h, mem_h) = host
+        if bool(overflow):
+            raise MailboxOverflowError(
+                "a (dst,src) mailbox ring overflowed; re-run with a "
+                "larger mailbox_depth"
             )
-            if sig == prev_sig:
-                # Zero progress.  If some tile sits beyond qend (it crossed
-                # the boundary executing one long record), jump the window
-                # up to it — blocked peers may be waiting on its future
-                # sends.  Only when every non-done tile was already eligible
-                # is this a genuine deadlock.
-                done_now = np.asarray(state.done)
-                clocks_now = np.asarray(state.core.clock_ps)
-                ahead = clocks_now[~done_now]
-                ahead = ahead[ahead >= qend]
-                if self.quantum_ps is not None and ahead.size:
-                    qend = self._next_boundary(int(ahead.min())) - self.quantum_ps
-                    prev_sig = None
-                    continue
-                blocked = np.flatnonzero(~done_now).tolist()
-                raise DeadlockError(
-                    f"no progress across a quantum; blocked tiles: "
-                    f"{blocked[:16]}{'...' if len(blocked) > 16 else ''}"
-                )
-            prev_sig = sig
-            if n_quanta >= max_quanta:
-                raise RuntimeError(f"exceeded max_quanta={max_quanta}")
+        if bool(deadlock):
+            blocked = np.flatnonzero(~done).tolist()
+            raise DeadlockError(
+                f"no progress across a quantum; blocked tiles: "
+                f"{blocked[:16]}{'...' if len(blocked) > 16 else ''}"
+            )
+        if not bool(done.all()):
+            raise RuntimeError(f"exceeded max_quanta={max_quanta}")
         self.state = state
-        return self._results(state, n_quanta)
+        return self._results_host(core_h, net_h, mem_h, int(n_quanta))
 
-    def _results(self, state: SimState, n_quanta: int) -> SimResults:
-        core, net = state.core, state.net
+    def _results_host(self, core, net_h, mem_h, n_quanta: int) -> SimResults:
+        """Assemble SimResults from already-fetched host arrays."""
         clock = np.asarray(core.clock_ps)
         mem_counters = None
         func_errors = 0
-        if state.mem is not None:
+        if mem_h is not None:
             import dataclasses as _dc
 
+            counters_h, func_errors_h = mem_h
             mem_counters = {
-                f.name: np.asarray(getattr(state.mem.counters, f.name))
-                for f in _dc.fields(state.mem.counters)
+                f.name: np.asarray(getattr(counters_h, f.name))
+                for f in _dc.fields(counters_h)
             }
-            func_errors = int(np.asarray(state.mem.func_errors))
+            func_errors = int(func_errors_h)
+        packets_sent, packets_received, total_latency_ps = net_h
         return SimResults(
             n_tiles=self.params.n_tiles,
             completion_time_ps=int(clock.max()),
@@ -319,10 +325,11 @@ class Simulator:
             sync_stall_ps=np.asarray(core.sync_stall_ps),
             bp_correct=np.asarray(core.bp_correct),
             bp_incorrect=np.asarray(core.bp_incorrect),
-            packets_sent=np.asarray(net.packets_sent),
-            packets_received=np.asarray(net.packets_received),
-            total_packet_latency_ps=np.asarray(net.total_latency_ps),
+            packets_sent=np.asarray(packets_sent),
+            packets_received=np.asarray(packets_received),
+            total_packet_latency_ps=np.asarray(total_latency_ps),
             n_quanta=n_quanta,
             mem_counters=mem_counters,
             func_errors=func_errors,
         )
+

@@ -95,12 +95,32 @@ def subquantum_iteration(
     tiles = jnp.arange(T, dtype=jnp.int32)
     idx = jnp.minimum(core.idx, trace.length - 1)
 
-    op = _gather_field(trace.op, idx).astype(jnp.int32)
-    flags = _gather_field(trace.flags, idx).astype(jnp.int32)
-    pc = _gather_field(trace.pc, idx)
-    aux0 = _gather_field(trace.aux0, idx)
-    aux1 = _gather_field(trace.aux1, idx)
-    dyn_ps = _gather_field(trace.dyn_ps, idx)
+    # Record fetch: per-row gathers on the [T, L] trace cost ~0.25 ms each
+    # on TPU (gather lowers poorly), so when every tile is at the SAME
+    # column — the common case for lockstep stretches — read the column
+    # with one dynamic_slice instead.  The gather path runs only when tiles
+    # have diverged (blocked on sync/messages).
+    gather_fields = (trace.op, trace.flags, trace.pc, trace.aux0, trace.aux1,
+                     trace.dyn_ps) + (
+        (trace.addr0, trace.addr1) if params.mem is not None else ())
+    uniform = jnp.all(idx == idx[0])
+
+    def _read_uniform(_):
+        return tuple(
+            lax.dynamic_slice_in_dim(f, idx[0], 1, axis=1)[:, 0]
+            for f in gather_fields
+        )
+
+    def _read_gather(_):
+        return tuple(_gather_field(f, idx) for f in gather_fields)
+
+    fetched = lax.cond(uniform, _read_uniform, _read_gather, None)
+    op = fetched[0].astype(jnp.int32)
+    flags = fetched[1].astype(jnp.int32)
+    pc = fetched[2]
+    aux0 = fetched[3]
+    aux1 = fetched[4]
+    dyn_ps = fetched[5]
 
     enabled = state.models_enabled
     done = state.done | (op == Op.NOP) | (op == Op.THREAD_EXIT)
@@ -113,8 +133,7 @@ def subquantum_iteration(
     if params.mem is not None:
         from graphite_tpu.memory.engine import RecView, memory_engine_step
 
-        addr0 = _gather_field(trace.addr0, idx)
-        addr1 = _gather_field(trace.addr1, idx)
+        addr0, addr1 = fetched[6], fetched[7]
         rec = RecView(op=op, flags=flags, pc=pc, addr0=addr0, addr1=addr1,
                       aux0=aux0, aux1=aux1)
         mem_out = memory_engine_step(
@@ -143,6 +162,7 @@ def subquantum_iteration(
     is_mlock = op == Op.MUTEX_LOCK
     is_munlock = op == Op.MUTEX_UNLOCK
     is_join = op == Op.THREAD_JOIN
+    is_bblock = op == Op.BBLOCK
     # Events that always complete in one iteration:
     is_simple_event = (
         (op == Op.THREAD_SPAWN)
@@ -170,131 +190,215 @@ def subquantum_iteration(
     cost_ps = cycles_to_ps(cycles, core.freq_mhz.astype(I64))
     cost_ps = jnp.where(is_dynamic, dyn_ps, cost_ps)
     cost_ps = jnp.where(op < 20, cost_ps, 0)  # events carry no direct cost
+    # compressed run: aux1 = total cycles for aux0 instructions
+    cost_ps = jnp.where(
+        is_bblock,
+        cycles_to_ps(aux1.astype(I64), core.freq_mhz.astype(I64)),
+        cost_ps,
+    )
     cost_ps = jnp.where(enabled, cost_ps, 0)
 
-    # --- SEND: push into (dst, src) mailbox ring -------------------------
+    # The network / barrier / mutex / join machinery each runs under a
+    # lax.cond keyed on "any lane has such an op right now" — compute-heavy
+    # stretches then skip the scatter-heavy machinery entirely (a TPU
+    # scatter costs ~0.2-0.9 ms regardless of how many lanes are masked on).
     dst = jnp.clip(aux0, 0, T - 1)
     send_now = active & is_send
-    if params.user_hbh is not None:
-        from graphite_tpu.models.network_hop_by_hop import route_hop_by_hop
-        from graphite_tpu.models.network_user import user_packet_bits
 
-        noc_user, arrival_ps, _, _ = route_hop_by_hop(
-            params.user_hbh, state.noc_user, tiles, dst,
-            user_packet_bits(aux1), core.clock_ps, send_now, enabled)
-        lat_ps = arrival_ps - core.clock_ps
-    else:
-        noc_user = state.noc_user
-        lat_ps = route_latency_ps(params.net, tiles, dst, aux1, enabled)
-        arrival_ps = core.clock_ps + lat_ps
-    slot = (net.head[dst, tiles] % D).astype(jnp.int32)
-    # Write under mask: redirect masked-off lanes to their own (t, t) cell
-    # at a dummy slot; since each lane writes a distinct src column, no
-    # collisions occur either way.
-    w_dst = jnp.where(send_now, dst, tiles)
-    time_ps_new = net.time_ps.at[w_dst, tiles, slot].set(
-        jnp.where(send_now, arrival_ps, net.time_ps[w_dst, tiles, slot])
-    )
-    lat_arr_new = net.lat_ps.at[w_dst, tiles, slot].set(
-        jnp.where(send_now, lat_ps.astype(jnp.int32),
-                  net.lat_ps[w_dst, tiles, slot])
-    )
-    head_new = net.head.at[w_dst, tiles].add(jnp.where(send_now, 1, 0))
+    # --- SEND + RECV: (dst, src) mailbox rings ---------------------------
+    def _net_block(_):
+        if params.user_hbh is not None:
+            from graphite_tpu.models.network_hop_by_hop import route_hop_by_hop
+            from graphite_tpu.models.network_user import user_packet_bits
 
-    # --- RECV: match earliest in-flight packet ---------------------------
-    tail = ((net.head - net.count) % D).astype(jnp.int32)  # [T, T]
-    tail_times = jnp.take_along_axis(net.time_ps, tail[:, :, None], axis=2)[:, :, 0]
-    tail_lats = jnp.take_along_axis(net.lat_ps, tail[:, :, None], axis=2)[:, :, 0]
-    avail = net.count > 0
-    masked_times = jnp.where(avail, tail_times, FAR_FUTURE_PS)
-    any_src = jnp.argmin(masked_times, axis=1).astype(jnp.int32)     # [T]
-    want_src = jnp.where(aux0 == ANY_SENDER, any_src, jnp.clip(aux0, 0, T - 1))
-    recv_time = masked_times[tiles, want_src]
-    recv_lat = tail_lats[tiles, want_src]
-    matched = recv_time < FAR_FUTURE_PS
-    recv_now = active & is_recv & matched
+            noc_user, arrival_ps, _, _ = route_hop_by_hop(
+                params.user_hbh, state.noc_user, tiles, dst,
+                user_packet_bits(aux1), core.clock_ps, send_now, enabled)
+            lat_ps = arrival_ps - core.clock_ps
+        else:
+            noc_user = state.noc_user
+            lat_ps = route_latency_ps(params.net, tiles, dst, aux1, enabled)
+            arrival_ps = core.clock_ps + lat_ps
+        slot = (net.head[dst, tiles] % D).astype(jnp.int32)
+        # Write under mask: redirect masked-off lanes to their own (t, t)
+        # cell at a dummy slot; since each lane writes a distinct src
+        # column, no collisions occur either way.  Updates are add-a-delta
+        # so the scatter is the array's ONLY remaining use — XLA then
+        # updates the loop-carried mailbox buffers in place instead of
+        # copying ~100MB per iteration.
+        w_dst = jnp.where(send_now, dst, tiles)
+        old_time = net.time_ps[w_dst, tiles, slot]
+        old_lat = net.lat_ps[w_dst, tiles, slot]
+        time_ps_new = net.time_ps.at[w_dst, tiles, slot].add(
+            jnp.where(send_now, arrival_ps - old_time, 0)
+        )
+        lat_arr_new = net.lat_ps.at[w_dst, tiles, slot].add(
+            jnp.where(send_now, lat_ps.astype(jnp.int32) - old_lat, 0)
+        )
+        head_new = net.head.at[w_dst, tiles].add(jnp.where(send_now, 1, 0))
+        count_sent = net.count.at[w_dst, tiles].add(
+            jnp.where(send_now, 1, 0))
+
+        # RECV matches against the POST-send arrays: a packet sent this
+        # iteration is immediately visible (its timestamp carries the
+        # arrival time, so simulated timing is unchanged — this only
+        # removes retry iterations and lets the send scatters alias).
+        # Specific-sender receives only touch their own (dst, src) ring:
+        # O(T) gathers.  The earliest-across-all-senders scan for
+        # ANY_SENDER receives is O(T^2) and runs under its own cond.
+        is_any_recv = is_recv & (aux0 == ANY_SENDER)
+
+        def _any_src(_):
+            tail = ((head_new - count_sent) % D).astype(jnp.int32)  # [T, T]
+            tail_times = jnp.take_along_axis(
+                time_ps_new, tail[:, :, None], axis=2)[:, :, 0]
+            masked_times = jnp.where(
+                count_sent > 0, tail_times, FAR_FUTURE_PS)
+            return jnp.argmin(masked_times, axis=1).astype(jnp.int32)
+
+        any_src = lax.cond(
+            jnp.any(active & is_any_recv),
+            _any_src, lambda _: jnp.zeros((T,), jnp.int32), None)
+        want_src = jnp.where(is_any_recv, any_src, jnp.clip(aux0, 0, T - 1))
+        sel_count = count_sent[tiles, want_src]
+        sel_tail = ((head_new[tiles, want_src] - sel_count) % D).astype(
+            jnp.int32)
+        matched = sel_count > 0
+        recv_time = jnp.where(
+            matched, time_ps_new[tiles, want_src, sel_tail], FAR_FUTURE_PS)
+        recv_lat = lat_arr_new[tiles, want_src, sel_tail]
+        recv_now = active & is_recv & matched
+        # pop (count -1)
+        count_new = count_sent.at[tiles, want_src].add(
+            jnp.where(recv_now, -1, 0))
+        # only a send can overflow its ring; check just the written cells
+        overflow = net.overflow | jnp.any(
+            send_now & (count_sent[w_dst, tiles] > D))
+        return (time_ps_new, lat_arr_new, head_new, count_new, overflow,
+                noc_user, recv_now, recv_time, recv_lat)
+
+    def _net_skip(_):
+        return (net.time_ps, net.lat_ps, net.head, net.count, net.overflow,
+                state.noc_user, jnp.zeros((T,), jnp.bool_),
+                jnp.full((T,), FAR_FUTURE_PS, I64), jnp.zeros((T,), jnp.int32))
+
+    (time_ps_new, lat_arr_new, head_new, count_new, overflow, noc_user,
+     recv_now, recv_time, recv_lat) = lax.cond(
+        jnp.any(send_now | (active & is_recv)), _net_block, _net_skip, None)
     recv_wait_ps = jnp.maximum(recv_time - core.clock_ps, 0)
-    # pop (count -1); sends above add +1 — combine as two scatter-adds
-    count_new = (
-        net.count.at[w_dst, tiles].add(jnp.where(send_now, 1, 0))
-        .at[tiles, want_src].add(jnp.where(recv_now, -1, 0))
-    )
-    overflow = net.overflow | jnp.any(count_new > D)
+    recv_wait_ps = jnp.where(recv_now, recv_wait_ps, 0)
 
     # --- BARRIER ---------------------------------------------------------
-    # Masked scatter-updates below use the add-a-delta idiom: masked-off
-    # lanes contribute +0, so duplicate dummy indices cannot clobber a live
-    # update (a plain masked .set would).
-    bar = jnp.clip(aux0, 0, sync.barrier_count.shape[0] - 1)
-    binit_now = active & is_binit
-    barrier_count = sync.barrier_count.at[bar].add(
-        jnp.where(binit_now, aux1 - sync.barrier_count[bar], 0)
-    )
-    new_arrival = active & is_bwait & ~sync.barrier_waiting
-    arr_tgt = jnp.where(new_arrival, bar, 0)
-    barrier_arrived = sync.barrier_arrived.at[arr_tgt].add(
-        jnp.where(new_arrival, 1, 0)
-    )
-    barrier_time = sync.barrier_time_ps.at[arr_tgt].max(
-        jnp.where(new_arrival, core.clock_ps, 0)
-    )
-    release_bar = (barrier_count > 0) & (barrier_arrived >= barrier_count)
-    participant = is_bwait & (sync.barrier_waiting | new_arrival) & ~done
-    released = participant & release_bar[bar]
-    release_time = barrier_time[bar]
-    barrier_waiting = (sync.barrier_waiting | new_arrival) & ~released
-    # reset released barriers
-    barrier_arrived = jnp.where(release_bar, 0, barrier_arrived)
-    barrier_time = jnp.where(release_bar, 0, barrier_time)
+    def _barrier_block(_):
+        # Masked scatter-updates use the add-a-delta idiom: masked-off
+        # lanes contribute +0, so duplicate dummy indices cannot clobber a
+        # live update (a plain masked .set would).
+        bar = jnp.clip(aux0, 0, sync.barrier_count.shape[0] - 1)
+        binit_now = active & is_binit
+        barrier_count = sync.barrier_count.at[bar].add(
+            jnp.where(binit_now, aux1 - sync.barrier_count[bar], 0)
+        )
+        new_arrival = active & is_bwait & ~sync.barrier_waiting
+        arr_tgt = jnp.where(new_arrival, bar, 0)
+        barrier_arrived = sync.barrier_arrived.at[arr_tgt].add(
+            jnp.where(new_arrival, 1, 0)
+        )
+        barrier_time = sync.barrier_time_ps.at[arr_tgt].max(
+            jnp.where(new_arrival, core.clock_ps, 0)
+        )
+        release_bar = (barrier_count > 0) & (barrier_arrived >= barrier_count)
+        participant = is_bwait & (sync.barrier_waiting | new_arrival) & ~done
+        released = participant & release_bar[bar]
+        release_time = barrier_time[bar]
+        barrier_waiting = (sync.barrier_waiting | new_arrival) & ~released
+        # reset released barriers
+        barrier_arrived = jnp.where(release_bar, 0, barrier_arrived)
+        barrier_time = jnp.where(release_bar, 0, barrier_time)
+        return (barrier_count, barrier_arrived, barrier_time,
+                barrier_waiting, released, release_time)
+
+    def _barrier_skip(_):
+        return (sync.barrier_count, sync.barrier_arrived,
+                sync.barrier_time_ps, sync.barrier_waiting,
+                jnp.zeros((T,), jnp.bool_), jnp.zeros((T,), I64))
+
+    (barrier_count, barrier_arrived, barrier_time, barrier_waiting,
+     released, release_time) = lax.cond(
+        jnp.any(active & (is_binit | is_bwait)),
+        _barrier_block, _barrier_skip, None)
     barrier_wait_ps = jnp.maximum(release_time - core.clock_ps, 0)
+    barrier_wait_ps = jnp.where(released, barrier_wait_ps, 0)
 
     # --- MUTEX -----------------------------------------------------------
     NM = sync.mutex_locked.shape[0]
-    mux = jnp.clip(aux0, 0, NM - 1)
-    minit_now = active & is_minit
-    mutex_locked = sync.mutex_locked.at[mux].add(
-        jnp.where(minit_now, -sync.mutex_locked[mux], 0)
-    )
-    # candidates: tiles at MUTEX_LOCK (waiting from before, or arriving now)
-    lock_candidate = is_mlock & ~done & (sync.mutex_waiting | active)
-    cand_mux = jnp.where(lock_candidate, mux, NM)  # NM = "no mutex" bucket
-    grant_key = core.clock_ps * jnp.asarray(T, I64) + tiles.astype(I64)
-    masked_key = jnp.where(lock_candidate, grant_key, jnp.asarray(2**62, I64))
-    best_key = (
-        jnp.full((NM + 1,), 2**62, I64).at[cand_mux].min(masked_key)
-    )[:NM]
-    grantable = mutex_locked == 0
-    granted = lock_candidate & grantable[mux] & (masked_key == best_key[mux])
-    mutex_grab_time = sync.mutex_time_ps[mux]
-    mutex_wait_ps = jnp.maximum(mutex_grab_time - core.clock_ps, 0)
-    mutex_wait_ps = jnp.where(granted, mutex_wait_ps, 0)
-    # grant is unique per mutex (key includes tile id), unlock unique per
-    # mutex (single owner), so add-deltas below cannot double-apply
-    mutex_locked = mutex_locked.at[mux].add(jnp.where(granted, 1, 0))
-    mutex_owner = sync.mutex_owner.at[mux].add(
-        jnp.where(granted, tiles - sync.mutex_owner[mux], 0)
-    )
-    mutex_waiting = (lock_candidate & ~granted) | (
-        sync.mutex_waiting & ~is_mlock
-    )
-    # unlock: free + stamp handoff time (`sync_server.cc:211-240`)
-    unlock_now = active & is_munlock
-    mutex_locked = mutex_locked.at[mux].add(jnp.where(unlock_now, -1, 0))
-    mutex_owner = mutex_owner.at[mux].add(
-        jnp.where(unlock_now, -1 - mutex_owner[mux], 0)
-    )
-    mutex_time = sync.mutex_time_ps.at[mux].add(
-        jnp.where(unlock_now, core.clock_ps - sync.mutex_time_ps[mux], 0)
-    )
+
+    def _mutex_block(_):
+        mux = jnp.clip(aux0, 0, NM - 1)
+        minit_now = active & is_minit
+        mutex_locked = sync.mutex_locked.at[mux].add(
+            jnp.where(minit_now, -sync.mutex_locked[mux], 0)
+        )
+        # candidates: tiles at MUTEX_LOCK (waiting or arriving now)
+        lock_candidate = is_mlock & ~done & (sync.mutex_waiting | active)
+        cand_mux = jnp.where(lock_candidate, mux, NM)  # NM = "none" bucket
+        grant_key = core.clock_ps * jnp.asarray(T, I64) + tiles.astype(I64)
+        masked_key = jnp.where(
+            lock_candidate, grant_key, jnp.asarray(2**62, I64))
+        best_key = (
+            jnp.full((NM + 1,), 2**62, I64).at[cand_mux].min(masked_key)
+        )[:NM]
+        grantable = mutex_locked == 0
+        granted = lock_candidate & grantable[mux] & (
+            masked_key == best_key[mux])
+        mutex_grab_time = sync.mutex_time_ps[mux]
+        mutex_wait_ps = jnp.maximum(mutex_grab_time - core.clock_ps, 0)
+        mutex_wait_ps = jnp.where(granted, mutex_wait_ps, 0)
+        # grant is unique per mutex (key includes tile id), unlock unique
+        # per mutex (single owner), so add-deltas cannot double-apply
+        mutex_locked = mutex_locked.at[mux].add(jnp.where(granted, 1, 0))
+        mutex_owner = sync.mutex_owner.at[mux].add(
+            jnp.where(granted, tiles - sync.mutex_owner[mux], 0)
+        )
+        mutex_waiting = (lock_candidate & ~granted) | (
+            sync.mutex_waiting & ~is_mlock
+        )
+        # unlock: free + stamp handoff time (`sync_server.cc:211-240`)
+        unlock_now = active & is_munlock
+        mutex_locked = mutex_locked.at[mux].add(jnp.where(unlock_now, -1, 0))
+        mutex_owner = mutex_owner.at[mux].add(
+            jnp.where(unlock_now, -1 - mutex_owner[mux], 0)
+        )
+        mutex_time = sync.mutex_time_ps.at[mux].add(
+            jnp.where(unlock_now, core.clock_ps - sync.mutex_time_ps[mux], 0)
+        )
+        return (mutex_locked, mutex_owner, mutex_time, mutex_waiting,
+                granted, mutex_wait_ps)
+
+    def _mutex_skip(_):
+        return (sync.mutex_locked, sync.mutex_owner, sync.mutex_time_ps,
+                sync.mutex_waiting, jnp.zeros((T,), jnp.bool_),
+                jnp.zeros((T,), I64))
+
+    (mutex_locked, mutex_owner, mutex_time, mutex_waiting, granted,
+     mutex_wait_ps) = lax.cond(
+        jnp.any((active & (is_minit | is_munlock))
+                | (is_mlock & ~done & (sync.mutex_waiting | active))),
+        _mutex_block, _mutex_skip, None)
 
     # --- JOIN ------------------------------------------------------------
-    join_target = jnp.clip(aux0, 0, T - 1)
-    target_idx = jnp.minimum(core.idx[join_target], trace.length - 1)
-    target_done = state.done[join_target] | (
-        trace.op[join_target, target_idx] == Op.THREAD_EXIT
-    )
-    join_now = active & is_join & target_done
-    join_time = jnp.maximum(core.clock_ps, core.clock_ps[join_target])
+    def _join_block(_):
+        join_target = jnp.clip(aux0, 0, T - 1)
+        target_idx = jnp.minimum(core.idx[join_target], trace.length - 1)
+        target_done = state.done[join_target] | (
+            trace.op[join_target, target_idx] == Op.THREAD_EXIT
+        )
+        join_now = active & is_join & target_done
+        join_time = jnp.maximum(core.clock_ps, core.clock_ps[join_target])
+        return join_now, join_time
+
+    join_now, join_time = lax.cond(
+        jnp.any(active & is_join), _join_block,
+        lambda _: (jnp.zeros((T,), jnp.bool_), core.clock_ps), None)
 
     # --- commit: advance mask, clocks, counters --------------------------
     # Instruction records with memory operands commit only once all their
@@ -302,18 +406,18 @@ def subquantum_iteration(
     # latencies and the execution cost land on the clock together).
     instr_like = is_static | is_branch
     advance = active & (
-        (instr_like & mem_ok) | (is_dynamic & ~is_spawn_instr)
+        ((instr_like | is_bblock) & mem_ok) | (is_dynamic & ~is_spawn_instr)
         | is_simple_event | is_send
     )
     advance = advance | recv_now | released | (active & is_spawn_instr)
     advance = advance | granted | join_now
 
     clock = core.clock_ps
-    clock = jnp.where(advance & (instr_like
+    clock = jnp.where(advance & (instr_like | is_bblock
                                  | (is_dynamic & ~is_spawn_instr)
                                  | is_simple_event | is_send),
                       clock + cost_ps
-                      + jnp.where(instr_like, mem_acc_ps, 0),
+                      + jnp.where(instr_like | is_bblock, mem_acc_ps, 0),
                       clock)
     clock = jnp.where(active & is_spawn_instr,
                       jnp.maximum(clock, dyn_ps), clock)
@@ -340,12 +444,14 @@ def subquantum_iteration(
         idx=core.idx + advance.astype(jnp.int32),
         instruction_count=core.instruction_count
         + (instr_now & enabled).astype(I64)
+        + jnp.where(advance & is_bblock & enabled, aux0.astype(I64), 0)
         + recv_charged.astype(I64)
         + sync_charged.astype(I64),
         memory_stall_ps=core.memory_stall_ps
-        + jnp.where(advance & instr_like, mem_acc_ps, 0),
+        + jnp.where(advance & (instr_like | is_bblock), mem_acc_ps, 0),
         execution_stall_ps=core.execution_stall_ps
-        + jnp.where(advance & (is_static | is_branch), cost_ps, 0),
+        + jnp.where(advance & (is_static | is_branch | is_bblock),
+                    cost_ps, 0),
         recv_instructions=core.recv_instructions + recv_charged.astype(I64),
         recv_stall_ps=core.recv_stall_ps
         + jnp.where(recv_charged, recv_wait_ps, 0),
@@ -353,9 +459,12 @@ def subquantum_iteration(
         sync_stall_ps=core.sync_stall_ps
         + jnp.where(released & enabled, barrier_wait_ps, 0)
         + jnp.where(granted & enabled, mutex_wait_ps, 0),
-        bp_bits=core.bp_bits.at[tiles, bp_index].set(
-            jnp.where(active & is_branch & enabled, taken,
-                      core.bp_bits[tiles, bp_index])
+        # delta-add (uint8 modular): old + (taken - old) == taken; avoids a
+        # second gather of bp_bits inside the scatter so the buffer updates
+        # in place ((tiles, bp_index) pairs are unique per lane)
+        bp_bits=core.bp_bits.at[tiles, bp_index].add(
+            jnp.where(active & is_branch & enabled, taken - bp_pred, 0)
+            .astype(jnp.uint8)
         ),
         bp_correct=core.bp_correct
         + (active & is_branch & bp_correct_now & enabled).astype(I64),
@@ -406,6 +515,36 @@ def subquantum_iteration(
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 
 
+def _quantum_loop(params, trace, state, qend):
+    """Blocks of `inner_block` iterations until no tile makes progress.
+    Returns (state, total_progress)."""
+
+    def block(state, progress):
+        def body(carry, _):
+            st, prog = carry
+            st, adv = subquantum_iteration(params, trace, st, qend)
+            return (st, prog + adv), None
+
+        (state, progress), _ = lax.scan(
+            body, (state, progress), None, length=params.inner_block,
+        )
+        return state, progress
+
+    def cond(carry):
+        _, _, blk_prog = carry
+        return blk_prog > 0
+
+    def body(carry):
+        st, total, _ = carry
+        st, blk = block(st, jnp.asarray(0, jnp.int32))
+        return st, total + blk, blk
+
+    state, total, _ = lax.while_loop(
+        cond, body,
+        (state, jnp.asarray(0, jnp.int32), jnp.asarray(1, jnp.int32)))
+    return state, total
+
+
 def run_quantum(
     params: EngineParams, trace: DeviceTrace, state: SimState, qend: jax.Array
 ) -> SimState:
@@ -420,28 +559,7 @@ def run_quantum(
     jax-0.9 dispatch bug (constant-buffer miscount after topology changes);
     callers jit a closure instead (`make_quantum_step`).
     """
-
-    def block(state: SimState):
-        def body(carry, _):
-            st, prog = carry
-            st, adv = subquantum_iteration(params, trace, st, qend)
-            return (st, prog + adv), None
-
-        (state, progress), _ = lax.scan(
-            body, (state, jnp.asarray(0, jnp.int32)), None,
-            length=params.inner_block,
-        )
-        return state, progress
-
-    def cond(carry):
-        _, prog = carry
-        return prog > 0
-
-    def body(carry):
-        st, _ = carry
-        return block(st)
-
-    state, _ = lax.while_loop(cond, body, (state, jnp.asarray(1, jnp.int32)))
+    state, _ = _quantum_loop(params, trace, state, qend)
     return state
 
 
@@ -453,3 +571,83 @@ def make_quantum_step(params: EngineParams, trace: DeviceTrace):
         return run_quantum(params, trace, state, qend)
 
     return step
+
+
+def run_simulation(
+    params: EngineParams,
+    trace: DeviceTrace,
+    state: SimState,
+    quantum_ps: int | None,
+    max_quanta: int = 1_000_000,
+):
+    """The whole simulation as ONE compiled region: an outer while_loop over
+    lax-barrier quanta (the MCP barrier loop, `lax_barrier_sync_server.h`)
+    wrapping the per-quantum progress loop.
+
+    Device-driven on purpose: every host↔device round trip costs ~100 ms
+    over a tunneled chip, so the host loop's per-quantum control reads made
+    quanta 5x slower than the quantum itself.  Loop control (next quantum
+    boundary, zero-progress/deadlock detection, overflow) is computed on
+    device; the host reads back one final state.
+
+    Returns (state, n_quanta, deadlock flag) — deadlock means a quantum made
+    zero progress while some tile was eligible to run (same condition the
+    reference debugs with its progress trace, `pin/progress_trace.cc`).
+    """
+    INF_QEND = jnp.asarray(2**61, I64)
+    qps = None if quantum_ps is None else int(quantum_ps)
+
+    def next_boundary(clock):
+        return (clock // qps + 1) * qps
+
+    def cond(carry):
+        st, qend, n, deadlock = carry
+        return (
+            ~jnp.all(st.done)
+            & ~st.net.overflow
+            & ~deadlock
+            & (n < max_quanta)
+        )
+
+    def body(carry):
+        st, prev_qend, n, deadlock = carry
+        clocks = st.core.clock_ps
+        not_done = ~st.done
+        min_pending = jnp.min(jnp.where(not_done, clocks, jnp.asarray(2**62, I64)))
+        if qps is None:
+            qend = INF_QEND
+        else:
+            qend = jnp.maximum(prev_qend + qps, next_boundary(min_pending))
+        st2, progress = _quantum_loop(params, trace, st, qend)
+        # Zero progress: if some non-done tile sits beyond qend (it crossed
+        # the boundary executing one long record), jump the window up to it
+        # — blocked peers may wait on its future sends.  Only when every
+        # non-done tile was already eligible is this a genuine deadlock.
+        zero = (progress == 0) & jnp.any(~st2.done)
+        if qps is not None:
+            ahead_clock = jnp.min(jnp.where(
+                ~st2.done & (st2.core.clock_ps >= qend),
+                st2.core.clock_ps, jnp.asarray(2**62, I64)))
+            have_ahead = ahead_clock < 2**62
+            qend_next = jnp.where(
+                zero & have_ahead, next_boundary(ahead_clock) - qps, qend)
+            deadlock = zero & ~have_ahead
+        else:
+            qend_next = qend
+            deadlock = zero
+        return st2, qend_next, n + 1, deadlock
+
+    state, _, n_quanta, deadlock = lax.while_loop(
+        cond, body,
+        (state, jnp.asarray(0, I64), jnp.asarray(0, jnp.int32),
+         jnp.asarray(False)))
+    return state, n_quanta, deadlock
+
+
+def make_simulation_runner(params: EngineParams, trace: DeviceTrace,
+                           quantum_ps: int | None, max_quanta: int):
+    @jax.jit
+    def run(state: SimState):
+        return run_simulation(params, trace, state, quantum_ps, max_quanta)
+
+    return run

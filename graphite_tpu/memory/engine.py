@@ -284,10 +284,12 @@ def memory_engine_step(
 
     # ---- slot decomposition of the current record -------------------------
     flags = rec.flags
-    # icache fetches only for static/branch records (op < DYNAMIC_MISC):
-    # step.py commits dynamic ops (15-19) without waiting on mem_ok, so
-    # giving them a fetch slot would leave an in-flight transaction behind
-    is_instr = rec.op < 15
+    # icache fetches for static/branch records (op < DYNAMIC_MISC) and
+    # compressed BBLOCK runs (op 50, one fetch for the block's first line —
+    # documented approximation of per-line fetches).  step.py commits
+    # dynamic ops (15-19) without waiting on mem_ok, so giving them a fetch
+    # slot would leave an in-flight transaction behind.
+    is_instr = (rec.op < 15) | (rec.op == 50)
     icache_present = (
         jnp.asarray(mp.icache_modeling)
         & jnp.asarray(enabled)

@@ -99,6 +99,18 @@ class Op(enum.IntEnum):
     DISABLE_MODELS = 47
     DVFS_SET = 48      # aux0=domain, aux1=frequency in MHz
     DVFS_GET = 49      # aux0=domain
+    # Compressed straight-line run: aux0 = instruction count, aux1 = total
+    # cycles (sum of per-instruction static costs).  The TPU-native analog
+    # of Pin's basic-block granularity (`pin/instruction_modeling.cc`
+    # instruments per-INS but the cost algebra over a run of static-cost
+    # instructions is associative, so one record carries the whole run —
+    # cycle-identical at fixed frequency when icache modeling is off, and
+    # DVFS changes only occur at DVFS_SET records, never inside a run).
+    # With icache modeling ON, a BBLOCK pays ONE icache fetch for its first
+    # line (record pc) rather than per-line fetches — a documented
+    # block-granularity approximation.  No memory operands, branches, or
+    # events inside a run.
+    BBLOCK = 50
     NOP = 255          # padding past THREAD_EXIT
 
 
@@ -232,6 +244,11 @@ class TraceBuilder:
         flags = (FLAG_MEM0_VALID | FLAG_MEM1_VALID | FLAG_MEM1_WRITE)
         return self._append(op, flags=flags, pc=pc, addr0=raddr,
                             addr1=waddr, size0=size, size1=size)
+
+    def bblock(self, n_instr: int, cycles: int, pc: int = 0) -> "TraceBuilder":
+        """A compressed run of `n_instr` straight-line instructions costing
+        `cycles` total (Op.BBLOCK)."""
+        return self._append(Op.BBLOCK, pc=pc, aux0=n_instr, aux1=cycles)
 
     def branch(self, taken: bool, pc: int = 0) -> "TraceBuilder":
         flags = FLAG_BRANCH_TAKEN if taken else 0

@@ -258,6 +258,8 @@ def message_ring_batch(
     packet_size: int = 8,
     pattern: str = "nearest_neighbor",
     seed: int = 0,
+    compressed: bool = False,
+    cycles_per_instr: int = 1,
 ) -> TraceBatch:
     """Vectorized compute+communicate workload (the bench kernel).
 
@@ -265,6 +267,11 @@ def message_ring_batch(
     the traffic pattern, one receive (from whichever sender targets this
     tile) — a trace-program reduction of the synthetic_network send/recv
     loop (`synthetic_network.cc:136-213`).
+
+    With `compressed=True` the per-round compute run is emitted as a single
+    Op.BBLOCK record (aux0=count, aux1=count*cycles_per_instr) — identical
+    simulated timing when the ialu static cost equals `cycles_per_instr`,
+    at basic-block replay granularity.
     """
     dest = destinations(pattern, n_tiles)  # [n_slots, n_tiles]
     n_slots = dest.shape[0]
@@ -273,14 +280,20 @@ def message_ring_batch(
     for s in range(n_slots):
         senders[s, dest[s]] = np.arange(n_tiles, dtype=dest.dtype)
 
-    L_round = compute_per_round + 2
+    n_compute_recs = 1 if compressed else compute_per_round
+    L_round = n_compute_recs + 2
     L = n_rounds * L_round
     op = np.full((n_tiles, L), int(Op.IALU), np.uint8)
     aux0 = np.zeros((n_tiles, L), np.int32)
     aux1 = np.zeros((n_tiles, L), np.int32)
-    send_cols = np.arange(n_rounds) * L_round + compute_per_round
+    send_cols = np.arange(n_rounds) * L_round + n_compute_recs
     recv_cols = send_cols + 1
     rounds = np.arange(n_rounds)
+    if compressed:
+        bblock_cols = np.arange(n_rounds) * L_round
+        op[:, bblock_cols] = int(Op.BBLOCK)
+        aux0[:, bblock_cols] = compute_per_round
+        aux1[:, bblock_cols] = compute_per_round * cycles_per_instr
     op[:, send_cols] = int(Op.SEND)
     op[:, recv_cols] = int(Op.NET_RECV)
     aux0[:, send_cols] = dest[rounds % n_slots].T          # [n_tiles, n_rounds]

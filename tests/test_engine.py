@@ -400,6 +400,35 @@ class TestSyntheticTraces:
         assert (r.instruction_count == 200).all()
         assert (r.clock_ps > 0).all()
 
+    def test_bblock_compression_timing_identical(self):
+        """A compressed trace must be cycle- and counter-identical to the
+        per-instruction trace it compresses (the cost algebra over a
+        straight-line run is associative)."""
+        sc = make_config(n_tiles=16, scheme="lax")
+        raw = synthetic.message_ring_batch(
+            16, n_rounds=6, compute_per_round=10)
+        comp = synthetic.message_ring_batch(
+            16, n_rounds=6, compute_per_round=10, compressed=True)
+        r_raw = Simulator(sc, raw).run()
+        r_comp = Simulator(sc, comp).run()
+        np.testing.assert_array_equal(r_raw.clock_ps, r_comp.clock_ps)
+        np.testing.assert_array_equal(
+            r_raw.instruction_count, r_comp.instruction_count)
+        np.testing.assert_array_equal(
+            r_raw.execution_stall_ps, r_comp.execution_stall_ps)
+        np.testing.assert_array_equal(
+            r_raw.total_packet_latency_ps, r_comp.total_packet_latency_ps)
+
+    def test_bblock_models_disabled_zero_cost(self):
+        sc = make_config(n_tiles=1, scheme="lax",
+                         extra="[general]\n"
+                               "trigger_models_within_application = true")
+        b = TraceBuilder()
+        b.bblock(100, 100)
+        r = run(sc, [b])
+        assert r.clock_ps[0] == 0
+        assert r.instruction_count[0] == 0
+
 
 class TestDeterminism:
     def test_bitwise_reproducible(self):
