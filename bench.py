@@ -1,7 +1,7 @@
 """Benchmark: aggregate simulated instructions/second on one chip.
 
 North star (BASELINE.json): ≥10M aggregate simulated instr/s at 1024 tiles.
-This round's kernel: a 256-tile compute+message workload (nearest-neighbor
+The kernel: a compute+message workload (BENCH_TILES, default 1024 tiles) (nearest-neighbor
 pattern over the e-mesh, hop-counter NoC timing) replayed through the full
 vectorized core/network/sync stack.  Prints exactly one JSON line.
 """

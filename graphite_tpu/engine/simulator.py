@@ -26,9 +26,6 @@ from graphite_tpu.models.network_user import UserNetworkParams
 from graphite_tpu.time_types import ns_to_ps, ps_to_ns
 from graphite_tpu.trace.schema import STATIC_COST_KEYS, Op, TraceBatch
 
-LAX_INFINITE_QUANTUM_PS = 2**61
-
-
 class DeadlockError(RuntimeError):
     pass
 
@@ -116,6 +113,7 @@ class Simulator:
         bp_size: int | None = None,
         n_barriers: int = 64,
         n_mutexes: int = 64,
+        n_conds: int = 64,
         mesh=None,
     ):
         if isinstance(config, str):
@@ -131,13 +129,6 @@ class Simulator:
                 f"trace has {n_tiles} tiles but config expects "
                 f"{config.application_tiles} application tiles"
             )
-        unsupported = {int(Op.COND_WAIT)}
-        present = set(np.unique(trace.op).tolist())
-        if present & unsupported:
-            raise NotImplementedError(
-                "COND_WAIT trace events need the full sync engine (pending)"
-            )
-
         costs = tuple(
             cfg.get_int(f"core/static_instruction_costs/{k}", 0)
             for k in STATIC_COST_KEYS
@@ -179,6 +170,7 @@ class Simulator:
             ),
             mailbox_depth=mailbox_depth,
             inner_block=inner_block,
+            n_conds=n_conds,
             mem=mem_params,
             user_hbh=user_hbh,
         )
@@ -208,6 +200,7 @@ class Simulator:
             mailbox_depth=mailbox_depth,
             n_barriers=n_barriers,
             n_mutexes=n_mutexes,
+            n_conds=n_conds,
             models_enabled=models_on,
         )
         if mem_params is not None:
@@ -229,11 +222,6 @@ class Simulator:
             )
         self._runner = None
         self._runner_max_quanta = None
-
-    def _next_boundary(self, clock_ps: int) -> int:
-        """First quantum boundary strictly above clock_ps."""
-        q = self.quantum_ps
-        return (clock_ps // q + 1) * q
 
     def _get_runner(self, max_quanta: int):
         from graphite_tpu.engine.step import make_simulation_runner

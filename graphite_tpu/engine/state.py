@@ -78,6 +78,19 @@ class SyncState:
     mutex_owner: jax.Array       # int32[NM]
     mutex_time_ps: jax.Array     # int64[NM] — time of last lock/unlock
     mutex_waiting: jax.Array     # bool[T] — tile has a pending lock request
+    # condition variables (`sync_server.cc` SimCond): a tile at a COND_WAIT
+    # record is either waiting (in the FIFO, mutex released), or signaled
+    # (woken, re-acquiring the mutex).  Signals/broadcasts park in per-cond
+    # pending slots stamped with their simulated time and are delivered in
+    # simulated-time order — to a waiter whose wait began at or before the
+    # signal — or dropped once provably lost (pthread lost-signal
+    # semantics), regardless of engine-iteration arrival order.
+    cond_waiting: jax.Array      # bool[T]
+    cond_signaled: jax.Array     # bool[T]
+    cond_arrival_ps: jax.Array   # int64[T] — wait arrival (FIFO order key)
+    cond_wake_ps: jax.Array      # int64[T] — signal/broadcast time
+    cond_sig_time_ps: jax.Array  # int64[NC, K] — pending signals (FAR=empty)
+    cond_bcast_time_ps: jax.Array  # int64[NC] — pending broadcast (FAR=none)
 
 
 @struct.dataclass
@@ -132,6 +145,8 @@ def init_state(
     mailbox_depth: int = 8,
     n_barriers: int = 64,
     n_mutexes: int = 64,
+    n_conds: int = 64,
+    n_pending_signals: int = 4,
     models_enabled: bool = True,
 ) -> SimState:
     T, D = n_tiles, mailbox_depth
@@ -172,6 +187,12 @@ def init_state(
         mutex_owner=jnp.full(n_mutexes, -1, jnp.int32),
         mutex_time_ps=jnp.zeros(n_mutexes, i64),
         mutex_waiting=jnp.zeros(T, jnp.bool_),
+        cond_waiting=jnp.zeros(T, jnp.bool_),
+        cond_signaled=jnp.zeros(T, jnp.bool_),
+        cond_arrival_ps=jnp.zeros(T, i64),
+        cond_wake_ps=jnp.zeros(T, i64),
+        cond_sig_time_ps=jnp.full((n_conds, n_pending_signals), 2**62, i64),
+        cond_bcast_time_ps=jnp.full(n_conds, 2**62, i64),
     )
     return SimState(
         core=core,

@@ -69,6 +69,7 @@ class EngineParams:
     bp_mispredict_penalty: int = 14
     mailbox_depth: int = 8
     inner_block: int = 32      # trace records per tile per scan
+    n_conds: int = 64          # cond-variable id space (sync tables)
     # memory subsystem (None = enable_shared_mem false: memory operands
     # cost nothing, like the reference's disabled shared-mem knob)
     mem: "object" = None       # MemParams | None
@@ -169,8 +170,9 @@ def subquantum_iteration(
         | is_binit | is_minit | is_munlock
         | (op == Op.ENABLE_MODELS) | (op == Op.DISABLE_MODELS)
         | (op == Op.DVFS_SET) | (op == Op.DVFS_GET)
-        | (op == Op.COND_INIT)  # cond signal/broadcast/wait handled in sync engine
-        | (op == Op.COND_SIGNAL) | (op == Op.COND_BROADCAST)
+        | (op == Op.COND_INIT)  # effects applied in the mutex+cond block
+        # COND_SIGNAL/COND_BROADCAST commit conditionally (cond_post_commit):
+        # surplus same-iteration posters retry, so they are NOT simple
     )
 
     # --- static + dynamic instruction costs ------------------------------
@@ -329,61 +331,184 @@ def subquantum_iteration(
     barrier_wait_ps = jnp.maximum(release_time - core.clock_ps, 0)
     barrier_wait_ps = jnp.where(released, barrier_wait_ps, 0)
 
-    # --- MUTEX -----------------------------------------------------------
+    # --- MUTEX + COND ----------------------------------------------------
+    # One gated block: condition variables interlock with mutexes
+    # (COND_WAIT releases its mutex; a signaled waiter re-acquires it —
+    # `sync_server.cc` SimCond::wait/signal/broadcast + SimMutex).
     NM = sync.mutex_locked.shape[0]
+    NC = params.n_conds
+    is_cwait = op == Op.COND_WAIT
+    is_csig = op == Op.COND_SIGNAL
+    is_cbcast = op == Op.COND_BROADCAST
+    is_cinit = op == Op.COND_INIT
+    BIG = jnp.asarray(2**62, I64)
 
-    def _mutex_block(_):
-        mux = jnp.clip(aux0, 0, NM - 1)
+    def _mutex_cond_block(_):
+        mux = jnp.clip(aux0, 0, NM - 1)       # mutex ops' mutex id
+        cw_mux = jnp.clip(aux1, 0, NM - 1)    # COND_WAIT's mutex id (aux1)
+        cid = jnp.clip(aux0, 0, NC - 1)       # cond ops'/waiters' cond id
         minit_now = active & is_minit
         mutex_locked = sync.mutex_locked.at[mux].add(
             jnp.where(minit_now, -sync.mutex_locked[mux], 0)
         )
-        # candidates: tiles at MUTEX_LOCK (waiting or arriving now)
-        lock_candidate = is_mlock & ~done & (sync.mutex_waiting | active)
-        cand_mux = jnp.where(lock_candidate, mux, NM)  # NM = "none" bucket
-        grant_key = core.clock_ps * jnp.asarray(T, I64) + tiles.astype(I64)
-        masked_key = jnp.where(
-            lock_candidate, grant_key, jnp.asarray(2**62, I64))
+        # COND_WAIT arrival: join the FIFO (key = arrival time) and release
+        # the mutex below (`SimCond::wait` pushes the waiter then unlocks)
+        cwait_arrive = (active & is_cwait
+                        & ~sync.cond_waiting & ~sync.cond_signaled)
+        cond_waiting = sync.cond_waiting | cwait_arrive
+        cond_arrival = jnp.where(
+            cwait_arrive, core.clock_ps, sync.cond_arrival_ps)
+
+        # --- signal/broadcast posting --------------------------------------
+        # Engine-iteration order is NOT simulated-time order (a tile can be
+        # behind in records yet ahead in time), so signals park in per-cond
+        # pending slots stamped with their simulated time; delivery below
+        # resolves them in simulated-time order.  One signal per cond per
+        # iteration is accepted (the earliest by (time, tile)); surplus
+        # same-iteration signalers simply do not commit their record and
+        # retry next iteration (clock unchanged — timing unaffected).
+        psig = sync.cond_sig_time_ps            # [NC, K], FAR = empty
+        pbc = sync.cond_bcast_time_ps           # [NC],    FAR = none
+        # COND_INIT resets the cond's pending state
+        cinit_now = active & is_cinit
+        init_cond = jnp.zeros((NC,), jnp.bool_).at[cid].max(cinit_now)
+        psig = jnp.where(init_cond[:, None], BIG, psig)
+        pbc = jnp.where(init_cond, BIG, pbc)
+        sig_now = active & is_csig
+        bcast_now = active & is_cbcast
+        post_key = core.clock_ps * jnp.asarray(T, I64) + tiles.astype(I64)
+        sbest = (
+            jnp.full((NC + 1,), 2**62, I64)
+            .at[jnp.where(sig_now, cid, NC)]
+            .min(jnp.where(sig_now, post_key, BIG))
+        )[:NC]
+        sig_elect = sig_now & (post_key == sbest[cid])
+        free = psig >= FAR_FUTURE_PS            # [NC, K]
+        have_free = free.any(axis=1)
+        free_k = jnp.argmax(free, axis=1).astype(jnp.int32)
+        sig_post = sig_elect & have_free[cid]
+        psig = psig.at[cid, free_k[cid]].min(
+            jnp.where(sig_post, core.clock_ps, BIG))
+        bbest = (
+            jnp.full((NC + 1,), 2**62, I64)
+            .at[jnp.where(bcast_now, cid, NC)]
+            .min(jnp.where(bcast_now, post_key, BIG))
+        )[:NC]
+        bc_elect = bcast_now & (post_key == bbest[cid])
+        bc_post = bc_elect & (pbc[cid] >= FAR_FUTURE_PS)
+        pbc = pbc.at[cid].min(jnp.where(bc_post, core.clock_ps, BIG))
+
+        # --- delivery / drop, in simulated-time order ----------------------
+        # A pending signal S wakes the earliest eligible waiter (wait began
+        # at W <= S).  Resolution waits until engine order can no longer
+        # contradict simulated-time order: deliver when the chosen waiter's
+        # W precedes every still-running tile's clock (no future wait can
+        # beat it), and drop as LOST when every still-running tile is past
+        # S with no eligible waiter (no future wait can be eligible).
+        runner = ~done & ~cond_waiting & ~sync.cond_signaled
+        min_active = jnp.min(jnp.where(runner, core.clock_ps, BIG))
+        S = jnp.min(psig, axis=1)               # [NC] earliest pending
+        s_k = jnp.argmin(psig, axis=1).astype(jnp.int32)
+        have_sig = S < FAR_FUTURE_PS
+        elig = cond_waiting & (cond_arrival <= S[cid])
+        wake_key = cond_arrival * jnp.asarray(T, I64) + tiles.astype(I64)
+        ckey = jnp.where(elig, wake_key, BIG)
+        cbest = (
+            jnp.full((NC + 1,), 2**62, I64)
+            .at[jnp.where(elig, cid, NC)].min(ckey)
+        )[:NC]
+        any_elig = cbest < BIG
+        best_arrival = cbest // jnp.asarray(T, I64)
+        safe_deliver = have_sig & any_elig & (best_arrival < min_active)
+        lost = have_sig & ~any_elig & (min_active > S)
+        woken_s = elig & safe_deliver[cid] & (ckey == cbest[cid])
+        clear_slot = safe_deliver | lost
+        psig = psig.at[jnp.arange(NC), s_k].max(
+            jnp.where(clear_slot, BIG, 0))
+        # pending broadcast: resolves once every still-running tile is past
+        # its time — wakes every waiter with W <= S_bcast, then clears
+        bc_time = pbc                           # [NC] pre-clear times
+        bc_ready = (bc_time < FAR_FUTURE_PS) & (min_active > bc_time)
+        woken_b = (cond_waiting & bc_ready[cid]
+                   & (cond_arrival <= bc_time[cid]) & ~woken_s)
+        pbc = jnp.where(bc_ready, BIG, pbc)
+
+        woken = woken_b | woken_s
+        cond_wake = jnp.where(
+            woken_b, bc_time[cid],
+            jnp.where(woken_s, S[cid], sync.cond_wake_ps))
+        cond_signaled = sync.cond_signaled | woken
+        cond_waiting = cond_waiting & ~woken
+
+        # lock candidates: MUTEX_LOCK lanes + signaled COND_WAIT lanes
+        # re-acquiring their mutex (`SimCond::signal` → `SimMutex::lock`)
+        relock = is_cwait & ~done & cond_signaled
+        plain_lock = is_mlock & ~done & (sync.mutex_waiting | active)
+        lock_candidate = plain_lock | relock
+        lmux = jnp.where(relock, cw_mux, mux)
+        eff_clock = jnp.where(
+            relock, jnp.maximum(core.clock_ps, cond_wake), core.clock_ps)
+        cand_mux = jnp.where(lock_candidate, lmux, NM)  # NM = "none"
+        grant_key = eff_clock * jnp.asarray(T, I64) + tiles.astype(I64)
+        masked_key = jnp.where(lock_candidate, grant_key, BIG)
         best_key = (
             jnp.full((NM + 1,), 2**62, I64).at[cand_mux].min(masked_key)
         )[:NM]
         grantable = mutex_locked == 0
-        granted = lock_candidate & grantable[mux] & (
-            masked_key == best_key[mux])
-        mutex_grab_time = sync.mutex_time_ps[mux]
-        mutex_wait_ps = jnp.maximum(mutex_grab_time - core.clock_ps, 0)
+        granted = lock_candidate & grantable[lmux] & (
+            masked_key == best_key[lmux])
+        mutex_grab_time = sync.mutex_time_ps[lmux]
+        # wait until: the mutex handoff, and for woken waiters the signal
+        # time — clock_new = clock + wait = max(clock, wake, grab)
+        wait_until = jnp.where(
+            relock, jnp.maximum(mutex_grab_time, cond_wake),
+            mutex_grab_time)
+        mutex_wait_ps = jnp.maximum(wait_until - core.clock_ps, 0)
         mutex_wait_ps = jnp.where(granted, mutex_wait_ps, 0)
         # grant is unique per mutex (key includes tile id), unlock unique
         # per mutex (single owner), so add-deltas cannot double-apply
-        mutex_locked = mutex_locked.at[mux].add(jnp.where(granted, 1, 0))
-        mutex_owner = sync.mutex_owner.at[mux].add(
-            jnp.where(granted, tiles - sync.mutex_owner[mux], 0)
+        mutex_locked = mutex_locked.at[lmux].add(jnp.where(granted, 1, 0))
+        mutex_owner = sync.mutex_owner.at[lmux].add(
+            jnp.where(granted, tiles - sync.mutex_owner[lmux], 0)
         )
-        mutex_waiting = (lock_candidate & ~granted) | (
+        mutex_waiting = (plain_lock & ~granted) | (
             sync.mutex_waiting & ~is_mlock
         )
-        # unlock: free + stamp handoff time (`sync_server.cc:211-240`)
+        cond_signaled = cond_signaled & ~granted  # commit clears the flag
+        # unlock: explicit MUTEX_UNLOCK, or COND_WAIT arrival releasing its
+        # mutex; stamp the handoff time (`sync_server.cc:211-240`)
         unlock_now = active & is_munlock
-        mutex_locked = mutex_locked.at[mux].add(jnp.where(unlock_now, -1, 0))
-        mutex_owner = mutex_owner.at[mux].add(
-            jnp.where(unlock_now, -1 - mutex_owner[mux], 0)
+        un_do = unlock_now | cwait_arrive
+        un_mux = jnp.where(cwait_arrive, cw_mux, mux)
+        mutex_locked = mutex_locked.at[un_mux].add(jnp.where(un_do, -1, 0))
+        mutex_owner = mutex_owner.at[un_mux].add(
+            jnp.where(un_do, -1 - mutex_owner[un_mux], 0)
         )
-        mutex_time = sync.mutex_time_ps.at[mux].add(
-            jnp.where(unlock_now, core.clock_ps - sync.mutex_time_ps[mux], 0)
+        mutex_time = sync.mutex_time_ps.at[un_mux].add(
+            jnp.where(un_do, core.clock_ps - sync.mutex_time_ps[un_mux], 0)
         )
         return (mutex_locked, mutex_owner, mutex_time, mutex_waiting,
-                granted, mutex_wait_ps)
+                granted, mutex_wait_ps, cond_waiting, cond_signaled,
+                cond_arrival, cond_wake, psig, pbc,
+                sig_post | bc_post)
 
-    def _mutex_skip(_):
+    def _mutex_cond_skip(_):
         return (sync.mutex_locked, sync.mutex_owner, sync.mutex_time_ps,
                 sync.mutex_waiting, jnp.zeros((T,), jnp.bool_),
-                jnp.zeros((T,), I64))
+                jnp.zeros((T,), I64), sync.cond_waiting, sync.cond_signaled,
+                sync.cond_arrival_ps, sync.cond_wake_ps,
+                sync.cond_sig_time_ps, sync.cond_bcast_time_ps,
+                jnp.zeros((T,), jnp.bool_))
 
     (mutex_locked, mutex_owner, mutex_time, mutex_waiting, granted,
-     mutex_wait_ps) = lax.cond(
-        jnp.any((active & (is_minit | is_munlock))
-                | (is_mlock & ~done & (sync.mutex_waiting | active))),
-        _mutex_block, _mutex_skip, None)
+     mutex_wait_ps, cond_waiting, cond_signaled, cond_arrival_ps,
+     cond_wake_ps, cond_sig_time_ps, cond_bcast_time_ps,
+     cond_post_commit) = lax.cond(
+        jnp.any((active & (is_minit | is_munlock | is_csig | is_cbcast
+                           | is_cinit))
+                | (is_mlock & ~done & (sync.mutex_waiting | active))
+                | (is_cwait & ~done)),
+        _mutex_cond_block, _mutex_cond_skip, None)
 
     # --- JOIN ------------------------------------------------------------
     def _join_block(_):
@@ -410,7 +535,7 @@ def subquantum_iteration(
         | is_simple_event | is_send
     )
     advance = advance | recv_now | released | (active & is_spawn_instr)
-    advance = advance | granted | join_now
+    advance = advance | granted | join_now | cond_post_commit
 
     clock = core.clock_ps
     clock = jnp.where(advance & (instr_like | is_bblock
@@ -491,6 +616,12 @@ def subquantum_iteration(
         mutex_owner=mutex_owner,
         mutex_time_ps=mutex_time,
         mutex_waiting=mutex_waiting,
+        cond_waiting=cond_waiting,
+        cond_signaled=cond_signaled,
+        cond_arrival_ps=cond_arrival_ps,
+        cond_wake_ps=cond_wake_ps,
+        cond_sig_time_ps=cond_sig_time_ps,
+        cond_bcast_time_ps=cond_bcast_time_ps,
     )
     enable_now = jnp.any(active & (op == Op.ENABLE_MODELS))
     disable_now = jnp.any(active & (op == Op.DISABLE_MODELS))
@@ -557,20 +688,10 @@ def run_quantum(
     (`carbon_sim.cfg:92-97`).  Deliberately NOT a module-level
     `jit(static_argnums=0)`: jitting here with dataclass static args hits a
     jax-0.9 dispatch bug (constant-buffer miscount after topology changes);
-    callers jit a closure instead (`make_quantum_step`).
+    callers jit a closure instead (see `make_simulation_runner`).
     """
     state, _ = _quantum_loop(params, trace, state, qend)
     return state
-
-
-def make_quantum_step(params: EngineParams, trace: DeviceTrace):
-    """Bind params/trace into a per-instance jitted step for the host loop."""
-
-    @jax.jit
-    def step(state: SimState, qend: jax.Array) -> SimState:
-        return run_quantum(params, trace, state, qend)
-
-    return step
 
 
 def run_simulation(

@@ -63,7 +63,7 @@ from graphite_tpu.memory.state import (
 from graphite_tpu.time_types import cycles_to_ps
 from graphite_tpu.trace.schema import (
     FLAG_CHECK, FLAG_MEM0_VALID, FLAG_MEM0_WRITE, FLAG_MEM1_VALID,
-    FLAG_MEM1_WRITE,
+    FLAG_MEM1_WRITE, Op,
 )
 
 I64 = jnp.int64
@@ -289,7 +289,7 @@ def memory_engine_step(
     # documented approximation of per-line fetches).  step.py commits
     # dynamic ops (15-19) without waiting on mem_ok, so giving them a fetch
     # slot would leave an in-flight transaction behind.
-    is_instr = (rec.op < 15) | (rec.op == 50)
+    is_instr = (rec.op < 15) | (rec.op == int(Op.BBLOCK))
     icache_present = (
         jnp.asarray(mp.icache_modeling)
         & jnp.asarray(enabled)

@@ -45,6 +45,7 @@ def _tile_spec(leaf: jax.Array) -> P:
 _REPLICATED_STATE_FIELDS = {
     "barrier_count", "barrier_arrived", "barrier_time_ps",
     "mutex_locked", "mutex_owner", "mutex_time_ps",
+    "cond_sig_time_ps", "cond_bcast_time_ps",
     "models_enabled", "overflow",
     # functional word store: a global address space, replicated (the
     # coherence protocol serializes conflicting writes)

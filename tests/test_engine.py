@@ -263,6 +263,77 @@ class TestSync:
         assert r.clock_ps[2] == 6000
 
 
+class TestCondVars:
+    """SimCond semantics (`sync_server.cc` SimCond::wait/signal/broadcast):
+    wait releases the mutex and joins the FIFO; signal wakes the earliest
+    waiter, who re-acquires the mutex; broadcast wakes all; a signal with
+    no waiter is lost (pthread semantics)."""
+
+    def test_wait_signal_producer_consumer(self):
+        # consumer: lock, wait (releases mutex); producer: compute, lock,
+        # compute, signal, unlock — consumer resumes at
+        # max(signal time, mutex handoff time)
+        b1 = TraceBuilder().mutex_init(0).cond_init(0).mutex_lock(0)
+        b1.cond_wait(0, 0).instr(Op.IALU).mutex_unlock(0)
+        b0 = TraceBuilder()
+        for _ in range(3):
+            b0.instr(Op.IALU)
+        b0.mutex_lock(0)          # @3000 — proves wait released the mutex
+        for _ in range(2):
+            b0.instr(Op.IALU)
+        b0.cond_signal(0).mutex_unlock(0)
+        r = run(make_config(n_tiles=2), [b0, b1])
+        assert r.clock_ps[0] == 5000
+        # woken at 5000, +1 ialu = 6000
+        assert r.clock_ps[1] == 6000
+        assert r.sync_stall_ps[1] == 5000
+        assert r.sync_instructions[1] >= 1
+
+    def test_broadcast_wakes_all_serialized_relock(self):
+        waiters = []
+        for t in range(3):
+            b = TraceBuilder()
+            if t == 0:
+                b.mutex_init(0).cond_init(0)
+            b.mutex_lock(0).cond_wait(0, 0).instr(Op.IALU).mutex_unlock(0)
+            waiters.append(b)
+        b0 = TraceBuilder()
+        for _ in range(5):
+            b0.instr(Op.IALU)
+        b0.mutex_lock(0).cond_broadcast(0).mutex_unlock(0)
+        r = run(make_config(), [b0] + waiters)
+        assert r.clock_ps[0] == 5000
+        # woken together at 5000; mutex re-acquisition serializes in tile
+        # order (deterministic FIFO key = (wake time, tile))
+        assert r.clock_ps[1] == 6000
+        assert r.clock_ps[2] == 7000
+        assert r.clock_ps[3] == 8000
+
+    def test_signal_without_waiter_is_lost(self):
+        b0 = TraceBuilder().cond_init(0).mutex_init(0).cond_signal(0)
+        b1 = TraceBuilder().instr(Op.IALU).mutex_lock(0).cond_wait(0, 0)
+        with pytest.raises(DeadlockError):
+            run(make_config(n_tiles=2), [b0, b1])
+
+    def test_signal_wakes_fifo_earliest(self):
+        # two waiters arriving at 1000 and 2000; one signal at 5000 wakes
+        # the earlier one only; a second signal at 7000 wakes the other
+        w1 = TraceBuilder().instr(Op.IALU).mutex_lock(0)
+        w1.cond_wait(0, 0).mutex_unlock(0)
+        w2 = TraceBuilder().instr(Op.IALU).instr(Op.IALU).mutex_lock(0)
+        w2.cond_wait(0, 0).mutex_unlock(0)
+        b0 = TraceBuilder().mutex_init(0).cond_init(0)
+        for _ in range(5):
+            b0.instr(Op.IALU)
+        b0.cond_signal(0)
+        for _ in range(2):
+            b0.instr(Op.IALU)
+        b0.cond_signal(0)
+        r = run(make_config(), [b0, w1, w2, TraceBuilder()])
+        assert r.clock_ps[1] == 5000   # woken by first signal
+        assert r.clock_ps[2] == 7000   # woken by second signal
+
+
 class TestThreads:
     def test_join_waits_for_target_exit(self):
         b0 = TraceBuilder().thread_spawn(1).thread_join(1).instr(Op.IALU)
