@@ -58,6 +58,9 @@ class SimResults:
     # memory-subsystem counters (per-tile arrays), None when no memory model
     mem_counters: "dict | None" = None
     func_errors: int = 0
+    # iocoom detailed stall breakdown (`iocoom_core_model.cc:64-77`),
+    # None for the simple core model
+    detailed_stalls: "dict | None" = None
 
     @property
     def total_instructions(self) -> int:
@@ -86,6 +89,29 @@ class SimResults:
                        f"{ps_to_ns(int(self.sync_stall_ps[t]))}")
             out.append("      Network Recv: "
                        f"{ps_to_ns(int(self.recv_stall_ps[t]))}")
+            if self.detailed_stalls is not None:
+                # `iocoom_core_model.cc:64-77` outputSummary
+                ds = self.detailed_stalls
+                out.append("    Detailed Stall Time Breakdown "
+                           "(in nanoseconds): ")
+                out.append(f"      Load Queue: "
+                           f"{ps_to_ns(int(ds['load_queue'][t]))}")
+                out.append(f"      Store Queue: "
+                           f"{ps_to_ns(int(ds['store_queue'][t]))}")
+                out.append(f"      L1-I Cache: "
+                           f"{ps_to_ns(int(ds['l1icache'][t]))}")
+                out.append(
+                    "      L1-D Cache (Intra-Instruction): "
+                    f"{ps_to_ns(int(ds['intra_ins_l1dcache'][t]))}")
+                out.append(
+                    "      L1-D Cache (Inter-Instruction): "
+                    f"{ps_to_ns(int(ds['inter_ins_l1dcache'][t]))}")
+                out.append(
+                    "      Execution Unit (Intra-Instruction): "
+                    f"{ps_to_ns(int(ds['intra_ins_execution_unit'][t]))}")
+                out.append(
+                    "      Execution Unit (Inter-Instruction): "
+                    f"{ps_to_ns(int(ds['inter_ins_execution_unit'][t]))}")
             bp_total = int(self.bp_correct[t] + self.bp_incorrect[t])
             if bp_total:
                 out.append("    Branch Predictor:")
@@ -159,6 +185,17 @@ class Simulator:
             from graphite_tpu.models.network_hop_by_hop import HopByHopParams
 
             user_hbh = HopByHopParams.from_config(config, "user")
+        # Core model from the `[tile] model_list` (`carbon_sim.cfg:158-176`;
+        # default model_list uses iocoom).  Homogeneous for now: tile 0's
+        # core type selects the model.
+        iocoom_params = None
+        core_type = config.tile_specs[0].core_type
+        if core_type == "iocoom":
+            from graphite_tpu.models.iocoom import IocoomParams
+
+            iocoom_params = IocoomParams.from_config(cfg)
+        elif core_type not in ("simple", "default", "magic"):
+            raise NotImplementedError(f"core model {core_type!r}")
         self.params = EngineParams(
             n_tiles=n_tiles,
             static_cost_cycles=costs,
@@ -171,6 +208,7 @@ class Simulator:
             mailbox_depth=mailbox_depth,
             inner_block=inner_block,
             n_conds=n_conds,
+            iocoom=iocoom_params,
             mem=mem_params,
             user_hbh=user_hbh,
         )
@@ -211,6 +249,11 @@ class Simulator:
             from graphite_tpu.models.network_hop_by_hop import init_noc_state
 
             self.state = self.state.replace(noc_user=init_noc_state(user_hbh))
+        if iocoom_params is not None:
+            from graphite_tpu.models.iocoom import init_iocoom_state
+
+            self.state = self.state.replace(
+                ioc=init_iocoom_state(n_tiles, iocoom_params))
         self.device_trace = DeviceTrace.from_batch(trace)
         if mesh is not None:
             # Shard the tile axis over the device mesh (SURVEY §2.10): the
@@ -261,14 +304,29 @@ class Simulator:
             (state.mem.counters, state.mem.func_errors)
             if state.mem is not None else None
         )
+        ioc_part = (
+            {
+                "load_queue": state.ioc.load_queue_stall_ps,
+                "store_queue": state.ioc.store_queue_stall_ps,
+                "l1icache": state.ioc.l1icache_stall_ps,
+                "intra_ins_l1dcache": state.ioc.intra_ins_l1dcache_stall_ps,
+                "inter_ins_l1dcache": state.ioc.inter_ins_l1dcache_stall_ps,
+                "intra_ins_execution_unit":
+                    state.ioc.intra_ins_execution_unit_stall_ps,
+                "inter_ins_execution_unit":
+                    state.ioc.inter_ins_execution_unit_stall_ps,
+            }
+            if state.ioc is not None else None
+        )
         host = jax.device_get((
             n_quanta_dev, deadlock_dev, state.net.overflow, state.done,
             state.core,
             (state.net.packets_sent, state.net.packets_received,
              state.net.total_latency_ps),
-            mem_part,
+            mem_part, ioc_part,
         ))
-        (n_quanta, deadlock, overflow, done, core_h, net_h, mem_h) = host
+        (n_quanta, deadlock, overflow, done, core_h, net_h, mem_h,
+         ioc_h) = host
         if bool(overflow):
             raise MailboxOverflowError(
                 "a (dst,src) mailbox ring overflowed; re-run with a "
@@ -283,9 +341,10 @@ class Simulator:
         if not bool(done.all()):
             raise RuntimeError(f"exceeded max_quanta={max_quanta}")
         self.state = state
-        return self._results_host(core_h, net_h, mem_h, int(n_quanta))
+        return self._results_host(core_h, net_h, mem_h, int(n_quanta), ioc_h)
 
-    def _results_host(self, core, net_h, mem_h, n_quanta: int) -> SimResults:
+    def _results_host(self, core, net_h, mem_h, n_quanta: int,
+                      ioc_h=None) -> SimResults:
         """Assemble SimResults from already-fetched host arrays."""
         clock = np.asarray(core.clock_ps)
         mem_counters = None
@@ -319,5 +378,8 @@ class Simulator:
             n_quanta=n_quanta,
             mem_counters=mem_counters,
             func_errors=func_errors,
+            detailed_stalls=(
+                {k: np.asarray(v) for k, v in ioc_h.items()}
+                if ioc_h is not None else None),
         )
 

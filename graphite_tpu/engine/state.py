@@ -106,6 +106,8 @@ class SimState:
     # USER-network hop-by-hop port-contention state (None unless
     # network/user = emesh_hop_by_hop)
     noc_user: "object" = None
+    # iocoom core-model state (None unless core type = iocoom)
+    ioc: "object" = None
 
 
 @struct.dataclass
@@ -122,6 +124,9 @@ class DeviceTrace:
     aux0: jax.Array
     aux1: jax.Array
     dyn_ps: jax.Array
+    rreg0: jax.Array
+    rreg1: jax.Array
+    wreg: jax.Array
 
     @classmethod
     def from_batch(cls, batch: TraceBatch) -> "DeviceTrace":

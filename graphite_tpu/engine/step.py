@@ -70,6 +70,8 @@ class EngineParams:
     mailbox_depth: int = 8
     inner_block: int = 32      # trace records per tile per scan
     n_conds: int = 64          # cond-variable id space (sync tables)
+    # iocoom core model (None = simple 1-IPC in-order model)
+    iocoom: "object" = None    # IocoomParams | None
     # memory subsystem (None = enable_shared_mem false: memory operands
     # cost nothing, like the reference's disabled shared-mem knob)
     mem: "object" = None       # MemParams | None
@@ -79,6 +81,19 @@ class EngineParams:
 
 def _gather_field(field: jax.Array, idx: jax.Array) -> jax.Array:
     return jnp.take_along_axis(field, idx[:, None], axis=1)[:, 0]
+
+
+def _elect_min(mask, gid, key, n_groups):
+    """Per-group minimum of `key` over lanes with `mask`, via a scatter-min
+    into group buckets (bucket n_groups collects masked-off lanes).
+    Returns int64[n_groups]; empty groups hold 2**62.  A lane wins its
+    group's election iff mask & (key == result[gid])."""
+    best = (
+        jnp.full((n_groups + 1,), 2**62, I64)
+        .at[jnp.where(mask, gid, n_groups)]
+        .min(jnp.where(mask, key, jnp.asarray(2**62, I64)))
+    )
+    return best[:n_groups]
 
 
 
@@ -103,7 +118,9 @@ def subquantum_iteration(
     # have diverged (blocked on sync/messages).
     gather_fields = (trace.op, trace.flags, trace.pc, trace.aux0, trace.aux1,
                      trace.dyn_ps) + (
-        (trace.addr0, trace.addr1) if params.mem is not None else ())
+        (trace.addr0, trace.addr1) if params.mem is not None else ()) + (
+        (trace.rreg0, trace.rreg1, trace.wreg)
+        if params.iocoom is not None else ())
     uniform = jnp.all(idx == idx[0])
 
     def _read_uniform(_):
@@ -377,11 +394,7 @@ def subquantum_iteration(
         sig_now = active & is_csig
         bcast_now = active & is_cbcast
         post_key = core.clock_ps * jnp.asarray(T, I64) + tiles.astype(I64)
-        sbest = (
-            jnp.full((NC + 1,), 2**62, I64)
-            .at[jnp.where(sig_now, cid, NC)]
-            .min(jnp.where(sig_now, post_key, BIG))
-        )[:NC]
+        sbest = _elect_min(sig_now, cid, post_key, NC)
         sig_elect = sig_now & (post_key == sbest[cid])
         free = psig >= FAR_FUTURE_PS            # [NC, K]
         have_free = free.any(axis=1)
@@ -389,11 +402,7 @@ def subquantum_iteration(
         sig_post = sig_elect & have_free[cid]
         psig = psig.at[cid, free_k[cid]].min(
             jnp.where(sig_post, core.clock_ps, BIG))
-        bbest = (
-            jnp.full((NC + 1,), 2**62, I64)
-            .at[jnp.where(bcast_now, cid, NC)]
-            .min(jnp.where(bcast_now, post_key, BIG))
-        )[:NC]
+        bbest = _elect_min(bcast_now, cid, post_key, NC)
         bc_elect = bcast_now & (post_key == bbest[cid])
         bc_post = bc_elect & (pbc[cid] >= FAR_FUTURE_PS)
         pbc = pbc.at[cid].min(jnp.where(bc_post, core.clock_ps, BIG))
@@ -402,33 +411,36 @@ def subquantum_iteration(
         # A pending signal S wakes the earliest eligible waiter (wait began
         # at W <= S).  Resolution waits until engine order can no longer
         # contradict simulated-time order: deliver when the chosen waiter's
-        # W precedes every still-running tile's clock (no future wait can
-        # beat it), and drop as LOST when every still-running tile is past
-        # S with no eligible waiter (no future wait can be eligible).
+        # W is at or before every still-running tile's clock (a later
+        # registrant could at best tie, and simultaneous wait/signal is a
+        # race even in the reference), and drop as LOST when every
+        # still-running tile has reached S with no eligible waiter.
+        # Comparisons are NON-strict: a tile pinned exactly at the post time
+        # (e.g. the poster blocked on a join) must not hold delivery forever.
+        # A pending broadcast and pending signals on one cond resolve in
+        # simulated-time order, one per iteration — the earlier wakes first
+        # and the later re-evaluates against the remaining waiters.
         runner = ~done & ~cond_waiting & ~sync.cond_signaled
         min_active = jnp.min(jnp.where(runner, core.clock_ps, BIG))
         S = jnp.min(psig, axis=1)               # [NC] earliest pending
         s_k = jnp.argmin(psig, axis=1).astype(jnp.int32)
-        have_sig = S < FAR_FUTURE_PS
+        bc_time = pbc                           # [NC]
+        have_sig = (S < FAR_FUTURE_PS) & (S < bc_time)  # signal resolves 1st
+        bc_first = (bc_time < FAR_FUTURE_PS) & (bc_time <= S)
         elig = cond_waiting & (cond_arrival <= S[cid])
         wake_key = cond_arrival * jnp.asarray(T, I64) + tiles.astype(I64)
         ckey = jnp.where(elig, wake_key, BIG)
-        cbest = (
-            jnp.full((NC + 1,), 2**62, I64)
-            .at[jnp.where(elig, cid, NC)].min(ckey)
-        )[:NC]
+        cbest = _elect_min(elig, cid, ckey, NC)
         any_elig = cbest < BIG
         best_arrival = cbest // jnp.asarray(T, I64)
-        safe_deliver = have_sig & any_elig & (best_arrival < min_active)
-        lost = have_sig & ~any_elig & (min_active > S)
+        safe_deliver = have_sig & any_elig & (best_arrival <= min_active)
+        lost = have_sig & ~any_elig & (min_active >= S)
         woken_s = elig & safe_deliver[cid] & (ckey == cbest[cid])
         clear_slot = safe_deliver | lost
         psig = psig.at[jnp.arange(NC), s_k].max(
             jnp.where(clear_slot, BIG, 0))
-        # pending broadcast: resolves once every still-running tile is past
-        # its time — wakes every waiter with W <= S_bcast, then clears
-        bc_time = pbc                           # [NC] pre-clear times
-        bc_ready = (bc_time < FAR_FUTURE_PS) & (min_active > bc_time)
+        # pending broadcast: wakes every waiter with W <= S_bcast
+        bc_ready = bc_first & (min_active >= bc_time)
         woken_b = (cond_waiting & bc_ready[cid]
                    & (cond_arrival <= bc_time[cid]) & ~woken_s)
         pbc = jnp.where(bc_ready, BIG, pbc)
@@ -448,15 +460,11 @@ def subquantum_iteration(
         lmux = jnp.where(relock, cw_mux, mux)
         eff_clock = jnp.where(
             relock, jnp.maximum(core.clock_ps, cond_wake), core.clock_ps)
-        cand_mux = jnp.where(lock_candidate, lmux, NM)  # NM = "none"
         grant_key = eff_clock * jnp.asarray(T, I64) + tiles.astype(I64)
-        masked_key = jnp.where(lock_candidate, grant_key, BIG)
-        best_key = (
-            jnp.full((NM + 1,), 2**62, I64).at[cand_mux].min(masked_key)
-        )[:NM]
+        best_key = _elect_min(lock_candidate, lmux, grant_key, NM)
         grantable = mutex_locked == 0
         granted = lock_candidate & grantable[lmux] & (
-            masked_key == best_key[lmux])
+            grant_key == best_key[lmux])
         mutex_grab_time = sync.mutex_time_ps[lmux]
         # wait until: the mutex handoff, and for woken waiters the signal
         # time — clock_new = clock + wait = max(clock, wake, grab)
@@ -538,12 +546,50 @@ def subquantum_iteration(
     advance = advance | granted | join_now | cond_post_commit
 
     clock = core.clock_ps
-    clock = jnp.where(advance & (instr_like | is_bblock
-                                 | (is_dynamic & ~is_spawn_instr)
-                                 | is_simple_event | is_send),
-                      clock + cost_ps
-                      + jnp.where(instr_like | is_bblock, mem_acc_ps, 0),
-                      clock)
+    if params.iocoom is not None:
+        # IOCOOM: instruction-like records go through the scoreboard /
+        # load-store queue pipeline algebra; everything else (events,
+        # dynamic, bblock) keeps the simple cost accumulation (the
+        # reference adds dynamic costs directly, `iocoom_core_model.cc:88`)
+        from graphite_tpu.models.iocoom import iocoom_commit
+
+        slot_lat = (mem_out.slot_lat_ps if params.mem is not None
+                    else jnp.zeros((T, 3), I64))
+        ioc_commit_mask = advance & instr_like
+        new_ioc, ioc_clock, ioc_mem_stall, ioc_exec_stall = iocoom_commit(
+            params.iocoom, state.ioc,
+            commit=ioc_commit_mask,
+            clock_ps=core.clock_ps,
+            freq_mhz=core.freq_mhz.astype(I64),
+            cost_ps=cost_ps,
+            flags=flags,
+            rreg0=fetched[-3].astype(jnp.int32),
+            rreg1=fetched[-2].astype(jnp.int32),
+            wreg=fetched[-1].astype(jnp.int32),
+            addr0=(fetched[6] if params.mem is not None
+                   else jnp.zeros((T,), jnp.uint32)),
+            addr1=(fetched[7] if params.mem is not None
+                   else jnp.zeros((T,), jnp.uint32)),
+            slot_lat_ps=slot_lat,
+            enabled=enabled,
+        )
+        clock = jnp.where(advance & (is_bblock
+                                     | (is_dynamic & ~is_spawn_instr)
+                                     | is_simple_event | is_send),
+                          clock + cost_ps
+                          + jnp.where(is_bblock, mem_acc_ps, 0),
+                          clock)
+        clock = jnp.where(ioc_commit_mask, ioc_clock, clock)
+    else:
+        new_ioc = state.ioc
+        ioc_mem_stall = None
+        ioc_exec_stall = None
+        clock = jnp.where(advance & (instr_like | is_bblock
+                                     | (is_dynamic & ~is_spawn_instr)
+                                     | is_simple_event | is_send),
+                          clock + cost_ps
+                          + jnp.where(instr_like | is_bblock, mem_acc_ps, 0),
+                          clock)
     clock = jnp.where(active & is_spawn_instr,
                       jnp.maximum(clock, dyn_ps), clock)
     clock = jnp.where(recv_now, jnp.maximum(clock, recv_time), clock)
@@ -573,10 +619,14 @@ def subquantum_iteration(
         + recv_charged.astype(I64)
         + sync_charged.astype(I64),
         memory_stall_ps=core.memory_stall_ps
-        + jnp.where(advance & (instr_like | is_bblock), mem_acc_ps, 0),
+        + (jnp.where(advance & is_bblock, mem_acc_ps, 0) + ioc_mem_stall
+           if params.iocoom is not None else
+           jnp.where(advance & (instr_like | is_bblock), mem_acc_ps, 0)),
         execution_stall_ps=core.execution_stall_ps
-        + jnp.where(advance & (is_static | is_branch | is_bblock),
-                    cost_ps, 0),
+        + (jnp.where(advance & is_bblock, cost_ps, 0) + ioc_exec_stall
+           if params.iocoom is not None else
+           jnp.where(advance & (is_static | is_branch | is_bblock),
+                     cost_ps, 0)),
         recv_instructions=core.recv_instructions + recv_charged.astype(I64),
         recv_stall_ps=core.recv_stall_ps
         + jnp.where(recv_charged, recv_wait_ps, 0),
@@ -633,6 +683,8 @@ def subquantum_iteration(
         mem_state = mem_state.replace(req=mem_state.req.replace(
             slot=jnp.where(advance, 0, mem_state.req.slot),
             acc_ps=jnp.where(advance, 0, mem_state.req.acc_ps),
+            slot_lat_ps=jnp.where(
+                advance[:, None], 0, mem_state.req.slot_lat_ps),
         ))
     new_state = SimState(
         core=new_core,
@@ -642,6 +694,7 @@ def subquantum_iteration(
         done=done,
         mem=mem_state,
         noc_user=noc_user,
+        ioc=new_ioc,
     )
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 

@@ -171,6 +171,7 @@ class MemStepOut:
     ms: MemState
     mem_complete: jax.Array  # bool[T] all slots of current record done
     acc_ps: jax.Array        # int64[T] memory latency of the record so far
+    slot_lat_ps: jax.Array   # int64[T, 3] per-slot latency [icache, m0, m1]
     progress: jax.Array      # int32[] events this iteration
 
 
@@ -453,6 +454,11 @@ def memory_engine_step(
         clock_ps=jnp.where(l2_miss_go, req_send_ps, ms.req.clock_ps),
         acc_ps=ms.req.acc_ps
         + jnp.where(slot_done_now, slot_done_ps - clock_ps, 0),
+        # per-slot latency for the iocoom operand algebra
+        slot_lat_ps=jnp.where(
+            (slot_done_now[:, None]
+             & (jnp.arange(3)[None, :] == slot[:, None])),
+            (slot_done_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
         instr_buf=new_instr_buf,
         # slot advances on completion; on miss it stays (the reply path
         # advances it); skipped-over absent slots jump to the live one
@@ -525,6 +531,7 @@ def memory_engine_step(
     mem_complete = (ms.req.phase == PHASE_IDLE) & (final_slot >= 3)
     return MemStepOut(
         ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
+        slot_lat_ps=ms.req.slot_lat_ps,
         progress=progress,
     )
 
@@ -1066,6 +1073,10 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
         phase=jnp.where(fill, PHASE_IDLE, ms.req.phase),
         slot=jnp.where(fill, ms.req.slot + 1, ms.req.slot),
         acc_ps=ms.req.acc_ps + jnp.where(fill, done_ps - clock_ps, 0),
+        slot_lat_ps=jnp.where(
+            (fill[:, None]
+             & (jnp.arange(3)[None, :] == ms.req.slot[:, None])),
+            (done_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
     )
     ms = ms.replace(l1i=l1i, l1d=l1d, l2=l2, l2_cloc=l2_cloc, mail=mail,
                     req=req)

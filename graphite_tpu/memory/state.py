@@ -131,6 +131,9 @@ class RequesterState:
     is_write: jax.Array    # bool[T]
     component: jax.Array   # uint8[T] MOD_L1I or MOD_L1D
     instr_buf: jax.Array   # int32[T] instruction-buffer line (`core.cc:207-219`)
+    # per-slot latency of the current record [icache, mem0, mem1] — the
+    # iocoom model needs per-operand latencies (`DynamicMemoryInfo::_latency`)
+    slot_lat_ps: jax.Array  # int64[T, 3]
 
 
 @struct.dataclass
@@ -222,6 +225,7 @@ def init_mem_state(mp: MemParams) -> MemState:
         is_write=jnp.zeros(T, jnp.bool_),
         component=jnp.zeros(T, jnp.uint8),
         instr_buf=jnp.full(T, -1, jnp.int32),
+        slot_lat_ps=jnp.zeros((T, 3), jnp.int64),
     )
     counters = MemCounters(
         l1i_hits=zi64(), l1i_misses=zi64(),

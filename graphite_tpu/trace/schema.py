@@ -51,6 +51,10 @@ FLAG_ATOMIC = 1 << 5
 # FLAG_CHECK compares the loaded word against aux0 and bumps a global
 # functional-error counter on mismatch.
 FLAG_CHECK = 1 << 6
+# MOV whose only memory operand is a single load (`Instruction::
+# isSimpleMovMemoryLoad`): the iocoom model lets the next instruction issue
+# at load-queue allocate time instead of load completion.
+FLAG_SIMPLE_MOV_LOAD = 1 << 7
 
 
 class Op(enum.IntEnum):
@@ -125,6 +129,8 @@ STATIC_COST_KEYS = (
     "dynamic_misc", "recv", "sync", "spawn", "stall",
 )
 
+NO_REG = 0xFFFF  # sentinel: operand slot unused
+
 _FIELDS = (
     ("op", np.uint8),
     ("flags", np.uint8),
@@ -136,6 +142,11 @@ _FIELDS = (
     ("aux0", np.int32),
     ("aux1", np.int32),
     ("dyn_ps", np.int64),
+    # register operands (iocoom scoreboard; `instruction.h` RegisterOperand
+    # lists, bounded to 2 reads + 1 write per record).  NO_REG = unused.
+    ("rreg0", np.uint16),
+    ("rreg1", np.uint16),
+    ("wreg", np.uint16),
 )
 
 
@@ -153,6 +164,9 @@ class TraceBatch:
     aux0: np.ndarray
     aux1: np.ndarray
     dyn_ps: np.ndarray
+    rreg0: np.ndarray
+    rreg1: np.ndarray
+    wreg: np.ndarray
 
     @property
     def n_tiles(self) -> int:
@@ -182,6 +196,8 @@ class TraceBatch:
             name: np.zeros((n, length), dtype=dtype) for name, dtype in _FIELDS
         }
         arrays["op"][:] = int(Op.NOP)
+        for reg_field in ("rreg0", "rreg1", "wreg"):
+            arrays[reg_field][:] = NO_REG
         for t, b in enumerate(builders):
             for name, _ in _FIELDS:
                 col = getattr(b, "_" + name)
@@ -197,7 +213,8 @@ class TraceBuilder:
             setattr(self, "_" + name, [])
 
     def _append(self, op, flags=0, pc=0, addr0=0, addr1=0, size0=0, size1=0,
-                aux0=0, aux1=0, dyn_ps=0) -> "TraceBuilder":
+                aux0=0, aux1=0, dyn_ps=0, rreg0=NO_REG, rreg1=NO_REG,
+                wreg=NO_REG) -> "TraceBuilder":
         self._op.append(int(op))
         self._flags.append(flags)
         self._pc.append(pc)
@@ -208,23 +225,37 @@ class TraceBuilder:
         self._aux0.append(aux0)
         self._aux1.append(aux1)
         self._dyn_ps.append(dyn_ps)
+        self._rreg0.append(rreg0)
+        self._rreg1.append(rreg1)
+        self._wreg.append(wreg)
         return self
 
     # --- instructions ----------------------------------------------------
 
-    def instr(self, op: Op, pc: int = 0) -> "TraceBuilder":
+    def instr(self, op: Op, pc: int = 0, rregs=(), wreg: int = NO_REG,
+              ) -> "TraceBuilder":
         """A compute instruction with no memory operands."""
-        return self._append(op, pc=pc)
+        rr = tuple(rregs) + (NO_REG, NO_REG)
+        return self._append(op, pc=pc, rreg0=rr[0], rreg1=rr[1], wreg=wreg)
 
     def load(self, addr: int, size: int = 4, pc: int = 0,
-             op: Op = Op.MOV) -> "TraceBuilder":
-        return self._append(op, flags=FLAG_MEM0_VALID, pc=pc,
-                            addr0=addr, size0=size)
+             op: Op = Op.MOV, rregs=(), wreg: int = NO_REG,
+             ) -> "TraceBuilder":
+        flags = FLAG_MEM0_VALID
+        if op == Op.MOV:
+            flags |= FLAG_SIMPLE_MOV_LOAD
+        rr = tuple(rregs) + (NO_REG, NO_REG)
+        return self._append(op, flags=flags, pc=pc,
+                            addr0=addr, size0=size,
+                            rreg0=rr[0], rreg1=rr[1], wreg=wreg)
 
     def store(self, addr: int, size: int = 4, pc: int = 0,
-              op: Op = Op.MOV) -> "TraceBuilder":
+              op: Op = Op.MOV, rregs=(), wreg: int = NO_REG,
+              ) -> "TraceBuilder":
+        rr = tuple(rregs) + (NO_REG, NO_REG)
         return self._append(op, flags=FLAG_MEM0_VALID | FLAG_MEM0_WRITE,
-                            pc=pc, addr0=addr, size0=size)
+                            pc=pc, addr0=addr, size0=size,
+                            rreg0=rr[0], rreg1=rr[1], wreg=wreg)
 
     def store_value(self, addr: int, value: int, size: int = 4, pc: int = 0,
                     op: Op = Op.MOV) -> "TraceBuilder":
@@ -236,7 +267,10 @@ class TraceBuilder:
                    pc: int = 0, op: Op = Op.MOV) -> "TraceBuilder":
         """Self-checking load: bumps the functional-error counter unless the
         loaded word equals `expect` (FLAG_CHECK)."""
-        return self._append(op, flags=FLAG_MEM0_VALID | FLAG_CHECK, pc=pc,
+        flags = FLAG_MEM0_VALID | FLAG_CHECK
+        if op == Op.MOV:
+            flags |= FLAG_SIMPLE_MOV_LOAD
+        return self._append(op, flags=flags, pc=pc,
                             addr0=addr, size0=size, aux0=expect)
 
     def load_store(self, raddr: int, waddr: int, size: int = 4,

@@ -207,11 +207,13 @@ def _batch_from_columns(op, *, flags=None, pc=None, aux0=None, aux1=None,
         [op, np.full((n, 1), int(Op.THREAD_EXIT), np.uint8)], axis=1
     )
 
-    def pad(col, dtype):
+    def pad(col, dtype, fill=0):
         if col is None:
-            return np.zeros((n, L + 1), dtype)
+            return np.full((n, L + 1), fill, dtype)
         return np.concatenate([col.astype(dtype),
-                               np.zeros((n, 1), dtype)], axis=1)
+                               np.full((n, 1), fill, dtype)], axis=1)
+
+    from graphite_tpu.trace.schema import NO_REG
 
     return TraceBatch(
         op=op.astype(np.uint8),
@@ -224,6 +226,9 @@ def _batch_from_columns(op, *, flags=None, pc=None, aux0=None, aux1=None,
         aux0=pad(aux0, np.int32),
         aux1=pad(aux1, np.int32),
         dyn_ps=pad(dyn_ps, np.int64),
+        rreg0=pad(None, np.uint16, NO_REG),
+        rreg1=pad(None, np.uint16, NO_REG),
+        wreg=pad(None, np.uint16, NO_REG),
     )
 
 

@@ -315,6 +315,37 @@ class TestCondVars:
         with pytest.raises(DeadlockError):
             run(make_config(n_tiles=2), [b0, b1])
 
+    def test_broadcast_resolves_with_poster_pinned_at_post_time(self):
+        """A poster whose clock stays frozen exactly at the broadcast time
+        (blocked on a join of the waiter) must not hold delivery forever."""
+        b0 = TraceBuilder().mutex_init(0).cond_init(0)
+        for _ in range(5):
+            b0.instr(Op.IALU)
+        b0.mutex_lock(0).cond_broadcast(0).mutex_unlock(0)
+        b0.thread_join(1)     # clock pinned at 5000 until t1 exits
+        b1 = TraceBuilder().mutex_lock(0).cond_wait(0, 0).mutex_unlock(0)
+        r = run(make_config(n_tiles=2), [b0, b1])
+        assert r.clock_ps[1] == 5000
+
+    def test_broadcast_before_signal_orders_by_time(self):
+        """Pending broadcast (t=3000) and pending signal (t=5000) on one
+        cond resolve in simulated-time order: the waiter wakes at the
+        broadcast time; the later signal finds no waiter and is lost."""
+        # a slow third tile keeps min_active low so both park as pending
+        b2 = TraceBuilder()
+        b2.dynamic(Op.STALL, cost_ps=20_000)
+        w = TraceBuilder().instr(Op.IALU).mutex_lock(0).cond_wait(0, 0)
+        w.mutex_unlock(0)
+        b0 = TraceBuilder().mutex_init(0).cond_init(0)
+        for _ in range(3):
+            b0.instr(Op.IALU)
+        b0.cond_broadcast(0)
+        for _ in range(2):
+            b0.instr(Op.IALU)
+        b0.cond_signal(0)
+        r = run(make_config(), [b0, w, b2, TraceBuilder()])
+        assert r.clock_ps[1] == 3000   # woken by the broadcast, not 5000
+
     def test_signal_wakes_fifo_earliest(self):
         # two waiters arriving at 1000 and 2000; one signal at 5000 wakes
         # the earlier one only; a second signal at 7000 wakes the other

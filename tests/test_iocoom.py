@@ -1,0 +1,186 @@
+"""IOCOOM core-model tests: scoreboard + load/store queue timing algebra.
+
+Hand-derived from `iocoom_core_model.cc:79-276`:
+ - a pure-ALU instruction advances the clock only to read_operands_ready
+   (its execution overlaps younger instructions; `:240-248`);
+ - register dependencies serialize through the scoreboard (`:115-146`);
+ - a simple MOV load advances only to load_queue_ready; its write register
+   is stamped LOAD_UNIT at completion+cost (`:185-198,246`);
+ - a store advances to store_queue_ready (`:255-263`);
+ - a load whose line sits in the store queue bypasses in one cycle
+   (`executeLoad`, `isAddressAvailable`).
+
+All tests run with enable_shared_mem=false: memory operand latencies are
+zero, so queue timing is purely the one-cycle check costs — exactly
+hand-computable.
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine import Simulator
+from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+
+def make_config(n_tiles=2):
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = false
+[tile]
+model_list = "<default,iocoom,T1,T1,T1>"
+[core/iocoom]
+num_load_queue_entries = 8
+num_store_queue_entries = 8
+speculative_loads_enabled = true
+multiple_outstanding_RFOs_enabled = true
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+generic = 1
+mov = 1
+ialu = 1
+imul = 3
+[branch_predictor]
+type = one_bit
+mispredict_penalty = 14
+size = 1024
+[clock_skew_management]
+scheme = lax
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+def run(sc, builders):
+    return Simulator(sc, TraceBatch.from_builders(builders)).run()
+
+
+class TestIocoomAlu:
+    def test_independent_alus_fully_overlap(self):
+        """Without dependencies the clock never advances: each instruction
+        issues immediately (cost overlaps with younger instructions)."""
+        b = TraceBuilder()
+        for i in range(5):
+            b.instr(Op.IALU, wreg=i)
+        r = run(make_config(1), [b])
+        assert r.clock_ps[0] == 0
+        assert r.instruction_count[0] == 5
+
+    def test_dependency_chain_serializes(self):
+        """r1 = alu(); r2 = alu(r1); r3 = alu(r2): each waits one cost."""
+        b = TraceBuilder()
+        b.instr(Op.IALU, wreg=1)
+        b.instr(Op.IALU, rregs=(1,), wreg=2)
+        b.instr(Op.IALU, rregs=(2,), wreg=3)
+        r = run(make_config(1), [b])
+        # i2 issues at 1000 (r1 ready), i3 at 2000
+        assert r.clock_ps[0] == 2000
+        assert r.detailed_stalls["inter_ins_execution_unit"][0] == 2000
+
+    def test_imul_dependency_costs_three_cycles(self):
+        b = TraceBuilder()
+        b.instr(Op.IMUL, wreg=1)
+        b.instr(Op.IALU, rregs=(1,), wreg=2)
+        r = run(make_config(1), [b])
+        assert r.clock_ps[0] == 3000
+
+
+class TestIocoomLoadStore:
+    def test_simple_mov_load_overlaps(self):
+        """A simple MOV load advances only to load-queue allocate (time 0);
+        a dependent consumer waits for completion+cost via the LOAD_UNIT
+        scoreboard entry."""
+        b = TraceBuilder()
+        b.load(0x100, wreg=1)                      # simple MOV load
+        b.instr(Op.IALU, rregs=(1,), wreg=2)
+        r = run(make_config(1), [b])
+        # load: completion = 0 + (0 latency + 1cy SQ check) = 1000;
+        # reg1 ready at completion + cost(mov 1cy) = 2000, LOAD_UNIT;
+        # consumer: register_operands_ready = 2000
+        assert r.clock_ps[0] == 2000
+        assert r.detailed_stalls["inter_ins_l1dcache"][0] == 2000
+
+    def test_store_advances_to_store_queue_ready(self):
+        b = TraceBuilder()
+        b.store(0x100)
+        r = run(make_config(1), [b])
+        # write_operands_ready = 0 + cost(1cy) = 1000; SQ allocate at 1000
+        assert r.clock_ps[0] == 1000
+
+    def test_load_bypasses_store_queue(self):
+        """A load hitting a store-queue line returns in one cycle."""
+        b = TraceBuilder()
+        b.store(0x100)                             # SQ entry, dealloc 2000
+        b.load(0x100, wreg=1)                      # bypass at sched 1000
+        b.instr(Op.IALU, rregs=(1,), wreg=2)
+        r = run(make_config(1), [b])
+        # load: sched=1000 (clock after store), bypass completion 2000,
+        # reg1 = 2000 + mov cost = 3000; consumer issues at 3000
+        assert r.clock_ps[0] == 3000
+
+    def test_load_queue_deallocate_serializes(self):
+        """Speculative loads deallocate in order, one per cycle: N loads
+        with zero latency still deallocate 1 cycle apart."""
+        b = TraceBuilder()
+        for i in range(4):
+            b.load(0x100 + 64 * i, wreg=i)
+        b.instr(Op.IALU, rregs=(3,), wreg=10)
+        r = run(make_config(1), [b])
+        # load k completes at 1000 but deallocates at max(1000, dealloc_{k-1}
+        # +1000); reg_k = completion(1000) + cost(1000) = 2000 for every k
+        # (completion, not dealloc, feeds the register) — consumer at 2000
+        assert r.clock_ps[0] == 2000
+
+
+class TestIocoomWithMemory:
+    def test_cold_load_latency_reaches_scoreboard(self):
+        """With the MSI protocol on, a cold load's full miss latency flows
+        into the consumer's issue time through the LOAD_UNIT register."""
+        text = """
+[general]
+total_cores = 1
+mode = lite
+enable_shared_mem = true
+max_frequency = 1.0
+[tile]
+model_list = "<default,iocoom,T1,T1,T1>"
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+mov = 1
+ialu = 1
+[clock_skew_management]
+scheme = lax
+"""
+        sc = SimConfig(ConfigFile.from_string(text))
+        b = TraceBuilder()
+        b.load(0x100, wreg=1)
+        b.instr(Op.IALU, rregs=(1,), wreg=2)
+        r = run(sc, [b])
+        # consumer waits for the full cold-miss latency (directory + DRAM,
+        # >= 100ns DRAM latency alone) + queue-check + cost cycles
+        assert r.clock_ps[0] > 100_000
+        assert r.mem_counters["l1d_read_misses"][0] == 1
+        assert r.detailed_stalls["inter_ins_l1dcache"][0] == r.clock_ps[0]
+
+
+class TestIocoomSummary:
+    def test_summary_contains_detailed_breakdown(self):
+        b = TraceBuilder()
+        b.instr(Op.IALU, wreg=1)
+        b.instr(Op.IALU, rregs=(1,), wreg=2)
+        r = run(make_config(1), [b])
+        s = r.summary()
+        assert "Detailed Stall Time Breakdown" in s
+        assert "Load Queue" in s
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
