@@ -174,10 +174,12 @@ class Simulator:
             from graphite_tpu.memory import MemParams
 
             mem_params = MemParams.from_config(config)
-            if mem_params.protocol != "pr_l1_pr_l2_dram_directory_msi":
+            supported = ("pr_l1_pr_l2_dram_directory_msi",
+                         "pr_l1_pr_l2_dram_directory_mosi")
+            if mem_params.protocol not in supported:
                 raise NotImplementedError(
                     f"caching protocol {mem_params.protocol!r} pending "
-                    "(pr_l1_pr_l2_dram_directory_msi available)"
+                    f"(available: {', '.join(supported)})"
                 )
         # Full hop-by-hop USER NoC with per-port contention
         user_hbh = None

@@ -48,11 +48,11 @@ from flax import struct
 
 from graphite_tpu.memory import cache_array as ca
 from graphite_tpu.memory.cache_array import (
-    INVALID, MODIFIED, SHARED, state_readable, state_writable,
+    INVALID, MODIFIED, OWNED, SHARED, state_readable, state_writable,
 )
 from graphite_tpu.memory.params import MemParams
 from graphite_tpu.memory.state import (
-    DIR_MODIFIED, DIR_SHARED, DIR_UNCACHED,
+    DIR_MODIFIED, DIR_OWNED, DIR_SHARED, DIR_UNCACHED,
     MOD_CORE, MOD_DIR, MOD_L1D, MOD_L1I, MOD_L2, MOD_NET_MEM,
     MSG_EX_REP, MSG_EX_REQ, MSG_FLUSH_REP, MSG_FLUSH_REQ, MSG_INV_REP,
     MSG_INV_REQ, MSG_NONE, MSG_NULLIFY, MSG_SH_REP, MSG_SH_REQ, MSG_WB_REP,
@@ -108,6 +108,21 @@ def test_bit(words: jax.Array, idx: jax.Array) -> jax.Array:
 def popcount(words: jax.Array) -> jax.Array:
     """[T, SW] → int32[T]."""
     return jax.lax.population_count(words).sum(axis=1).astype(jnp.int32)
+
+
+def lowest_sharer(words: jax.Array) -> jax.Array:
+    """Lowest set bit index per row ([T, SW] → int32[T], -1 when empty).
+
+    The deterministic form of `DirectoryEntry::getOneSharer` (the reference
+    returns an arbitrary list member)."""
+    nonzero = words != 0
+    w_idx = jnp.argmax(nonzero, axis=1).astype(jnp.int32)
+    any_bit = nonzero.any(axis=1)
+    tiles = jnp.arange(words.shape[0], dtype=jnp.int32)
+    w = words[tiles, w_idx]
+    low = w & (~w + jnp.uint32(1))
+    bit = jax.lax.population_count(low - jnp.uint32(1)).astype(jnp.int32)
+    return jnp.where(any_bit, w_idx * 32 + bit, -1)
 
 
 def unpack_sharers(words: jax.Array, n: int) -> jax.Array:
@@ -357,9 +372,15 @@ def memory_engine_step(
     l2_hit_now = l1_miss & l2_permit
     l2_miss = l1_miss & ~l2_permit
 
-    # upgrade (write to SHARED L2 line): invalidate L2 + INV_REP to home
-    # (`l2_cache_cntlr.cc:261-282 processExReqFromL1Cache`)
-    upgrade = l2_miss & s_write & (l2_state == SHARED)
+    # upgrade (write to a readable-but-not-writable L2 line): invalidate L2
+    # + eviction message to home, then a full EX_REQ refetch
+    # (`l2_cache_cntlr.cc:261-282 processExReqFromL1Cache`; documented
+    # simplification: the reference's UPGRADE_REP without data is modeled
+    # as a refetch, same message count, slightly larger data serialization).
+    # MOSI: an OWNED line is dirty, so its upgrade eviction must FLUSH.
+    upgrade = l2_miss & s_write & (
+        (l2_state == SHARED) | (l2_state == OWNED))
+    upgrade_dirty = upgrade & (l2_state == OWNED)
     s_home = home_of(s_line)
     evict_cell_busy = ms.mail.evict_type[s_home, tiles] != MSG_NONE
     stall_start = upgrade & evict_cell_busy
@@ -408,14 +429,17 @@ def memory_engine_step(
     # L1 line invalidated on miss before going to L2 (`l1_cache_cntlr.cc:137`)
     l1i_upd = ca.invalidate(l1i_upd, s_line, l1_miss & s_comp_l1i)
     l1d_upd = ca.invalidate(l1d_upd, s_line, l1_miss & ~s_comp_l1i)
-    # upgrade: invalidate L2 + INV_REP eviction message
+    # upgrade: invalidate L2 + eviction message (INV_REP clean, FLUSH_REP
+    # for a dirty OWNED line)
     l2_upd = ca.invalidate(l2_upd, s_line, upgrade & ~stall_start)
     mail = ms.mail
     up_go = upgrade & ~stall_start
+    up_msg = jnp.where(upgrade_dirty, MSG_FLUSH_REP,
+                       MSG_INV_REP).astype(jnp.uint8)
     w_home = jnp.where(up_go, s_home, 0)
     mail = mail.replace(
         evict_type=mail.evict_type.at[w_home, tiles].set(
-            jnp.where(up_go, MSG_INV_REP, mail.evict_type[w_home, tiles])),
+            jnp.where(up_go, up_msg, mail.evict_type[w_home, tiles])),
         evict_line=mail.evict_line.at[w_home, tiles].set(
             jnp.where(up_go, s_line, mail.evict_line[w_home, tiles])),
         evict_time=mail.evict_time.at[w_home, tiles].set(
@@ -599,14 +623,21 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     l1d = ca.invalidate(ms.l1d, fline, inv_l1 & (cloc == MOD_L1D))
     l1i_hit, l1i_way, _ = ca.lookup(l1i, fline)
     l1d_hit, l1d_way, _ = ca.lookup(l1d, fline)
-    l1i = ca.set_state(l1i, fline, l1i_way, SHARED,
+    # WB downgrade: MSI M→SHARED; MOSI M→OWNED, O→O, S→S (the owner keeps
+    # the dirty line — mosi `l2_cache_cntlr.cc:538-566`)
+    if mp.is_mosi:
+        wb_state = jnp.where(l2_state == MODIFIED, OWNED,
+                             l2_state).astype(jnp.uint8)
+    else:
+        wb_state = jnp.full_like(l2_state, SHARED)
+    l1i = ca.set_state(l1i, fline, l1i_way, wb_state,
                        wb_l1 & (cloc == MOD_L1I) & l1i_hit)
-    l1d = ca.set_state(l1d, fline, l1d_way, SHARED,
+    l1d = ca.set_state(l1d, fline, l1d_way, wb_state,
                        wb_l1 & (cloc == MOD_L1D) & l1d_hit)
 
-    # L2: invalidate (INV/FLUSH) or downgrade to SHARED (WB)
+    # L2: invalidate (INV/FLUSH) or downgrade (WB)
     l2 = ca.invalidate(ms.l2, fline, inv_l1)
-    l2 = ca.set_state(l2, fline, l2_way, SHARED, wb_l1)
+    l2 = ca.set_state(l2, fline, l2_way, wb_state, wb_l1)
     l2_cloc = ms.l2_cloc.at[tiles, sets, l2_way].set(
         jnp.where(inv_l1, 0, ms.l2_cloc[tiles, sets, l2_way]))
 
@@ -669,8 +700,14 @@ def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress):
     new_nsh = nsh - (apply & was_sharer).astype(jnp.int32)
     is_flush = etype == MSG_FLUSH_REP
     new_owner = jnp.where(apply & is_flush, -1, owner)
+    # empty entry → UNCACHED; a dirty (owner) departure with sharers left
+    # behind → SHARED (the MOSI O→S downgrade; MSI flushes always empty the
+    # entry so the same formula holds)
     new_dstate = jnp.where(
-        apply & (is_flush | (new_nsh == 0)), DIR_UNCACHED, dstate
+        apply,
+        jnp.where(new_nsh == 0, DIR_UNCACHED,
+                  jnp.where(is_flush, DIR_SHARED, dstate)),
+        dstate,
     ).astype(jnp.uint8)
     d = _dir_update(d, sets, way, apply, dstate=new_dstate, owner=new_owner,
                     sharers=new_sharers, nsharers=new_nsh)
@@ -684,6 +721,10 @@ def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress):
                           jnp.maximum(txn.time_ps, etime + dir_access_ps),
                           txn.time_ps),
         data_cached=txn.data_cached | (txn_match & is_flush),
+        # park flushed data in the home's one-entry buffer
+        # (`_cached_data_list`): a later request for the line skips DRAM
+        cdata_line=jnp.where(found & is_flush, eline, txn.cdata_line),
+        cdata_valid=txn.cdata_valid | (found & is_flush),
     )
 
     csrc = jnp.where(found, src, 0)
@@ -759,22 +800,35 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     exf = finish & is_ex & dfound
     d = _dir_update(d, sets, way, exf, dstate=DIR_MODIFIED, owner=r,
                     sharers=rbit_words, nsharers=jnp.ones(T, jnp.int32))
-    # SH finish after WB: SHARED, add r (`processWbRepFromL2Cache` +
-    # `processShReqFromL2Cache` SHARED branch)
-    _, _, _, cur_sharers, cur_nsh = _dir_gather(d, sets, way)
+    # SH finish: add r as sharer.  MSI: entry becomes SHARED ownerless
+    # (`processWbRepFromL2Cache`).  MOSI: a dirty source keeps the line —
+    # M/O entries become/stay OWNED with the owner retained
+    # (mosi `processWbRepFromL2Cache` M→OWNED, `restartShmemReq`)
+    _, cur_dstate, cur_owner, cur_sharers, cur_nsh = _dir_gather(d, sets, way)
     shf = finish & is_sh & dfound
     had = test_bit(cur_sharers, r)
+    if mp.is_mosi:
+        from_dirty = (cur_dstate == DIR_MODIFIED) | (cur_dstate == DIR_OWNED)
+        sh_dstate = jnp.where(from_dirty, DIR_OWNED,
+                              DIR_SHARED).astype(jnp.uint8)
+        sh_owner = jnp.where(from_dirty, cur_owner, -1)
+    else:
+        sh_dstate = jnp.full(T, DIR_SHARED, jnp.uint8)
+        sh_owner = jnp.full(T, -1, jnp.int32)
     d = _dir_update(
-        d, sets, way, shf, dstate=DIR_SHARED,
-        owner=jnp.full(T, -1, jnp.int32),
+        d, sets, way, shf, dstate=sh_dstate,
+        owner=sh_owner,
         sharers=set_bit(cur_sharers, r, shf),
         nsharers=cur_nsh + (~had).astype(jnp.int32))
     # NULLIFY finish: the entry was already replaced at allocation; nothing
     # directory-side remains (`processNullifyReq` UNCACHED branch)
 
     # reply to requester (dram read only if the data did not come back
-    # cached via FLUSH/WB — `retrieveDataAndSendToL2Cache`)
-    need_dram = finish & ~txn.data_cached & ~is_nullify
+    # cached via FLUSH/WB or sit in the home's flushed-data buffer —
+    # `retrieveDataAndSendToL2Cache` checks `_cached_data_list` first)
+    cdata_hit = txn.cdata_valid & (txn.cdata_line == txn.line)
+    data_avail = txn.data_cached | cdata_hit
+    need_dram = finish & ~data_avail & ~is_nullify
     rep_ready_ps = txn.time_ps + jnp.where(need_dram, dram_lat_ps, 0)
     rep_lat = mem_net_latency_ps(mp, tiles, r, mp.rep_bits, enabled)
     rep_msg = jnp.where(is_ex, MSG_EX_REP, MSG_SH_REP).astype(jnp.uint8)
@@ -798,11 +852,16 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         active=txn.active & ~finish,
         last_line=jnp.where(finish, txn.line, txn.last_line),
         last_done_ps=jnp.where(finish, rep_ready_ps, txn.last_done_ps),
+        cdata_valid=txn.cdata_valid & ~(finish & cdata_hit),  # consumed
     )
+    # MSI writes WB data through to DRAM (the entry turns SHARED clean);
+    # MOSI keeps it dirty at the owner (entry turns OWNED) — DRAM is only
+    # written when dirty lines are evicted/flushed
+    wb_writes_dram = (jnp.zeros_like(wb_any) if mp.is_mosi else wb_any)
     counters = ms.counters.replace(
         dram_reads=ms.counters.dram_reads + (need_dram & enabled).astype(I64),
         dram_writes=ms.counters.dram_writes
-        + (wb_any & enabled).astype(I64),
+        + (wb_writes_dram & enabled).astype(I64),
         dram_total_lat_ps=ms.counters.dram_total_lat_ps
         + jnp.where(need_dram & enabled, dram_lat_ps, 0),
     )
@@ -909,10 +968,15 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     uncached = eff_dstate == DIR_UNCACHED
     shared = eff_dstate == DIR_SHARED
     modified = eff_dstate == DIR_MODIFIED
+    owned = eff_dstate == DIR_OWNED
 
-    # (a) immediate finishes: UNCACHED requests, SHARED+SH
+    # (a) immediate finishes: UNCACHED requests; MSI also serves SHARED+SH
+    # straight from DRAM, while MOSI fetches cache-to-cache (below)
     imm_ex = run_req & is_ex & uncached
-    imm_sh = run_req & is_sh & (uncached | shared)
+    if mp.is_mosi:
+        imm_sh = run_req & is_sh & uncached
+    else:
+        imm_sh = run_req & is_sh & (uncached | shared)
     imm = imm_ex | imm_sh
     rbit = set_bit(jnp.zeros((T, mp.sharer_words), U32), rreq, imm)
     cur_sh = jnp.where(imm_sh[:, None] & shared[:, None], v_sharers,
@@ -925,7 +989,11 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         sharers=cur_sh | rbit,
         nsharers=jnp.where(imm_ex, 1,
                            popcount(cur_sh) + (~had).astype(jnp.int32)))
-    rep_ready = eff_time + dram_lat_ps  # UNCACHED/SHARED reads hit DRAM
+    # UNCACHED/SHARED reads hit DRAM unless the home's flushed-data buffer
+    # holds the line (`retrieveDataAndSendToL2Cache` cached-data lookup)
+    cdata_imm = txn.cdata_valid & (txn.cdata_line == eff_line) & imm
+    rep_ready = eff_time + jnp.where(cdata_imm, 0, dram_lat_ps)
+    txn = txn.replace(cdata_valid=txn.cdata_valid & ~cdata_imm)
     rep_lat = mem_net_latency_ps(mp, tiles, rreq, mp.rep_bits, enabled)
     # add-delta scatter (cells zero before a live write; see finish path)
     wr = jnp.where(imm, rreq, 0)
@@ -941,14 +1009,31 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         last_done_ps=jnp.where(imm, rep_ready, txn.last_done_ps),
     )
 
-    # (b) fan-out transactions: EX/NULLIFY on SHARED (INV multicast),
-    #     anything on MODIFIED (FLUSH/WB to owner)
-    fan_inv = (run_req & is_ex & shared) | (nullify_live & shared)
+    # (b) fan-out transactions: EX/NULLIFY on SHARED (INV multicast; in
+    #     MOSI also on OWNED, where the owner gets FLUSH and the rest INV),
+    #     anything on MODIFIED (FLUSH/WB to owner), and — MOSI only — SH on
+    #     SHARED/OWNED fetching the data cache-to-cache from one sharer
+    #     (mosi `dram_directory_cntlr.cc:430-520`)
+    if mp.is_mosi:
+        fan_inv = ((run_req & is_ex) | nullify_live) & (shared | owned)
+        sh_fetch = run_req & is_sh & (shared | owned)
+    else:
+        fan_inv = (run_req & is_ex & shared) | (nullify_live & shared)
+        sh_fetch = jnp.zeros((T,), jnp.bool_)
     fan_owner = ((run_req | nullify_live) & modified)
-    fan = fan_inv | fan_owner
+    fan = fan_inv | fan_owner | sh_fetch
     owner_bits = set_bit(jnp.zeros((T, mp.sharer_words), U32),
                          jnp.clip(v_owner, 0, T - 1), fan_owner)
-    pending = jnp.where(fan_inv[:, None], v_sharers, owner_bits)
+    # cache-to-cache source: the owner when the entry is OWNED (it has the
+    # dirty line), else the lowest-id sharer (deterministic getOneSharer)
+    fetch_src = jnp.where(owned & (v_owner >= 0), v_owner,
+                          lowest_sharer(v_sharers))
+    fetch_bits = set_bit(jnp.zeros((T, mp.sharer_words), U32),
+                         jnp.clip(fetch_src, 0, T - 1),
+                         sh_fetch & (fetch_src >= 0))
+    pending = jnp.where(
+        fan_inv[:, None], v_sharers,
+        jnp.where(sh_fetch[:, None], fetch_bits, owner_bits))
     fwd_msg = jnp.where(
         fan_inv, MSG_INV_REQ,
         jnp.where(is_sh, MSG_WB_REQ, MSG_FLUSH_REQ)).astype(jnp.uint8)
@@ -967,12 +1052,26 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     targets = unpack_sharers(pending, T)          # [home, sharer]
     send = fan[:, None] & targets                 # [home, sharer]
     send_t = send.T                               # [sharer, home]
+    msg_hs = jnp.broadcast_to(fwd_msg[:, None], (T, T))  # [home, sharer]
+    if mp.is_mosi:
+        # one target of an invalidation sweep supplies the data by FLUSH
+        # (`INV_FLUSH_COMBINED_REQ`, mosi `dram_directory_cntlr.cc:385-395`):
+        # the owner when the entry is OWNED (dirty), else one sharer for an
+        # EX on SHARED — the EX then completes cache-to-cache with no DRAM
+        # read.  NULLIFY on SHARED keeps plain INVs (data is clean in DRAM).
+        flush_pick = jnp.where(owned & (v_owner >= 0), v_owner,
+                               lowest_sharer(v_sharers))
+        pick_col = tiles[None, :] == flush_pick[:, None]  # [home, sharer]
+        pick_rows = (fan_inv & (owned | (run_req & is_ex & shared)))
+        msg_hs = jnp.where(
+            pick_rows[:, None] & pick_col,
+            jnp.uint8(MSG_FLUSH_REQ), msg_hs)
     fwd_lat = mem_net_latency_ps(
         mp, tiles[:, None], tiles[None, :], mp.req_bits, enabled
     )  # [src=home? careful] — computed as [row, col] = (home, sharer)
     arrive = eff_time[:, None] + fwd_lat          # [home, sharer]
     mail = mail.replace(
-        fwd_type=jnp.where(send_t, fwd_msg[None, :], mail.fwd_type),
+        fwd_type=jnp.where(send_t, msg_hs.T, mail.fwd_type),
         fwd_line=jnp.where(send_t, eff_line[None, :], mail.fwd_line),
         fwd_time=jnp.where(send_t, arrive.T, mail.fwd_time),
     )
@@ -980,9 +1079,10 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     counters = ms.counters.replace(
         dir_accesses=ms.counters.dir_accesses
         + (starting & enabled).astype(I64),
-        dram_reads=ms.counters.dram_reads + (imm & enabled).astype(I64),
+        dram_reads=ms.counters.dram_reads
+        + (imm & ~cdata_imm & enabled).astype(I64),
         dram_total_lat_ps=ms.counters.dram_total_lat_ps
-        + jnp.where(imm & enabled, dram_lat_ps, 0),
+        + jnp.where(imm & ~cdata_imm & enabled, dram_lat_ps, 0),
     )
     progress = progress + jnp.sum(starting, dtype=jnp.int32)
     return ms.replace(directory=d, txn=txn, mail=mail,
@@ -1026,12 +1126,14 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
                   jnp.where(comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8),
                   ms.l2_cloc[tiles, sets, way]))
 
-    # eviction message (FLUSH_REP if dirty, INV_REP if shared —
-    # `insertCacheLine`, `l2_cache_cntlr.cc:75-116`)
-    e_msg = jnp.where(v_state == MODIFIED, MSG_FLUSH_REP,
+    # eviction message (FLUSH_REP if dirty — MODIFIED, or OWNED in MOSI —
+    # else INV_REP; `insertCacheLine`, `l2_cache_cntlr.cc:75-116`, mosi
+    # `l2_cache_cntlr.cc:116-138`)
+    v_dirty = (v_state == MODIFIED) | (v_state == OWNED)
+    e_msg = jnp.where(v_dirty, MSG_FLUSH_REP,
                       MSG_INV_REP).astype(jnp.uint8)
     e_bits_lat = jnp.where(
-        v_state == MODIFIED,
+        v_dirty,
         mem_net_latency_ps(mp, tiles, v_home_all, mp.rep_bits, enabled),
         mem_net_latency_ps(mp, tiles, v_home_all, mp.req_bits, enabled))
     # fill timing: reply arrival + net sync + L2 insert (data+tags), then

@@ -141,6 +141,13 @@ class MemParams:
     def sharer_words(self) -> int:
         return (self.n_tiles + 31) // 32
 
+    @property
+    def is_mosi(self) -> bool:
+        """O-state protocol (`pr_l1_pr_l2_dram_directory_mosi/`): owner
+        retains dirty data on read-sharing; reads are served cache-to-cache
+        from a sharer instead of DRAM."""
+        return self.protocol == "pr_l1_pr_l2_dram_directory_mosi"
+
     @classmethod
     def from_config(cls, sc: SimConfig) -> "MemParams":
         cfg = sc.cfg

@@ -101,6 +101,11 @@ class TxnState:
     saved_time_ps: jax.Array    # int64[T]
     last_line: jax.Array     # int32[T]  same-address serialization floor
     last_done_ps: jax.Array  # int64[T]
+    # one-entry flushed-data buffer per home (`_cached_data_list` analog):
+    # a FLUSH_REP eviction parks its line here; a later request for the
+    # same line is served without a DRAM read
+    cdata_line: jax.Array    # int32[T]
+    cdata_valid: jax.Array   # bool[T]
 
 
 @struct.dataclass
@@ -199,6 +204,8 @@ def init_mem_state(mp: MemParams) -> MemState:
         saved_time_ps=zi64(),
         last_line=jnp.full(T, -1, jnp.int32),
         last_done_ps=zi64(),
+        cdata_line=jnp.full(T, -1, jnp.int32),
+        cdata_valid=jnp.zeros(T, jnp.bool_),
     )
     mail = MemMailboxes(
         req_type=jnp.zeros((T, T), jnp.uint8),

