@@ -198,6 +198,9 @@ class Simulator:
             iocoom_params = IocoomParams.from_config(cfg)
         elif core_type not in ("simple", "default", "magic"):
             raise NotImplementedError(f"core model {core_type!r}")
+        from graphite_tpu.models.dvfs import DvfsParams
+
+        dvfs_params = DvfsParams.from_config(cfg)
         self.params = EngineParams(
             n_tiles=n_tiles,
             static_cost_cycles=costs,
@@ -211,6 +214,7 @@ class Simulator:
             inner_block=inner_block,
             n_conds=n_conds,
             iocoom=iocoom_params,
+            dvfs=dvfs_params,
             mem=mem_params,
             user_hbh=user_hbh,
         )
@@ -256,6 +260,21 @@ class Simulator:
 
             self.state = self.state.replace(
                 ioc=init_iocoom_state(n_tiles, iocoom_params))
+        from graphite_tpu.engine.state import DvfsState
+
+        nd = dvfs_params.n_domains
+        init_freqs = jnp.broadcast_to(
+            jnp.asarray(dvfs_params.domain_freq_mhz, jnp.int32)[None, :],
+            (n_tiles, nd)).copy()
+        init_volts = jnp.asarray(
+            [dvfs_params.min_voltage_mv(f)
+             for f in dvfs_params.domain_freq_mhz], jnp.int32)
+        self.state = self.state.replace(dvfs=DvfsState(
+            freq_mhz=init_freqs,
+            voltage_mv=jnp.broadcast_to(
+                init_volts[None, :], (n_tiles, nd)).copy(),
+            errors=jnp.zeros(n_tiles, jnp.int64),
+        ))
         self.device_trace = DeviceTrace.from_batch(trace)
         if mesh is not None:
             # Shard the tile axis over the device mesh (SURVEY §2.10): the

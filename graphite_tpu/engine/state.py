@@ -94,6 +94,21 @@ class SyncState:
 
 
 @struct.dataclass
+class DvfsState:
+    """Per-tile per-domain frequency/voltage (`dvfs_manager.h:19-88`).
+
+    The CORE domain's frequency is mirrored authoritatively in
+    CoreState.freq_mhz (every cost conversion uses it); non-CORE domains
+    are tracked for the get/set API, with their model frequencies static
+    per run (documented divergence: the reference retunes cache/network
+    timing mid-run on those domains too)."""
+
+    freq_mhz: jax.Array     # int32[T, ND]
+    voltage_mv: jax.Array   # int32[T, ND]
+    errors: jax.Array       # int64[T] — failed in-trace DVFS_SET events
+
+
+@struct.dataclass
 class SimState:
     core: CoreState
     net: UserNetState
@@ -108,6 +123,8 @@ class SimState:
     noc_user: "object" = None
     # iocoom core-model state (None unless core type = iocoom)
     ioc: "object" = None
+    # per-domain DVFS state (None in minimal configs)
+    dvfs: "object" = None
 
 
 @struct.dataclass

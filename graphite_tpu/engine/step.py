@@ -72,6 +72,8 @@ class EngineParams:
     n_conds: int = 64          # cond-variable id space (sync tables)
     # iocoom core model (None = simple 1-IPC in-order model)
     iocoom: "object" = None    # IocoomParams | None
+    # DVFS tables (None = fixed frequencies, DVFS_SET is a raw freq poke)
+    dvfs: "object" = None      # DvfsParams | None
     # memory subsystem (None = enable_shared_mem false: memory operands
     # cost nothing, like the reference's disabled shared-mem knob)
     mem: "object" = None       # MemParams | None
@@ -597,11 +599,63 @@ def subquantum_iteration(
     clock = jnp.where(granted, clock + mutex_wait_ps, clock)
     clock = jnp.where(join_now, join_time, clock)
 
-    # DVFS_SET on the CORE domain (domain 0) retunes this tile's clock;
-    # the full DVFSManager (voltage levels, remote get/set over the DVFS
-    # network, `dvfs_manager.h:19-88`) is layered on in models/dvfs.
-    dvfs_set_now = active & (op == Op.DVFS_SET) & (aux0 == 0) & (aux1 > 0)
-    freq_mhz = jnp.where(dvfs_set_now, aux1, core.freq_mhz)
+    # DVFS_SET retunes the target domain's frequency, validated against the
+    # voltage/frequency tables (`DVFSManager::getVoltage`, technology
+    # levels): AUTO picks the minimum voltage for the frequency; HOLD
+    # (encoded aux1 < 0) fails if the frequency exceeds the current
+    # voltage's maximum; invalid requests count into dvfs errors and leave
+    # state unchanged (`dvfs.h` rc codes -2/-4/-5).
+    is_dvfs_set = op == Op.DVFS_SET
+    if params.dvfs is not None and state.dvfs is not None:
+        dvp = params.dvfs
+        ND = dvp.n_domains
+
+        def _dvfs_block(_):
+            req = jnp.abs(aux1)
+            hold = aux1 < 0
+            dom = jnp.clip(aux0, 0, ND - 1)
+            valid_dom = (aux0 >= 0) & (aux0 < ND)
+            volts = jnp.asarray(dvp.voltages_mv, jnp.int32)   # [L] desc
+            maxf = jnp.asarray(dvp.max_freq_mhz, jnp.int32)   # [L] desc
+            L = len(dvp.voltages_mv)
+            ok_levels = req[:, None] <= maxf[None, :]         # [T, L]
+            freq_ok = ok_levels.any(axis=1) & (req > 0)
+            # minimum voltage = last satisfying level (descending tables)
+            lvl = (L - 1) - jnp.argmax(
+                ok_levels[:, ::-1], axis=1).astype(jnp.int32)
+            auto_v = volts[jnp.clip(lvl, 0, L - 1)]
+            cur_v = state.dvfs.voltage_mv[tiles, dom]
+            cur_lvl = jnp.argmax(
+                volts[None, :] == cur_v[:, None], axis=1).astype(jnp.int32)
+            hold_ok = req <= maxf[cur_lvl]
+            attempt = active & is_dvfs_set
+            ok = attempt & valid_dom & freq_ok & (~hold | hold_ok)
+            err = attempt & ~(valid_dom & freq_ok & (~hold | hold_ok))
+            new_v = jnp.where(hold, cur_v, auto_v)
+            dmask = (dom[:, None] == jnp.arange(ND, dtype=jnp.int32)[None, :]
+                     ) & ok[:, None]
+            freq2 = jnp.where(dmask, req[:, None], state.dvfs.freq_mhz)
+            volt2 = jnp.where(dmask, new_v[:, None], state.dvfs.voltage_mv)
+            errs2 = state.dvfs.errors + err.astype(I64)
+            core_set = ok & (dom == dvp.core_domain)
+            return freq2, volt2, errs2, core_set, req
+
+        def _dvfs_skip(_):
+            return (state.dvfs.freq_mhz, state.dvfs.voltage_mv,
+                    state.dvfs.errors, jnp.zeros((T,), jnp.bool_),
+                    jnp.zeros((T,), aux1.dtype))
+
+        (dv_freq, dv_volt, dv_errs, dvfs_core_set, dvfs_req) = lax.cond(
+            jnp.any(active & is_dvfs_set), _dvfs_block, _dvfs_skip, None)
+        new_dvfs = state.dvfs.replace(
+            freq_mhz=dv_freq, voltage_mv=dv_volt, errors=dv_errs)
+        freq_mhz = jnp.where(
+            dvfs_core_set, dvfs_req.astype(core.freq_mhz.dtype),
+            core.freq_mhz)
+    else:
+        new_dvfs = state.dvfs
+        dvfs_set_now = active & is_dvfs_set & (aux0 == 0) & (aux1 > 0)
+        freq_mhz = jnp.where(dvfs_set_now, aux1, core.freq_mhz)
 
     instr_now = advance & (is_static | is_branch
                            | (is_dynamic & ~is_spawn_instr))
@@ -695,6 +749,7 @@ def subquantum_iteration(
         mem=mem_state,
         noc_user=noc_user,
         ioc=new_ioc,
+        dvfs=new_dvfs,
     )
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 

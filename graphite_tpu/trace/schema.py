@@ -335,5 +335,9 @@ class TraceBuilder:
     def exit(self) -> "TraceBuilder":
         return self._append(Op.THREAD_EXIT)
 
-    def dvfs_set(self, domain: int, freq_mhz: int) -> "TraceBuilder":
-        return self._append(Op.DVFS_SET, aux0=domain, aux1=freq_mhz)
+    def dvfs_set(self, domain: int, freq_mhz: int,
+                 hold: bool = False) -> "TraceBuilder":
+        """Retune a DVFS domain; hold=True keeps the current voltage
+        (fails if the frequency exceeds its maximum — `dvfs.h` HOLD)."""
+        return self._append(Op.DVFS_SET, aux0=domain,
+                            aux1=-freq_mhz if hold else freq_mhz)

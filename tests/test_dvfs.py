@@ -1,0 +1,147 @@
+"""DVFS manager tests: V/f tables, rc codes, in-trace frequency scaling.
+
+Mirrors the reference unit tests `tests/unit/dvfs_basic`, `dvfs_error_codes`
+and `frequency_scaling_simple`: AUTO picks the minimum voltage for a
+frequency, HOLD fails above the current voltage's maximum, invalid
+tile/domain/frequency return the `dvfs.h` rc codes, and a frequency change
+rescales subsequent instruction costs.
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine import Simulator
+from graphite_tpu.models import dvfs as dv
+from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+
+def make_config(n_tiles=2, max_freq="2.0"):
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = {max_freq}
+technology_node = 22
+[dvfs]
+synchronization_delay = 2
+[dvfs/domains]
+[dvfs]
+domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE, DIRECTORY> \
+<1.0, NETWORK_USER, NETWORK_MEMORY>"
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+ialu = 1
+[clock_skew_management]
+scheme = lax
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+def run_sim(sc, builders):
+    sim = Simulator(sc, TraceBatch.from_builders(builders))
+    return sim, sim.run()
+
+
+class TestLevels:
+    def test_min_voltage_auto(self):
+        p = dv.DvfsParams.from_config(make_config().cfg)
+        # max_frequency = 2 GHz: 2000 MHz needs 1.0 V; 0.5*2000=1000 runs
+        # at factor 0.5 -> 0.84 V; 0.37*2000=740 at 0.8 V
+        assert p.min_voltage_mv(2000) == 1000
+        assert p.min_voltage_mv(1000) == 840
+        assert p.min_voltage_mv(700) == 800
+        assert p.min_voltage_mv(2001) == -1
+
+    def test_initial_voltage_matches_domain_freq(self):
+        sc = make_config()
+        sim = Simulator(sc, TraceBatch.from_builders(
+            [TraceBuilder().instr(Op.IALU), TraceBuilder()]))
+        man = dv.DVFSManager(sim)
+        rc, f, v = man.get_dvfs(0, 0)
+        assert rc == dv.RC_OK
+        assert f == pytest.approx(1.0)
+        assert v == pytest.approx(0.84)  # 1 GHz at factor 0.5 of 2 GHz
+
+
+class TestErrorCodes:
+    def test_reference_rc_codes(self):
+        """dvfs_error_codes.cc sequence."""
+        sc = make_config()
+        sim = Simulator(sc, TraceBatch.from_builders(
+            [TraceBuilder().instr(Op.IALU), TraceBuilder()]))
+        man = dv.DVFSManager(sim)
+        assert man.get_dvfs(-1, 0)[0] == dv.RC_INVALID_TILE
+        assert man.get_dvfs(0, 99)[0] == dv.RC_INVALID_DOMAIN
+        assert man.set_dvfs(0, 0, 0.0) == dv.RC_INVALID_FREQUENCY
+        assert man.set_dvfs(0, 0, 1.0, voltage_flag=5) == \
+            dv.RC_INVALID_VOLTAGE_OPTION
+        assert man.set_dvfs(0, 0, 100.0) == dv.RC_INVALID_FREQUENCY
+        # drop to a low voltage, then HOLD a too-fast frequency
+        assert man.set_dvfs(0, 0, 0.1) == dv.RC_OK
+        assert man.set_dvfs(0, 0, 2.0, dv.HOLD) == \
+            dv.RC_ABOVE_MAX_FOR_VOLTAGE
+
+    def test_basic_set_get(self):
+        """dvfs_basic.cc: AUTO then HOLD round trip."""
+        sc = make_config()
+        sim = Simulator(sc, TraceBatch.from_builders(
+            [TraceBuilder().instr(Op.IALU), TraceBuilder()]))
+        man = dv.DVFSManager(sim)
+        assert man.set_dvfs(0, 0, 2.0) == dv.RC_OK
+        rc, f, v = man.get_dvfs(0, 0)
+        assert (f, v) == (pytest.approx(2.0), pytest.approx(1.0))
+        assert man.set_dvfs(0, 0, 1.0, dv.HOLD) == dv.RC_OK
+        rc, f, v = man.get_dvfs(0, 0)
+        assert (f, v) == (pytest.approx(1.0), pytest.approx(1.0))  # held
+
+
+class TestInTraceScaling:
+    def test_frequency_change_rescales_costs(self):
+        """frequency_scaling_simple analog: 4 ialu at 1 GHz, retune to
+        2 GHz, 4 more: 4*1000 + 4*500 ps."""
+        b = TraceBuilder()
+        for _ in range(4):
+            b.instr(Op.IALU)
+        b.dvfs_set(0, 2000)
+        for _ in range(4):
+            b.instr(Op.IALU)
+        sim, r = run_sim(make_config(), [b, TraceBuilder()])
+        assert r.clock_ps[0] == 4000 + 2000
+        assert int(np.asarray(sim.state.dvfs.errors).sum()) == 0
+        assert int(np.asarray(sim.state.dvfs.voltage_mv)[0, 0]) == 1000
+
+    def test_invalid_in_trace_set_counts_error(self):
+        b = TraceBuilder()
+        b.instr(Op.IALU)
+        b.dvfs_set(0, 5000)        # > 2 GHz max: rejected
+        b.instr(Op.IALU)
+        sim, r = run_sim(make_config(), [b, TraceBuilder()])
+        assert r.clock_ps[0] == 2000   # frequency unchanged
+        assert int(np.asarray(sim.state.dvfs.errors)[0]) == 1
+
+    def test_hold_in_trace_fails_above_voltage_max(self):
+        b = TraceBuilder()
+        b.dvfs_set(0, 740)             # AUTO: drops voltage to 0.8 V
+        b.dvfs_set(0, 2000, hold=True)  # exceeds 0.8 V max: rejected
+        b.instr(Op.IALU)
+        sim, r = run_sim(make_config(), [b, TraceBuilder()])
+        # still at 740 MHz: one ialu = ceil cycle at 740 MHz
+        assert int(np.asarray(sim.state.dvfs.errors)[0]) == 1
+        assert int(np.asarray(sim.state.dvfs.freq_mhz)[0, 0]) == 740
+
+    def test_non_core_domain_set_tracked(self):
+        b = TraceBuilder()
+        b.dvfs_set(1, 1500)            # NETWORK domain
+        b.instr(Op.IALU)
+        sim, r = run_sim(make_config(), [b, TraceBuilder()])
+        assert r.clock_ps[0] == 1000   # core frequency untouched
+        assert int(np.asarray(sim.state.dvfs.freq_mhz)[0, 1]) == 1500
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

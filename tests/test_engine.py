@@ -413,11 +413,21 @@ class TestSpawnAndDvfs:
 
     def test_dvfs_set_core_retunes_frequency(self):
         b = TraceBuilder().instr(Op.IALU)       # 1000 ps @ 1 GHz
-        b.dvfs_set(0, 2000)                     # CORE domain → 2 GHz
-        b.instr(Op.IALU)                        # 500 ps
+        b.dvfs_set(0, 500)                      # CORE domain → 0.5 GHz
+        b.instr(Op.IALU)                        # 2000 ps
         bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
         r = run(make_config(), bs)
-        assert r.clock_ps[0] == 1500
+        assert r.clock_ps[0] == 3000
+
+    def test_dvfs_set_above_max_frequency_rejected(self):
+        # [general] max_frequency is 1.0 GHz here: a 2 GHz request fails
+        # (`dvfs.h` rc -4) and leaves the frequency unchanged
+        b = TraceBuilder().instr(Op.IALU)
+        b.dvfs_set(0, 2000)
+        b.instr(Op.IALU)
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        r = run(make_config(), bs)
+        assert r.clock_ps[0] == 2000
 
 
 class TestQuantumLoop:
