@@ -296,6 +296,79 @@ class Simulator:
             self._runner_max_quanta = max_quanta
         return self._runner
 
+    def run_chunk(self, n_quanta: int):
+        """Run at most `n_quanta` quanta (for sampled/checkpointed runs).
+
+        Returns (done, quanta_executed).  Unlike run(), hitting the bound
+        is not an error — the caller samples/checkpoints and continues.
+        """
+        state, n_quanta_dev, deadlock_dev = self._get_runner(n_quanta)(
+            self.state)
+        nq, deadlock, overflow, done = jax.device_get((
+            n_quanta_dev, deadlock_dev, state.net.overflow, state.done))
+        if bool(overflow):
+            raise MailboxOverflowError(
+                "a (dst,src) mailbox ring overflowed; re-run with a "
+                "larger mailbox_depth")
+        if bool(deadlock):
+            blocked = np.flatnonzero(~done).tolist()
+            raise DeadlockError(
+                f"no progress across a quantum; blocked tiles: "
+                f"{blocked[:16]}{'...' if len(blocked) > 16 else ''}")
+        self.state = state
+        return bool(done.all()), int(nq)
+
+    @staticmethod
+    def _result_parts(state: SimState):
+        """Device-side pytrees for the summary counters (shared by run()
+        and _results_from_state — keep in one place)."""
+        mem_part = (
+            (state.mem.counters, state.mem.func_errors)
+            if state.mem is not None else None
+        )
+        ioc_part = (
+            {
+                "load_queue": state.ioc.load_queue_stall_ps,
+                "store_queue": state.ioc.store_queue_stall_ps,
+                "l1icache": state.ioc.l1icache_stall_ps,
+                "intra_ins_l1dcache": state.ioc.intra_ins_l1dcache_stall_ps,
+                "inter_ins_l1dcache": state.ioc.inter_ins_l1dcache_stall_ps,
+                "intra_ins_execution_unit":
+                    state.ioc.intra_ins_execution_unit_stall_ps,
+                "inter_ins_execution_unit":
+                    state.ioc.inter_ins_execution_unit_stall_ps,
+            }
+            if state.ioc is not None else None
+        )
+        net_part = (state.net.packets_sent, state.net.packets_received,
+                    state.net.total_latency_ps)
+        return net_part, mem_part, ioc_part
+
+    def _results_from_state(self, n_quanta: int) -> SimResults:
+        """SimResults from the CURRENT state (after run_chunk loops)."""
+        state = self.state
+        net_part, mem_part, ioc_part = self._result_parts(state)
+        core_h, net_h, mem_h, ioc_h = jax.device_get((
+            state.core, net_part, mem_part, ioc_part,
+        ))
+        return self._results_host(core_h, net_h, mem_h, n_quanta, ioc_h)
+
+    def write_output(self, results: SimResults,
+                     output_dir: str = "results") -> str:
+        """Write the `sim.out` summary + a config snapshot, mirroring the
+        reference's per-run results directory (`carbon_sim.cfg:11-30`,
+        `simulator.cc:152-170`)."""
+        import os
+
+        os.makedirs(output_dir, exist_ok=True)
+        out_path = os.path.join(output_dir, "sim.out")
+        with open(out_path, "w") as f:
+            f.write(results.summary() + "\n")
+        with open(os.path.join(output_dir, "carbon_sim.cfg"), "w") as f:
+            for key, value in sorted(self.config.cfg.as_dict().items()):
+                f.write(f"{key} = {value}\n")
+        return out_path
+
     def warmup(self, max_quanta: int = 1_000_000) -> None:
         """Compile (and execute once, discarding results) the full runner —
         for benchmarking so timed runs exclude compilation."""
@@ -321,30 +394,10 @@ class Simulator:
             self.state)
         # ONE batched device→host fetch for control flags + all summary
         # counters (each separate read over a tunneled chip costs ~100 ms).
-        mem_part = (
-            (state.mem.counters, state.mem.func_errors)
-            if state.mem is not None else None
-        )
-        ioc_part = (
-            {
-                "load_queue": state.ioc.load_queue_stall_ps,
-                "store_queue": state.ioc.store_queue_stall_ps,
-                "l1icache": state.ioc.l1icache_stall_ps,
-                "intra_ins_l1dcache": state.ioc.intra_ins_l1dcache_stall_ps,
-                "inter_ins_l1dcache": state.ioc.inter_ins_l1dcache_stall_ps,
-                "intra_ins_execution_unit":
-                    state.ioc.intra_ins_execution_unit_stall_ps,
-                "inter_ins_execution_unit":
-                    state.ioc.inter_ins_execution_unit_stall_ps,
-            }
-            if state.ioc is not None else None
-        )
+        net_part, mem_part, ioc_part = self._result_parts(state)
         host = jax.device_get((
             n_quanta_dev, deadlock_dev, state.net.overflow, state.done,
-            state.core,
-            (state.net.packets_sent, state.net.packets_received,
-             state.net.total_latency_ps),
-            mem_part, ioc_part,
+            state.core, net_part, mem_part, ioc_part,
         ))
         (n_quanta, deadlock, overflow, done, core_h, net_h, mem_h,
          ioc_h) = host

@@ -123,7 +123,8 @@ class SimState:
     noc_user: "object" = None
     # iocoom core-model state (None unless core type = iocoom)
     ioc: "object" = None
-    # per-domain DVFS state (None in minimal configs)
+    # per-domain DVFS state (always populated by Simulator; the None path
+    # exists only for direct engine-level construction in tests)
     dvfs: "object" = None
 
 

@@ -72,7 +72,8 @@ class EngineParams:
     n_conds: int = 64          # cond-variable id space (sync tables)
     # iocoom core model (None = simple 1-IPC in-order model)
     iocoom: "object" = None    # IocoomParams | None
-    # DVFS tables (None = fixed frequencies, DVFS_SET is a raw freq poke)
+    # DVFS tables (always set by Simulator; the None fallback — a raw
+    # frequency poke without validation — serves direct engine-level use)
     dvfs: "object" = None      # DvfsParams | None
     # memory subsystem (None = enable_shared_mem false: memory operands
     # cost nothing, like the reference's disabled shared-mem knob)

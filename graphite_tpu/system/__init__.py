@@ -1,0 +1,19 @@
+"""System-level services around the engine (the MCP/LCP service layer).
+
+Reference: `common/system/` — StatisticsManager periodic sampling
+(`statistics_manager.h:7-29`), the per-tile `Log` (`misc/log.h:13-110`),
+progress trace (`pin/progress_trace.cc`), and the `sim.out` summary writer
+(`simulator.cc:135-203`).  Checkpoint/resume is ABSENT in the reference
+(SURVEY §5) — here the state pytree *is* the checkpoint, so it comes free.
+"""
+
+from graphite_tpu.system.checkpoint import load_checkpoint, save_checkpoint
+from graphite_tpu.system.log import Log
+from graphite_tpu.system.statistics import StatisticsManager
+
+__all__ = [
+    "Log",
+    "StatisticsManager",
+    "load_checkpoint",
+    "save_checkpoint",
+]

@@ -1,0 +1,62 @@
+"""Checkpoint/resume of simulation state.
+
+The reference has NO checkpointing (SURVEY §5: a host death hangs the
+simulation and all progress is lost).  Here the entire simulation state is
+one pytree of dense arrays, so a checkpoint is a flat npz of its leaves
+plus the quantum counter; resume rebuilds the Simulator from the same
+config+trace and restores the leaves.  Bitwise-exact: a resumed run
+produces the same final state as an uninterrupted one (tested).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+_SEP = "||"
+
+
+def _flatten_with_paths(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = _SEP.join(
+            getattr(p, "name", None) or str(getattr(p, "idx", p))
+            for p in path
+        )
+        out[key] = np.asarray(leaf)
+    return out, treedef
+
+
+def save_checkpoint(sim, path: str, n_quanta: int = 0) -> None:
+    """Write the Simulator's current state (+ progress marker) to `path`."""
+    leaves, _ = _flatten_with_paths(sim.state)
+    leaves["__n_quanta__"] = np.asarray(n_quanta)
+    np.savez_compressed(path, **leaves)
+
+
+def load_checkpoint(sim, path: str) -> int:
+    """Restore state saved by save_checkpoint into a Simulator built from
+    the SAME config and trace.  Returns the saved quantum counter."""
+    with np.load(path) as data:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(sim.state)
+        restored = []
+        for p, leaf in flat:
+            key = _SEP.join(
+                getattr(q, "name", None) or str(getattr(q, "idx", q))
+                for q in p
+            )
+            if key not in data:
+                raise ValueError(
+                    f"checkpoint missing leaf {key!r} — was it saved from "
+                    "a different config/topology?")
+            arr = data[key]
+            if arr.shape != leaf.shape:
+                raise ValueError(
+                    f"checkpoint leaf {key!r} shape {arr.shape} != "
+                    f"state shape {leaf.shape}")
+            restored.append(jax.numpy.asarray(arr, leaf.dtype))
+        sim.state = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(sim.state), restored)
+        return int(data["__n_quanta__"])

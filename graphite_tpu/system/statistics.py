@@ -1,0 +1,179 @@
+"""Time-sampled statistics traces (`common/system/statistics_manager.cc`).
+
+Reference behavior: a statistics thread wakes at every barrier quantum that
+crosses the sampling interval and appends cache-line-replication and
+network-utilization records to trace files (`statistics_thread.h:8-28`,
+knobs `carbon_sim.cfg:394-411`).  Device-driven equivalent: the simulation
+runs in bounded-quantum chunks sized to the sampling interval; between
+chunks the sampler reads the state it needs in one batched device fetch and
+appends records.  (Each sample costs one host↔device round trip — only
+stats-enabled runs pay it, like the reference only pays when
+[statistics_trace] enabled.)
+
+Cache-line replication: from the L2 tag tensors directly — the number of
+tiles caching each distinct line, as a histogram (the reference walks every
+cache; here it is one np.unique over the tag arrays).
+Network utilization: per-interval injection rate on the USER network
+(exact, from packet counters) and the MEMORY network (message count
+approximated from the protocol event counters).
+Progress trace (`pin/progress_trace.cc`): per-tile clock/record progress
+per sample.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+
+
+class StatisticsManager:
+    """Drives a Simulator in sampling-interval chunks, writing traces."""
+
+    def __init__(self, sim, output_dir: str = "stats"):
+        cfg = sim.config.cfg
+        self.sim = sim
+        self.enabled = cfg.get_bool("statistics_trace/enabled", False)
+        stats = cfg.get_string(
+            "statistics_trace/statistics",
+            "cache_line_replication, network_utilization")
+        self.types = {s.strip() for s in stats.split(",") if s.strip()}
+        self.sampling_interval_ns = cfg.get_int(
+            "statistics_trace/sampling_interval", 10000)
+        self.progress_enabled = cfg.get_bool("progress_trace/enabled", False)
+        self.out_dir = output_dir
+        self._files: dict = {}
+        self._prev_user_packets = 0.0
+        self._prev_mem_msgs = 0.0
+        self._prev_sample_ns = 0
+
+    # -- trace files (`openTraceFiles`) ---------------------------------
+    def _file(self, name: str):
+        if name not in self._files:
+            os.makedirs(self.out_dir, exist_ok=True)
+            self._files[name] = open(
+                os.path.join(self.out_dir, f"{name}.trace"), "w")
+        return self._files[name]
+
+    def close(self) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+    # -- samplers --------------------------------------------------------
+    def replication_histogram(self) -> np.ndarray:
+        """hist[k] = number of distinct lines cached by exactly k tiles
+        (k = 1..n_tiles), from the L2 tag/state tensors."""
+        ms = self.sim.state.mem
+        if ms is None:
+            return np.zeros(self.sim.params.n_tiles, np.int64)
+        tags, state = jax.device_get((ms.l2.tags, ms.l2.state))
+        valid = state != 0  # INVALID == 0
+        lines = tags[valid]
+        if lines.size == 0:
+            return np.zeros(self.sim.params.n_tiles, np.int64)
+        _, counts = np.unique(lines, return_counts=True)
+        hist = np.bincount(counts, minlength=self.sim.params.n_tiles + 1)
+        return hist[1:]
+
+    def _memory_message_count(self, mem_counters) -> float:
+        """Protocol messages ≈ 2x misses (req+rep) + 2x invalidations +
+        evictions (approximation: the reference counts per-packet)."""
+        if mem_counters is None:
+            return 0.0
+        return float(
+            2 * mem_counters["l2_misses"].sum()
+            + 2 * mem_counters["invalidations"].sum()
+            + mem_counters["evictions"].sum())
+
+    def _sim_time_ns(self) -> int:
+        """Current simulated time: the laggard non-done tile's clock (the
+        barrier boundary the quantum loop just crossed), or the max clock
+        when all tiles are done."""
+        done, clocks = jax.device_get(
+            (self.sim.state.done, self.sim.state.core.clock_ps))
+        pending = clocks[~done]
+        t = pending.min() if pending.size else clocks.max()
+        return int(t) // 1000
+
+    def sample(self, time_ns: int) -> None:
+        state = self.sim.state
+        if not self.enabled:
+            # [statistics_trace] enabled=false: only the independently
+            # gated progress trace may write
+            if self.progress_enabled:
+                clocks, idx = jax.device_get(
+                    (state.core.clock_ps, state.core.idx))
+                row = " ".join(
+                    f"{c // 1000}/{i}" for c, i in zip(clocks, idx))
+                self._file("progress").write(f"{time_ns} {row}\n")
+            return
+        if "cache_line_replication" in self.types and state.mem is not None:
+            hist = self.replication_histogram()
+            nz = np.flatnonzero(hist)
+            row = " ".join(f"{k + 1}:{hist[k]}" for k in nz)
+            self._file("cache_line_replication").write(
+                f"{time_ns} {row}\n")
+        if "network_utilization" in self.types:
+            interval_ns = max(time_ns - self._prev_sample_ns, 1)
+            sent, = jax.device_get((state.net.packets_sent,))
+            total = float(sent.sum())
+            delta = total - self._prev_user_packets
+            self._prev_user_packets = total
+            rate = delta / interval_ns / max(self.sim.params.n_tiles, 1)
+            self._file("network_utilization_user").write(
+                f"{time_ns} {rate:.6f}\n")
+            if state.mem is not None:
+                import dataclasses as _dc
+
+                counters_h = jax.device_get(state.mem.counters)
+                mc = {f.name: np.asarray(getattr(counters_h, f.name))
+                      for f in _dc.fields(counters_h)}
+                msgs = self._memory_message_count(mc)
+                mdelta = msgs - self._prev_mem_msgs
+                self._prev_mem_msgs = msgs
+                mrate = mdelta / interval_ns / max(
+                    self.sim.params.n_tiles, 1)
+                self._file("network_utilization_memory").write(
+                    f"{time_ns} {mrate:.6f}\n")
+        self._prev_sample_ns = time_ns
+        if self.progress_enabled:
+            clocks, idx = jax.device_get(
+                (state.core.clock_ps, state.core.idx))
+            row = " ".join(f"{c // 1000}/{i}" for c, i in zip(clocks, idx))
+            self._file("progress").write(f"{time_ns} {row}\n")
+
+    # -- sampled run (`statistics_thread` + barrier wakeups) -------------
+    def run(self, max_samples: int = 100000):
+        """Run the simulation to completion, sampling every interval.
+
+        Requires lax_barrier (the reference demands the same:
+        `carbon_sim.cfg:397`); the chunk size is
+        sampling_interval / barrier quantum, so samples land on quantum
+        boundaries exactly as the reference's statistics thread does.
+        """
+        sim = self.sim
+        if sim.quantum_ps is None:
+            raise ValueError(
+                "statistics sampling needs clock_skew_management/scheme = "
+                "lax_barrier (reference requirement)")
+        interval_ps = self.sampling_interval_ns * 1000
+        quanta_per_sample = max(1, interval_ps // sim.quantum_ps)
+        total_quanta = 0
+        done = False
+        for s in range(max_samples):
+            done, nq = sim.run_chunk(int(quanta_per_sample))
+            total_quanta += nq
+            # timestamp from the device clocks: the loop skips empty
+            # quanta, so iteration count is NOT simulated time
+            self.sample(time_ns=self._sim_time_ns())
+            if done:
+                break
+        self.close()
+        if not done:
+            raise RuntimeError(
+                f"statistics run truncated: {max_samples} samples "
+                f"({total_quanta} quanta) without completing")
+        return sim._results_from_state(total_quanta)

@@ -104,8 +104,10 @@ class TestCoreTiming:
         assert r.instruction_count[0] == 1  # dynamic instrs count
 
     def test_core_frequency_scales_costs(self):
-        # CORE domain at 2 GHz: 1 cycle = 500 ps
-        sc = make_config(extra='[dvfs]\ndomains = "<2.0, CORE, L1_ICACHE, '
+        # CORE domain at 2 GHz: 1 cycle = 500 ps (max_frequency must allow
+        # the domain's initial frequency — DvfsParams validates)
+        sc = make_config(extra='[general]\nmax_frequency = 2.0\n'
+                         '[dvfs]\ndomains = "<2.0, CORE, L1_ICACHE, '
                          'L1_DCACHE, L2_CACHE, DIRECTORY, NETWORK_USER, '
                          'NETWORK_MEMORY>"\n')
         bs = [TraceBuilder().instr(Op.IALU) for _ in range(4)]

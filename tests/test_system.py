@@ -1,0 +1,166 @@
+"""System services: checkpoint/resume, statistics traces, log, sim.out.
+
+Checkpoint/resume is bitwise-exact (SURVEY §5 improvement over the
+reference, which has none); statistics sampling mirrors
+statistics_manager.cc trace output; Log mirrors misc/log.h filters.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine import Simulator
+from graphite_tpu.system import (
+    Log, StatisticsManager, load_checkpoint, save_checkpoint,
+)
+from graphite_tpu.trace import synthetic
+from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+
+def make_config(n_tiles=4, scheme="lax_barrier", extra=""):
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = true
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+generic = 1
+mov = 1
+ialu = 1
+[clock_skew_management]
+scheme = {scheme}
+[clock_skew_management/lax_barrier]
+quantum = 1000
+{extra}
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+def mem_workload(n_tiles=4, n=40):
+    builders = []
+    for t in range(n_tiles):
+        b = TraceBuilder()
+        for i in range(n):
+            b.store_value(t * 0x10000 + i * 64, i)
+            b.load_check(t * 0x10000 + i * 64, i)
+        builders.append(b)
+    return TraceBatch.from_builders(builders)
+
+
+class TestCheckpoint:
+    def test_resume_is_bitwise_identical(self, tmp_path):
+        sc = make_config()
+        batch = mem_workload()
+        # uninterrupted reference run
+        ref = Simulator(sc, batch)
+        r_ref = ref.run()
+
+        # checkpointed run: a few quanta, save, restore into a NEW sim
+        sim1 = Simulator(sc, batch)
+        done, nq = sim1.run_chunk(3)
+        assert not done
+        ckpt = str(tmp_path / "ckpt.npz")
+        save_checkpoint(sim1, ckpt, n_quanta=nq)
+
+        sim2 = Simulator(sc, batch)
+        resumed_quanta = load_checkpoint(sim2, ckpt)
+        assert resumed_quanta == nq
+        r2 = sim2.run()
+        np.testing.assert_array_equal(r_ref.clock_ps, r2.clock_ps)
+        np.testing.assert_array_equal(
+            r_ref.instruction_count, r2.instruction_count)
+        for k in r_ref.mem_counters:
+            np.testing.assert_array_equal(
+                r_ref.mem_counters[k], r2.mem_counters[k], err_msg=k)
+
+    def test_checkpoint_rejects_wrong_topology(self, tmp_path):
+        sim4 = Simulator(make_config(4), mem_workload(4))
+        ckpt = str(tmp_path / "c.npz")
+        save_checkpoint(sim4, ckpt)
+        sim2 = Simulator(make_config(2), mem_workload(2))
+        with pytest.raises(ValueError):
+            load_checkpoint(sim2, ckpt)
+
+
+class TestStatistics:
+    def test_sampled_run_writes_traces(self, tmp_path):
+        extra = """
+[statistics_trace]
+enabled = true
+statistics = "cache_line_replication, network_utilization"
+sampling_interval = 2000
+[progress_trace]
+enabled = true
+"""
+        sc = make_config(extra=extra)
+        sim = Simulator(sc, mem_workload())
+        stats = StatisticsManager(sim, output_dir=str(tmp_path))
+        results = stats.run()
+        assert results.func_errors == 0
+        rep = (tmp_path / "cache_line_replication.trace").read_text()
+        assert len(rep.strip().splitlines()) >= 1
+        net = (tmp_path / "network_utilization_memory.trace").read_text()
+        assert len(net.strip().splitlines()) >= 1
+        prog = (tmp_path / "progress.trace").read_text()
+        assert len(prog.strip().splitlines()) >= 1
+
+    def test_replication_histogram_counts_sharers(self):
+        """All tiles read one line: its replication count = n_tiles."""
+        sc = make_config(4)
+        builders = []
+        for t in range(4):
+            b = TraceBuilder()
+            if t == 0:
+                b.barrier_init(0, 4)
+                b.store_value(0x40, 7)
+            b.barrier_wait(0)
+            b.load_check(0x40, 7)
+            builders.append(b)
+        sim = Simulator(sc, TraceBatch.from_builders(builders))
+        sim.run()
+        stats = StatisticsManager(sim)
+        hist = stats.replication_histogram()
+        # the shared line is cached by all 4 tiles
+        assert hist[3] >= 1
+
+
+class TestLogAndOutput:
+    def test_log_filters_and_files(self, tmp_path):
+        cfg = ConfigFile.from_string("""
+[log]
+enabled = true
+disabled_modules = "network"
+""")
+        log = Log(cfg, output_dir=str(tmp_path))
+        assert log.is_logging_enabled("core")
+        assert not log.is_logging_enabled("network")
+        log.log("core", "hello", tile_id=2, sim_time_ns=123)
+        log.log("network", "dropped", tile_id=2)
+        log.close()
+        text = (tmp_path / "tile_2.log").read_text()
+        assert "hello" in text and "[123ns]" in text
+        assert "dropped" not in text
+        with pytest.raises(AssertionError):
+            log.assert_error(False, "core", "boom")
+
+    def test_sim_out_written(self, tmp_path):
+        sc = make_config()
+        sim = Simulator(sc, mem_workload())
+        results = sim.run()
+        out = sim.write_output(results, output_dir=str(tmp_path))
+        text = open(out).read()
+        assert "Simulation Summary" in text
+        assert "Tile 0 Summary" in text
+        assert (tmp_path / "carbon_sim.cfg").exists()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
