@@ -175,7 +175,8 @@ class Simulator:
 
             mem_params = MemParams.from_config(config)
             supported = ("pr_l1_pr_l2_dram_directory_msi",
-                         "pr_l1_pr_l2_dram_directory_mosi")
+                         "pr_l1_pr_l2_dram_directory_mosi",
+                         "pr_l1_sh_l2_msi", "pr_l1_sh_l2_mesi")
             if mem_params.protocol not in supported:
                 raise NotImplementedError(
                     f"caching protocol {mem_params.protocol!r} pending "
@@ -250,7 +251,14 @@ class Simulator:
         if mem_params is not None:
             from graphite_tpu.memory import init_mem_state
 
-            self.state = self.state.replace(mem=init_mem_state(mem_params))
+            if mem_params.protocol.startswith("pr_l1_sh_l2"):
+                from graphite_tpu.memory.engine_shl2 import init_shl2_state
+
+                self.state = self.state.replace(
+                    mem=init_shl2_state(mem_params))
+            else:
+                self.state = self.state.replace(
+                    mem=init_mem_state(mem_params))
         if user_hbh is not None:
             from graphite_tpu.models.network_hop_by_hop import init_noc_state
 

@@ -154,10 +154,15 @@ def subquantum_iteration(
     if params.mem is not None:
         from graphite_tpu.memory.engine import RecView, memory_engine_step
 
+        if params.mem.protocol.startswith("pr_l1_sh_l2"):
+            from graphite_tpu.memory.engine_shl2 import shl2_engine_step
+            engine_step = shl2_engine_step
+        else:
+            engine_step = memory_engine_step
         addr0, addr1 = fetched[6], fetched[7]
         rec = RecView(op=op, flags=flags, pc=pc, addr0=addr0, addr1=addr1,
                       aux0=aux0, aux1=aux1)
-        mem_out = memory_engine_step(
+        mem_out = engine_step(
             params.mem, state.mem, rec, core.clock_ps, core.freq_mhz,
             active, enabled)
         mem_state = mem_out.ms

@@ -1,0 +1,861 @@
+"""Vectorized shared-L2 protocol engine (pr_l1_sh_l2_msi / _mesi).
+
+Reference: `common/tile/memory_subsystem/pr_l1_sh_l2_{msi,mesi}/` — private
+L1s with a DISTRIBUTED shared L2: the L2 slice at a line's home tile holds
+both the data and an embedded directory entry over the L1 copies
+(`l2_cache_cntlr.h:27-67`, `l2_directory_cfg.cc`).  An L1 miss sends
+EX/SH_REQ to the home (`l1_cache_cntlr.cc:81-160`); the home's L2 either
+serves it (running the directory FSM over the L1 sharers,
+`l2_cache_cntlr.cc:443-700`) or allocates the line in state DATA_INVALID
+and fetches it from DRAM (`:541-560,900-915`).  MESI grants EXCLUSIVE on a
+read of an uncached line (`pr_l1_sh_l2_mesi/l2_cache_cntlr.cc:660-680`).
+
+Vectorized form mirrors engine.py's discipline: one lane per tile, dense
+mailboxes, one active transaction per home, simulated time carried in
+messages.  Documented simplifications (same class as engine.py's):
+ - upgrade replies are modeled as EX_REP (same message count, the data
+   serialization is slightly larger than the reference's UPGRADE_REP);
+ - one transaction per home serializes same-home requests (the reference
+   queues per address);
+ - the DRAM fetch is a timing-only round trip to the line's DRAM home
+   (`dram_home_lookup`), not a separate controller state machine.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from graphite_tpu.memory import cache_array as ca
+from graphite_tpu.memory.cache_array import (
+    EXCLUSIVE, INVALID, MODIFIED, SHARED,
+    state_readable, state_writable,
+)
+from graphite_tpu.memory.engine import (
+    MemStepOut, RecView, _row_earliest, clear_bit,
+    mem_net_latency_ps, set_bit, test_bit, unpack_sharers,
+)
+from graphite_tpu.memory.params import MemParams
+from graphite_tpu.memory.state import (
+    DIR_MODIFIED, DIR_SHARED, DIR_UNCACHED,
+    MOD_CORE, MOD_L1D, MOD_L1I, MOD_L2, MOD_NET_MEM,
+    MSG_EX_REP, MSG_EX_REQ, MSG_EXCL_REP, MSG_FLUSH_REP, MSG_FLUSH_REQ,
+    MSG_INV_REP, MSG_INV_REQ, MSG_NONE, MSG_NULLIFY, MSG_SH_REP, MSG_SH_REQ,
+    MSG_WB_REP, MSG_WB_REQ,
+    PHASE_IDLE, PHASE_WAIT_REPLY,
+    MemCounters, MemMailboxes, RequesterState, init_mem_common,
+)
+from graphite_tpu.time_types import cycles_to_ps
+from graphite_tpu.trace.schema import (
+    FLAG_CHECK, FLAG_MEM0_VALID, FLAG_MEM0_WRITE, FLAG_MEM1_VALID,
+    FLAG_MEM1_WRITE,
+)
+
+I64 = jnp.int64
+U32 = jnp.uint32
+FAR = 2**62
+
+# L2 slice data state (`cache_line_info.h` ShL2CacheLineInfo): the line is
+# allocated (directory live) but its data is still in flight from DRAM
+DATA_INVALID = 5
+
+# MESI directory state for an exclusive clean L1 copy
+DIR_EXCLUSIVE = 4
+
+
+@struct.dataclass
+class ShL2Dir:
+    """Per-L2-line embedded directory [T(home), S2, W2, ...]."""
+
+    dstate: jax.Array    # uint8
+    owner: jax.Array     # int32
+    sharers: jax.Array   # uint32[..., SW]
+    nsharers: jax.Array  # int32
+    cloc: jax.Array      # uint8 — caching component (MOD_L1I / MOD_L1D)
+
+
+@struct.dataclass
+class ShL2Txn:
+    active: jax.Array      # bool[T]
+    mtype: jax.Array       # uint8[T]
+    line: jax.Array        # int32[T]
+    requester: jax.Array   # int32[T]
+    req_comp: jax.Array    # uint8[T] MOD_L1I / MOD_L1D
+    time_ps: jax.Array     # int64[T]
+    pending: jax.Array     # uint32[T, SW]
+    dram_ready_ps: jax.Array  # int64[T] (FAR = no fetch in flight)
+    got_flush: jax.Array   # bool[T] — dirty data arrived (L2 turns M)
+    saved_valid: jax.Array
+    saved_type: jax.Array
+    saved_line: jax.Array
+    saved_requester: jax.Array
+    saved_comp: jax.Array
+    saved_time_ps: jax.Array
+    last_line: jax.Array
+    last_done_ps: jax.Array
+
+
+@struct.dataclass
+class ShL2State:
+    l1i: ca.CacheArrays
+    l1d: ca.CacheArrays
+    l2: ca.CacheArrays          # the local SLICE (home-indexed lines)
+    dir: ShL2Dir
+    mail: MemMailboxes
+    txn: ShL2Txn
+    req: RequesterState
+    counters: MemCounters
+    func_mem: jax.Array
+    func_errors: jax.Array
+
+
+def init_shl2_state(mp: MemParams) -> ShL2State:
+    """Build from the shared pieces (L1/L2 arrays, mailboxes, requester)."""
+    base = init_mem_common(mp)
+    T = mp.n_tiles
+    S2, W2 = mp.l2.num_sets, mp.l2.num_ways
+    SW = mp.sharer_words
+    zdir = ShL2Dir(
+        dstate=jnp.zeros((T, S2, W2), jnp.uint8),
+        owner=jnp.full((T, S2, W2), -1, jnp.int32),
+        sharers=jnp.zeros((T, S2, W2, SW), U32),
+        nsharers=jnp.zeros((T, S2, W2), jnp.int32),
+        cloc=jnp.zeros((T, S2, W2), jnp.uint8),
+    )
+    txn = ShL2Txn(
+        active=jnp.zeros(T, jnp.bool_),
+        mtype=jnp.zeros(T, jnp.uint8),
+        line=jnp.zeros(T, jnp.int32),
+        requester=jnp.zeros(T, jnp.int32),
+        req_comp=jnp.zeros(T, jnp.uint8),
+        time_ps=jnp.zeros(T, I64),
+        pending=jnp.zeros((T, SW), U32),
+        dram_ready_ps=jnp.full(T, FAR, I64),
+        got_flush=jnp.zeros(T, jnp.bool_),
+        saved_valid=jnp.zeros(T, jnp.bool_),
+        saved_type=jnp.zeros(T, jnp.uint8),
+        saved_line=jnp.zeros(T, jnp.int32),
+        saved_requester=jnp.zeros(T, jnp.int32),
+        saved_comp=jnp.zeros(T, jnp.uint8),
+        saved_time_ps=jnp.zeros(T, I64),
+        last_line=jnp.full(T, -1, jnp.int32),
+        last_done_ps=jnp.zeros(T, I64),
+    )
+    return ShL2State(dir=zdir, txn=txn, **base)
+
+
+def _l2_home(mp: MemParams, line):
+    """The L2 slice holding `line`: interleaved over ALL tiles
+    (`l2_cache_hash_fn.cc` home lookup)."""
+    return (line % mp.n_tiles).astype(jnp.int32)
+
+
+def _dram_lat_ps(mp: MemParams, home, enabled):
+    """DRAM fetch round trip from the home's L2 slice: network to the DRAM
+    home + access + return (`DRAM_FETCH_REQ`/`REP`)."""
+    mc = jnp.asarray(mp.mc_tiles, jnp.int32)
+    dram_home = mc[(home % len(mp.mc_tiles)).astype(jnp.int32)]
+    net = mem_net_latency_ps(mp, home, dram_home, mp.rep_bits, enabled)
+    acc = jnp.where(enabled,
+                    (mp.dram_latency_ns + mp.dram_processing_ns) * 1000, 0)
+    return 2 * net + acc
+
+
+def _dir_at(d: ShL2Dir, tiles, sets, way):
+    return (d.dstate[tiles, sets, way], d.owner[tiles, sets, way],
+            d.sharers[tiles, sets, way], d.nsharers[tiles, sets, way],
+            d.cloc[tiles, sets, way])
+
+
+def _dir_set(d: ShL2Dir, tiles, sets, way, mask, *, dstate=None, owner=None,
+             sharers=None, nsharers=None, cloc=None) -> ShL2Dir:
+    def upd(arr, val, cast=None):
+        if val is None:
+            return arr
+        cur = arr[tiles, sets, way]
+        new = jnp.where(mask, val, cur) if arr.ndim == 3 else jnp.where(
+            mask[:, None], val, cur)
+        if cast is not None:
+            new = new.astype(cast)
+        return arr.at[tiles, sets, way].set(new)
+
+    return d.replace(
+        dstate=upd(d.dstate, dstate, jnp.uint8),
+        owner=upd(d.owner, owner, jnp.int32),
+        sharers=upd(d.sharers, sharers),
+        nsharers=upd(d.nsharers, nsharers, jnp.int32),
+        cloc=upd(d.cloc, cloc, jnp.uint8),
+    )
+
+
+def shl2_engine_step(
+    mp: MemParams,
+    ms: ShL2State,
+    rec: RecView,
+    clock_ps: jax.Array,
+    freq_mhz: jax.Array,
+    active: jax.Array,
+    enabled,
+) -> MemStepOut:
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    fmhz = freq_mhz.astype(I64)
+    progress = jnp.zeros((), jnp.int32)
+    mesi = mp.protocol.endswith("mesi")
+
+    def ccyc(n, f=None):
+        ps = cycles_to_ps(jnp.asarray(n, I64), fmhz if f is None else f)
+        return jnp.where(enabled, ps, 0)
+
+    sync_core_l1 = ccyc(mp.sync_cycles(MOD_CORE, MOD_L1D))
+    sync_l1_net = ccyc(mp.sync_cycles(MOD_L1D, MOD_NET_MEM))
+    sync_l2_net = ccyc(mp.sync_cycles(MOD_L2, MOD_NET_MEM))
+    l2_access = ccyc(mp.l2.data_and_tags_cycles)
+
+    # ======================================================================
+    # (1) requester slot starts: L1-only lookup; misses go to the L2 home
+    # ======================================================================
+    flags = rec.flags
+    is_instr = (rec.op < 15) | (rec.op == 50)
+    icache_present = (jnp.asarray(mp.icache_modeling)
+                      & jnp.asarray(enabled) & is_instr)
+    mem0_present = (flags & FLAG_MEM0_VALID) != 0
+    mem1_present = (flags & FLAG_MEM1_VALID) != 0
+    present = jnp.stack([icache_present, mem0_present, mem1_present], axis=1)
+
+    def next_present(slot):
+        k = jnp.arange(3)[None, :]
+        cand = jnp.where(present & (k >= slot[:, None]), k, 3)
+        return cand.min(axis=1).astype(jnp.int32)
+
+    slot = next_present(ms.req.slot)
+    has_slot = slot < 3
+    idle = ms.req.phase == PHASE_IDLE
+    starting = active & idle & has_slot
+
+    s_is_icache = slot == 0
+    s_addr = jnp.where(
+        s_is_icache, rec.pc.astype(jnp.int32),
+        jnp.where(slot == 1, rec.addr0.astype(jnp.int32),
+                  rec.addr1.astype(jnp.int32)))
+    s_line = (s_addr.astype(jnp.uint32) >> mp.line_bits).astype(jnp.int32)
+    s_write = jnp.where(
+        s_is_icache, False,
+        jnp.where(slot == 1, (flags & FLAG_MEM0_WRITE) != 0,
+                  (flags & FLAG_MEM1_WRITE) != 0))
+
+    ibuf_hit = starting & s_is_icache & (s_line == ms.req.instr_buf)
+    new_instr_buf = jnp.where(starting & s_is_icache, s_line,
+                              ms.req.instr_buf)
+
+    l1i_hit, l1i_way, l1i_state = ca.lookup(ms.l1i, s_line)
+    l1d_hit, l1d_way, l1d_state = ca.lookup(ms.l1d, s_line)
+    l1_state = jnp.where(s_is_icache, l1i_state, l1d_state)
+    l1_permit = jnp.where(s_write, state_writable(l1_state),
+                          state_readable(l1_state))
+    do_l1 = starting & ~ibuf_hit
+    l1_hit_now = do_l1 & l1_permit
+    l1_miss = do_l1 & ~l1_permit
+
+    l1_dat = jnp.where(s_is_icache, ccyc(mp.l1i.data_and_tags_cycles),
+                       ccyc(mp.l1d.data_and_tags_cycles))
+    l1_tag = jnp.where(s_is_icache, ccyc(mp.l1i.tags_cycles),
+                       ccyc(mp.l1d.tags_cycles))
+    sclock = clock_ps + sync_core_l1
+    l1_hit_done_ps = sclock + l1_dat
+
+    # MESI silent upgrade: a write to an EXCLUSIVE L1 line promotes to M
+    # with no messages (the write-hit path: E is writable)
+    promote = l1_hit_now & s_write & (l1_state == EXCLUSIVE)
+    l1d_upd = ca.set_state(ms.l1d, s_line, l1d_way, MODIFIED,
+                           promote & ~s_is_icache)
+    l1i_upd = ms.l1i
+    l1i_upd = ca.touch_lru(l1i_upd, s_line, l1i_way, l1_hit_now & s_is_icache)
+    l1d_upd = ca.touch_lru(l1d_upd, s_line, l1d_way,
+                           l1_hit_now & ~s_is_icache)
+
+    # L1 miss: an upgrade (write to readable-but-unwritable line) keeps the
+    # line until the reply; a plain miss sends the request right away.  In
+    # both cases the L1 stays untouched here — the FILL path replaces it.
+    s_home = _l2_home(mp, s_line)
+    rq_type = jnp.where(s_write, MSG_EX_REQ, MSG_SH_REQ).astype(jnp.uint8)
+    req_send_ps = sclock + l1_tag + sync_l1_net
+    rq_arrival = req_send_ps + mem_net_latency_ps(
+        mp, tiles, s_home, mp.req_bits, enabled)
+    mail = ms.mail
+    rq_home = jnp.where(l1_miss, s_home, 0)
+    mail = mail.replace(
+        req_type=mail.req_type.at[rq_home, tiles].set(
+            jnp.where(l1_miss, rq_type, mail.req_type[rq_home, tiles])),
+        req_line=mail.req_line.at[rq_home, tiles].set(
+            jnp.where(l1_miss, s_line, mail.req_line[rq_home, tiles])),
+        req_time=mail.req_time.at[rq_home, tiles].set(
+            jnp.where(l1_miss, rq_arrival, mail.req_time[rq_home, tiles])),
+    )
+
+    slot_done_now = ibuf_hit | l1_hit_now
+    slot_done_ps = jnp.where(ibuf_hit, clock_ps + ccyc(1), l1_hit_done_ps)
+    req_state = ms.req.replace(
+        phase=jnp.where(l1_miss, PHASE_WAIT_REPLY, ms.req.phase),
+        line=jnp.where(l1_miss, s_line, ms.req.line),
+        is_write=jnp.where(l1_miss, s_write, ms.req.is_write),
+        component=jnp.where(
+            l1_miss, jnp.where(s_is_icache, MOD_L1I, MOD_L1D),
+            ms.req.component).astype(jnp.uint8),
+        clock_ps=jnp.where(l1_miss, req_send_ps, ms.req.clock_ps),
+        acc_ps=ms.req.acc_ps
+        + jnp.where(slot_done_now, slot_done_ps - clock_ps, 0),
+        slot_lat_ps=jnp.where(
+            (slot_done_now[:, None]
+             & (jnp.arange(3)[None, :] == slot[:, None])),
+            (slot_done_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
+        instr_buf=new_instr_buf,
+        slot=jnp.where(slot_done_now, slot + 1,
+                       jnp.where(starting, slot, ms.req.slot)),
+    )
+    counters = ms.counters.replace(
+        l1i_hits=ms.counters.l1i_hits
+        + ((l1_hit_now | ibuf_hit) & s_is_icache & enabled).astype(I64),
+        l1i_misses=ms.counters.l1i_misses
+        + (l1_miss & s_is_icache & enabled).astype(I64),
+        l1d_read_hits=ms.counters.l1d_read_hits
+        + (l1_hit_now & ~s_is_icache & ~s_write & enabled).astype(I64),
+        l1d_read_misses=ms.counters.l1d_read_misses
+        + (l1_miss & ~s_is_icache & ~s_write & enabled).astype(I64),
+        l1d_write_hits=ms.counters.l1d_write_hits
+        + (l1_hit_now & ~s_is_icache & s_write & enabled).astype(I64),
+        l1d_write_misses=ms.counters.l1d_write_misses
+        + (l1_miss & ~s_is_icache & s_write & enabled).astype(I64),
+    )
+    progress = progress + jnp.sum(slot_done_now | l1_miss, dtype=jnp.int32)
+    ms = ms.replace(l1i=l1i_upd, l1d=l1d_upd, mail=mail, req=req_state,
+                    counters=counters)
+    ms = _apply_functional(mp, ms, rec, slot, s_addr, s_write, slot_done_now)
+
+    # ======================================================================
+    # (2) L1 sharers serve INV/FLUSH/WB from homes
+    # ======================================================================
+    ms, progress = _sharer_step(mp, ms, fmhz, enabled, progress, sync_l1_net)
+
+    # ======================================================================
+    # (3) homes consume L1 evictions (directory + L2 dirty fill)
+    # ======================================================================
+    ms, progress = _home_evictions(mp, ms, l2_access, enabled, progress)
+
+    # ======================================================================
+    # (4) homes consume acks / dram arrivals, finish transactions
+    # ======================================================================
+    ms, progress = _home_finish(mp, ms, l2_access, sync_l2_net, enabled,
+                                progress, mesi)
+
+    # ======================================================================
+    # (5) homes start transactions
+    # ======================================================================
+    ms, progress = _home_starts(mp, ms, l2_access, sync_l2_net, enabled,
+                                progress, mesi)
+
+    # ======================================================================
+    # (6) requesters consume replies (fill L1)
+    # ======================================================================
+    ms, progress = _requester_fill(mp, ms, rec, clock_ps, fmhz, enabled,
+                                   progress, sync_l1_net)
+
+    final_slot = next_present(ms.req.slot)
+    mem_complete = (ms.req.phase == PHASE_IDLE) & (final_slot >= 3)
+    return MemStepOut(
+        ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
+        slot_lat_ps=ms.req.slot_lat_ps, progress=progress,
+    )
+
+
+def _apply_functional(mp, ms: ShL2State, rec: RecView, slot, s_addr,
+                      s_write, mask):
+    if mp.func_mem_words <= 0:
+        return ms
+    word = ((s_addr.astype(jnp.uint32) >> 2) % mp.func_mem_words).astype(
+        jnp.int32)
+    value = jnp.where(slot == 1, rec.aux0, rec.aux1).astype(jnp.uint32)
+    wr = mask & s_write
+    tgt = jnp.where(wr, word, mp.func_mem_words)
+    fm = ms.func_mem.at[tgt].set(jnp.where(wr, value, 0))
+    check = mask & ~s_write & (slot == 1) & ((rec.flags & FLAG_CHECK) != 0)
+    loaded = fm[word]
+    errs = jnp.sum(check & (loaded != rec.aux0.astype(jnp.uint32)),
+                   dtype=I64)
+    return ms.replace(func_mem=fm, func_errors=ms.func_errors + errs)
+
+
+def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net):
+    """L1-side service of INV/FLUSH/WB (`l1_cache_cntlr.cc` handlers)."""
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    mail = ms.mail
+
+    def ccyc(n):
+        ps = cycles_to_ps(jnp.asarray(n, I64), fmhz)
+        return jnp.where(enabled, ps, 0)
+
+    h, found = _row_earliest(mail.fwd_type, mail.fwd_time)
+    ftype = mail.fwd_type[tiles, h]
+    fline = mail.fwd_line[tiles, h]
+    ftime = mail.fwd_time[tiles, h]
+
+    l1i_hit, l1i_way, l1i_state = ca.lookup(ms.l1i, fline)
+    l1d_hit, l1d_way, l1d_state = ca.lookup(ms.l1d, fline)
+    have = l1i_hit | l1d_hit
+    serve = found & have
+    was_dirty = ((l1d_hit & ((l1d_state == MODIFIED)))
+                 | (l1i_hit & (l1i_state == MODIFIED)))
+
+    is_inv = ftype == MSG_INV_REQ
+    is_wb = ftype == MSG_WB_REQ
+    done_ps = ftime + sync_l1_net + ccyc(mp.l1d.data_and_tags_cycles)
+
+    inv_do = serve & ~is_wb
+    l1i = ca.invalidate(ms.l1i, fline, inv_do & l1i_hit)
+    l1d = ca.invalidate(ms.l1d, fline, inv_do & l1d_hit)
+    # WB downgrades M/E -> SHARED, data written back
+    l1i = ca.set_state(l1i, fline, l1i_way, SHARED, serve & is_wb & l1i_hit)
+    l1d = ca.set_state(l1d, fline, l1d_way, SHARED, serve & is_wb & l1d_hit)
+
+    # ack: FLUSH_REP when dirty data travels (flush of M, or WB of M),
+    # else INV_REP / WB_REP
+    ack = jnp.where(
+        is_inv, MSG_INV_REP,
+        jnp.where(is_wb,
+                  jnp.where(was_dirty, MSG_FLUSH_REP, MSG_WB_REP),
+                  MSG_FLUSH_REP)).astype(jnp.uint8)
+    # a FLUSH of a clean (S/E) line carries no data: INV_REP
+    ack = jnp.where((ftype == MSG_FLUSH_REQ) & ~was_dirty, MSG_INV_REP, ack)
+    ack_lat = jnp.where(
+        (ack == MSG_INV_REP),
+        mem_net_latency_ps(mp, tiles, h, mp.req_bits, enabled),
+        mem_net_latency_ps(mp, tiles, h, mp.rep_bits, enabled))
+    wh = jnp.where(serve, h, 0)
+    mail = mail.replace(
+        ack_type=mail.ack_type.at[wh, tiles].set(
+            jnp.where(serve, ack, mail.ack_type[wh, tiles])),
+        ack_line=mail.ack_line.at[wh, tiles].set(
+            jnp.where(serve, fline, mail.ack_line[wh, tiles])),
+        ack_time=mail.ack_time.at[wh, tiles].set(
+            jnp.where(serve, done_ps + ack_lat, mail.ack_time[wh, tiles])),
+    )
+    ch = jnp.where(found, h, 0)
+    mail = mail.replace(
+        fwd_type=mail.fwd_type.at[tiles, ch].set(
+            jnp.where(found, MSG_NONE, mail.fwd_type[tiles, ch])),
+    )
+    counters = ms.counters.replace(
+        invalidations=ms.counters.invalidations
+        + (serve & is_inv & enabled).astype(I64))
+    progress = progress + jnp.sum(found, dtype=jnp.int32)
+    return ms.replace(l1i=l1i, l1d=l1d, mail=mail, counters=counters), \
+        progress
+
+
+def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress):
+    """L1 eviction notices update the embedded directory; dirty flushes
+    land in the L2 slice (its line turns MODIFIED wrt DRAM)."""
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    mail = ms.mail
+
+    src, found = _row_earliest(mail.evict_type, mail.evict_time)
+    etype = mail.evict_type[tiles, src]
+    eline = mail.evict_line[tiles, src]
+    etime = mail.evict_time[tiles, src]
+
+    l2_hit, l2_way, l2_state = ca.lookup(ms.l2, eline)
+    sets = (eline % mp.l2.num_sets).astype(jnp.int32)
+    apply = found & l2_hit
+    dstate, owner, sharers, nsh, cloc = _dir_at(ms.dir, tiles, sets, l2_way)
+
+    was_sharer = test_bit(sharers, src)
+    new_sharers = clear_bit(sharers, src, apply)
+    new_nsh = nsh - (apply & was_sharer).astype(jnp.int32)
+    is_flush = etype == MSG_FLUSH_REP
+    from_owner = src == owner
+    new_owner = jnp.where(apply & from_owner, -1, owner)
+    new_dstate = jnp.where(
+        apply,
+        jnp.where(new_nsh == 0, DIR_UNCACHED, DIR_SHARED),
+        dstate).astype(jnp.uint8)
+    d = _dir_set(ms.dir, tiles, sets, l2_way, apply,
+                 dstate=new_dstate, owner=new_owner,
+                 sharers=new_sharers, nsharers=new_nsh)
+    # dirty flush data lands in the slice
+    l2 = ca.set_state(ms.l2, eline, l2_way, MODIFIED, apply & is_flush)
+
+    txn = ms.txn
+    txn_match = txn.active & found & (txn.line == eline)
+    txn = txn.replace(
+        pending=clear_bit(txn.pending, src, txn_match),
+        time_ps=jnp.where(txn_match,
+                          jnp.maximum(txn.time_ps, etime + l2_access),
+                          txn.time_ps),
+        got_flush=txn.got_flush | (txn_match & is_flush),
+    )
+    csrc = jnp.where(found, src, 0)
+    mail = mail.replace(
+        evict_type=mail.evict_type.at[tiles, csrc].set(
+            jnp.where(found, MSG_NONE, mail.evict_type[tiles, csrc])),
+    )
+    counters = ms.counters.replace(
+        evictions=ms.counters.evictions + (found & enabled).astype(I64))
+    progress = progress + jnp.sum(found, dtype=jnp.int32)
+    return ms.replace(dir=d, l2=l2, mail=mail, txn=txn,
+                      counters=counters), progress
+
+
+def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
+                 progress, mesi):
+    """Consume acks + DRAM arrivals; finish when nothing is pending."""
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    mail = ms.mail
+    txn = ms.txn
+
+    match = (mail.ack_type != MSG_NONE) & txn.active[:, None] & (
+        mail.ack_line == txn.line[:, None])
+    any_match = match.any(axis=1)
+    max_ack = jnp.where(match, mail.ack_time, 0).max(axis=1)
+    got_flush = (match & (mail.ack_type == MSG_FLUSH_REP)).any(axis=1)
+
+    SW = mp.sharer_words
+    pad = SW * 32 - T
+    mpad = jnp.pad(match, ((0, 0), (0, pad)))
+    acked_words = (
+        mpad.reshape(T, SW, 32).astype(U32)
+        << jnp.arange(32, dtype=U32)[None, None, :]
+    ).sum(axis=2, dtype=U32)
+    txn = txn.replace(
+        pending=txn.pending & ~acked_words,
+        time_ps=jnp.where(any_match,
+                          jnp.maximum(txn.time_ps, max_ack + l2_access),
+                          txn.time_ps),
+        got_flush=txn.got_flush | got_flush,
+    )
+    mail = mail.replace(ack_type=jnp.where(
+        mail.ack_type != MSG_NONE, MSG_NONE, mail.ack_type))
+
+    # DRAM arrival: the fetched line fills the slice in SHARED
+    dram_in = txn.active & (txn.dram_ready_ps < FAR) & (
+        txn.pending == 0).all(axis=1)
+    l2 = ms.l2
+    l2_hit, l2_way, _ = ca.lookup(l2, txn.line)
+    l2 = ca.set_state(l2, txn.line, l2_way, SHARED, dram_in & l2_hit)
+    txn = txn.replace(
+        time_ps=jnp.where(dram_in,
+                          jnp.maximum(txn.time_ps, txn.dram_ready_ps),
+                          txn.time_ps),
+        dram_ready_ps=jnp.where(dram_in, FAR, txn.dram_ready_ps),
+    )
+
+    # finish: no pending acks, no pending dram
+    no_pending = (txn.pending == 0).all(axis=1) & (txn.dram_ready_ps >= FAR)
+    finish = txn.active & no_pending
+    is_ex = txn.mtype == MSG_EX_REQ
+    is_sh = txn.mtype == MSG_SH_REQ
+    is_nullify = txn.mtype == MSG_NULLIFY
+
+    sets = (txn.line % mp.l2.num_sets).astype(jnp.int32)
+    _, l2_way, l2_state = ca.lookup(l2, txn.line)
+    r = txn.requester
+    rbit = set_bit(jnp.zeros((T, mp.sharer_words), U32), r, finish)
+    d = ms.dir
+    dstate, owner, sharers, nsh, cloc = _dir_at(d, tiles, sets, l2_way)
+
+    # dirty acks flushed data into the slice
+    l2 = ca.set_state(l2, txn.line, l2_way, MODIFIED,
+                      finish & txn.got_flush & ~is_nullify)
+
+    # EX finish: directory MODIFIED owner=r
+    exf = finish & is_ex
+    d = _dir_set(d, sets=sets, tiles=tiles, way=l2_way, mask=exf,
+                 dstate=jnp.full(T, DIR_MODIFIED, jnp.uint8), owner=r,
+                 sharers=rbit, nsharers=jnp.ones(T, jnp.int32),
+                 cloc=txn.req_comp)
+    # SH finish: add r as a sharer; MESI grants EXCLUSIVE when alone
+    shf = finish & is_sh
+    had = test_bit(sharers, r)
+    alone = (nsh - had.astype(jnp.int32)) == 0
+    excl = shf & alone & mesi
+    sh_dstate = jnp.where(excl, DIR_EXCLUSIVE, DIR_SHARED).astype(jnp.uint8)
+    d = _dir_set(d, tiles=tiles, sets=sets, way=l2_way, mask=shf,
+                 dstate=sh_dstate,
+                 owner=jnp.where(excl, r, -1),
+                 sharers=sharers | rbit,
+                 nsharers=nsh + (~had).astype(jnp.int32),
+                 cloc=txn.req_comp)
+    # NULLIFY finish: entry dies; dirty data (slice M or flushed) → DRAM
+    nlf = finish & is_nullify
+    wb_dram = nlf & ((l2_state == MODIFIED) | txn.got_flush)
+    l2 = ca.invalidate(l2, txn.line, nlf)
+    d = _dir_set(d, tiles=tiles, sets=sets, way=l2_way, mask=nlf,
+                 dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
+                 owner=jnp.full(T, -1, jnp.int32),
+                 sharers=jnp.zeros((T, mp.sharer_words), U32),
+                 nsharers=jnp.zeros(T, jnp.int32))
+
+    # reply to the requester (the slice access was charged at txn start)
+    rep_ready = txn.time_ps + sync_l2_net
+    rep_lat = mem_net_latency_ps(mp, tiles, r, mp.rep_bits, enabled)
+    rep_msg = jnp.where(
+        finish & is_ex, MSG_EX_REP,
+        jnp.where(excl, MSG_EXCL_REP, MSG_SH_REP)).astype(jnp.uint8)
+    rep_go = finish & ~is_nullify
+    wr = jnp.where(rep_go, r, 0)
+    mail = mail.replace(
+        rep_type=mail.rep_type.at[wr].add(
+            jnp.where(rep_go, rep_msg, 0).astype(jnp.uint8)),
+        rep_time=mail.rep_time.at[wr].add(
+            jnp.where(rep_go, rep_ready + rep_lat, 0)),
+    )
+    mail = mail.replace(
+        fwd_type=jnp.where(finish[None, :], MSG_NONE, mail.fwd_type))
+    txn = txn.replace(
+        active=txn.active & ~finish,
+        got_flush=txn.got_flush & ~finish,
+        last_line=jnp.where(finish, txn.line, txn.last_line),
+        last_done_ps=jnp.where(finish, rep_ready, txn.last_done_ps),
+    )
+    counters = ms.counters.replace(
+        dram_writes=ms.counters.dram_writes + (wb_dram & enabled).astype(I64),
+    )
+    progress = progress + jnp.sum(finish, dtype=jnp.int32) + jnp.sum(
+        any_match | dram_in, dtype=jnp.int32)
+    return ms.replace(l2=l2, dir=d, mail=mail, txn=txn,
+                      counters=counters), progress
+
+
+def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
+                 progress, mesi):
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    mail = ms.mail
+    txn = ms.txn
+
+    can_start = ~txn.active
+    use_saved = can_start & txn.saved_valid
+    r_col, r_found = _row_earliest(mail.req_type, mail.req_time)
+    use_pop = can_start & ~use_saved & r_found
+    starting = use_saved | use_pop
+    rtype = jnp.where(use_saved, txn.saved_type,
+                      mail.req_type[tiles, r_col]).astype(jnp.uint8)
+    rline = jnp.where(use_saved, txn.saved_line, mail.req_line[tiles, r_col])
+    rreq = jnp.where(use_saved, txn.saved_requester, r_col)
+    rcomp = jnp.where(use_saved, txn.saved_comp, MOD_L1D).astype(jnp.uint8)
+    rtime = jnp.where(use_saved, txn.saved_time_ps,
+                      mail.req_time[tiles, r_col])
+    rtime = rtime + jnp.where(use_saved, 0, sync_l2_net)
+    rtime = jnp.where(starting & (rline == txn.last_line),
+                      jnp.maximum(rtime, txn.last_done_ps), rtime)
+    cr = jnp.where(use_pop, r_col, 0)
+    mail = mail.replace(
+        req_type=mail.req_type.at[tiles, cr].set(
+            jnp.where(use_pop, MSG_NONE, mail.req_type[tiles, cr])))
+    txn = txn.replace(saved_valid=txn.saved_valid & ~use_saved)
+
+    # ---- L2 slice lookup / allocation -----------------------------------
+    l2 = ms.l2
+    l2_hit, way, l2_state = ca.lookup(l2, rline)
+    sets = (rline % mp.l2.num_sets).astype(jnp.int32)
+    # allocate on miss; a valid victim with L1 copies runs NULLIFY first
+    v_way, v_valid, v_line, v_state = ca.pick_victim(l2, rline)
+    v_sets = (v_line % mp.l2.num_sets).astype(jnp.int32)
+    v_dstate, v_owner, v_sharers, v_nsh, v_cloc = _dir_at(
+        ms.dir, tiles, v_sets, v_way)
+    need_alloc = starting & ~l2_hit
+    nullify_live = need_alloc & v_valid & (v_dstate != DIR_UNCACHED)
+    # clean victim with no L1 copies: drop now (dirty → DRAM write)
+    silent_kill = need_alloc & v_valid & (v_dstate == DIR_UNCACHED)
+    l2 = ca.invalidate(l2, v_line, silent_kill)
+    dram_wb = silent_kill & (v_state == MODIFIED)
+
+    txn = txn.replace(
+        saved_valid=jnp.where(nullify_live, True, txn.saved_valid),
+        saved_type=jnp.where(nullify_live, rtype, txn.saved_type),
+        saved_line=jnp.where(nullify_live, rline, txn.saved_line),
+        saved_requester=jnp.where(nullify_live, rreq, txn.saved_requester),
+        saved_comp=jnp.where(nullify_live, rcomp, txn.saved_comp),
+        saved_time_ps=jnp.where(nullify_live, rtime, txn.saved_time_ps),
+    )
+    # install the new line (DATA_INVALID until DRAM returns)
+    do_install = need_alloc & ~nullify_live
+    alloc_way = v_way  # pick_victim returns invalid-way-first
+    l2 = ca.insert_at(l2, rline, alloc_way, DATA_INVALID, do_install)
+    d = _dir_set(ms.dir, tiles, sets, alloc_way, do_install,
+                 dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
+                 owner=jnp.full(T, -1, jnp.int32),
+                 sharers=jnp.zeros((T, mp.sharer_words), U32),
+                 nsharers=jnp.zeros(T, jnp.int32))
+
+    eff_line = jnp.where(nullify_live, v_line, rline)
+    eff_type = jnp.where(nullify_live, MSG_NULLIFY, rtype).astype(jnp.uint8)
+    eff_time = rtime + l2_access
+    run_req = starting & ~nullify_live
+
+    # re-gather directory for the effective line
+    eff_sets = (eff_line % mp.l2.num_sets).astype(jnp.int32)
+    _, eff_way, eff_l2_state = ca.lookup(l2, eff_line)
+    dstate, owner, sharers, nsh, cloc = _dir_at(d, tiles, eff_sets, eff_way)
+
+    is_ex = eff_type == MSG_EX_REQ
+    is_sh = eff_type == MSG_SH_REQ
+    data_missing = run_req & (eff_l2_state == DATA_INVALID)
+
+    # (a) data present, dstate FSM
+    served = run_req & ~data_missing
+    uncached = dstate == DIR_UNCACHED
+    shared = dstate == DIR_SHARED
+    owned_like = (dstate == DIR_MODIFIED) | (dstate == DIR_EXCLUSIVE)
+
+    # immediate finishes: SH on UNCACHED/SHARED, EX on UNCACHED → resolved
+    # by the finish pass next iteration (pending stays empty).  Fan-outs:
+    # EX on SHARED → INV sharers; anything on M/E → FLUSH/WB the owner;
+    # NULLIFY → INV/FLUSH everyone.
+    is_nullify = eff_type == MSG_NULLIFY
+    fan_inv = (served & is_ex & shared) | (nullify_live & shared)
+    fan_owner = ((served | nullify_live) & owned_like)
+    owner_bits = set_bit(jnp.zeros((T, mp.sharer_words), U32),
+                         jnp.clip(owner, 0, T - 1), fan_owner)
+    pending = jnp.where(fan_inv[:, None], sharers, owner_bits)
+    fan = fan_inv | fan_owner
+    fwd_msg = jnp.where(
+        fan_inv, MSG_INV_REQ,
+        jnp.where(is_sh, MSG_WB_REQ, MSG_FLUSH_REQ)).astype(jnp.uint8)
+    # EX on SHARED where the requester itself is a sharer: don't ask the
+    # requester to invalidate its own line (upgrade) — clear its bit.
+    # ONLY for the upgrade case: a NULLIFY sweep must invalidate the saved
+    # requester's copy of the VICTIM line too, or it would keep a stale L1
+    # copy after the directory entry dies.
+    upgrade_clear = served & is_ex & shared
+    pending = clear_bit(pending, jnp.clip(rreq, 0, T - 1),
+                        upgrade_clear & test_bit(pending, rreq))
+
+    activate = fan | data_missing | served | nullify_live
+    txn = txn.replace(
+        active=txn.active | (starting & activate),
+        mtype=jnp.where(starting, eff_type, txn.mtype).astype(jnp.uint8),
+        line=jnp.where(starting, eff_line, txn.line),
+        requester=jnp.where(starting, rreq, txn.requester),
+        req_comp=jnp.where(starting, rcomp, txn.req_comp).astype(jnp.uint8),
+        time_ps=jnp.where(starting, eff_time, txn.time_ps),
+        pending=jnp.where(starting[:, None], pending, txn.pending),
+        got_flush=jnp.where(starting, False, txn.got_flush),
+        dram_ready_ps=jnp.where(
+            data_missing,
+            eff_time + _dram_lat_ps(mp, tiles, enabled),
+            jnp.where(starting, FAR, txn.dram_ready_ps)),
+    )
+
+    # multicast forwards
+    targets = unpack_sharers(pending, T)
+    send = fan[:, None] & targets
+    send_t = send.T
+    fwd_lat = mem_net_latency_ps(
+        mp, tiles[:, None], tiles[None, :], mp.req_bits, enabled)
+    arrive = eff_time[:, None] + fwd_lat
+    mail = mail.replace(
+        fwd_type=jnp.where(send_t, fwd_msg[None, :], mail.fwd_type),
+        fwd_line=jnp.where(send_t, eff_line[None, :], mail.fwd_line),
+        fwd_time=jnp.where(send_t, arrive.T, mail.fwd_time),
+    )
+    counters = ms.counters.replace(
+        dir_accesses=ms.counters.dir_accesses
+        + (starting & enabled).astype(I64),
+        l2_hits=ms.counters.l2_hits
+        + (run_req & ~data_missing & enabled).astype(I64),
+        l2_misses=ms.counters.l2_misses
+        + (data_missing & enabled).astype(I64),
+        dram_reads=ms.counters.dram_reads
+        + (data_missing & enabled).astype(I64),
+        dram_writes=ms.counters.dram_writes + (dram_wb & enabled).astype(I64),
+        dram_total_lat_ps=ms.counters.dram_total_lat_ps
+        + jnp.where(data_missing & enabled,
+                    (mp.dram_latency_ns + mp.dram_processing_ns) * 1000, 0),
+    )
+    progress = progress + jnp.sum(starting, dtype=jnp.int32)
+    return ms.replace(l2=l2, dir=d, mail=mail, txn=txn,
+                      counters=counters), progress
+
+
+def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
+                    enabled, progress, sync_l1_net):
+    """Reply fills the L1 (`handleMsgFromL2Cache` → insertCacheLine)."""
+    T = mp.n_tiles
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    mail = ms.mail
+
+    def ccyc(n):
+        ps = cycles_to_ps(jnp.asarray(n, I64), fmhz)
+        return jnp.where(enabled, ps, 0)
+
+    have_rep = (ms.req.phase == PHASE_WAIT_REPLY) & (mail.rep_type != MSG_NONE)
+    line = ms.req.line
+    comp_l1i = ms.req.component == MOD_L1I
+    new_state = jnp.where(
+        mail.rep_type == MSG_EX_REP, MODIFIED,
+        jnp.where(mail.rep_type == MSG_EXCL_REP, EXCLUSIVE,
+                  SHARED)).astype(jnp.uint8)
+
+    # Upgrade replies land in the line's EXISTING way (the S copy stays
+    # put during an EX upgrade); only true misses pick a victim.
+    l1i_hit, l1i_hway, _ = ca.lookup(ms.l1i, line)
+    l1d_hit, l1d_hway, _ = ca.lookup(ms.l1d, line)
+    l1i_vway, l1i_vv, l1i_vline, l1i_vstate = ca.pick_victim(ms.l1i, line)
+    l1d_vway, l1d_vv, l1d_vline, l1d_vstate = ca.pick_victim(ms.l1d, line)
+    l1i_way = jnp.where(l1i_hit, l1i_hway, l1i_vway)
+    l1d_way = jnp.where(l1d_hit, l1d_hway, l1d_vway)
+    already = jnp.where(comp_l1i, l1i_hit, l1d_hit)
+    v_valid = jnp.where(comp_l1i, l1i_vv, l1d_vv) & ~already
+    v_line = jnp.where(comp_l1i, l1i_vline, l1d_vline)
+    v_state = jnp.where(comp_l1i, l1i_vstate, l1d_vstate)
+    v_home = _l2_home(mp, v_line)
+    need_evict = have_rep & v_valid
+    evict_busy = mail.evict_type[v_home, tiles] != MSG_NONE
+    fill = have_rep & ~(need_evict & evict_busy)
+    evict_go = need_evict & fill
+
+    l1i = ca.insert_at(ms.l1i, line, l1i_way, new_state, fill & comp_l1i)
+    l1d = ca.insert_at(ms.l1d, line, l1d_way, new_state, fill & ~comp_l1i)
+
+    e_msg = jnp.where(v_state == MODIFIED, MSG_FLUSH_REP,
+                      MSG_INV_REP).astype(jnp.uint8)
+    fill_ps = mail.rep_time + sync_l1_net + ccyc(
+        mp.l1d.data_and_tags_cycles)
+    e_lat = jnp.where(
+        v_state == MODIFIED,
+        mem_net_latency_ps(mp, tiles, v_home, mp.rep_bits, enabled),
+        mem_net_latency_ps(mp, tiles, v_home, mp.req_bits, enabled))
+    wh = jnp.where(evict_go, v_home, 0)
+    mail = mail.replace(
+        evict_type=mail.evict_type.at[wh, tiles].set(
+            jnp.where(evict_go, e_msg, mail.evict_type[wh, tiles])),
+        evict_line=mail.evict_line.at[wh, tiles].set(
+            jnp.where(evict_go, v_line, mail.evict_line[wh, tiles])),
+        evict_time=mail.evict_time.at[wh, tiles].set(
+            jnp.where(evict_go, fill_ps + e_lat,
+                      mail.evict_time[wh, tiles])),
+        rep_type=jnp.where(fill, MSG_NONE, mail.rep_type),
+        rep_time=jnp.where(fill, 0, mail.rep_time),
+    )
+    req = ms.req.replace(
+        phase=jnp.where(fill, PHASE_IDLE, ms.req.phase),
+        slot=jnp.where(fill, ms.req.slot + 1, ms.req.slot),
+        acc_ps=ms.req.acc_ps + jnp.where(fill, fill_ps - clock_ps, 0),
+        slot_lat_ps=jnp.where(
+            (fill[:, None]
+             & (jnp.arange(3)[None, :] == ms.req.slot[:, None])),
+            (fill_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
+    )
+    ms = ms.replace(l1i=l1i, l1d=l1d, mail=mail, req=req)
+    s_addr = jnp.where(ms.req.slot - 1 == 1, rec.addr0.astype(jnp.int32),
+                       rec.addr1.astype(jnp.int32))
+    ms = _apply_functional(mp, ms, rec, ms.req.slot - 1, s_addr,
+                           ms.req.is_write, fill)
+    counters = ms.counters.replace(
+        evictions=ms.counters.evictions + (evict_go & enabled).astype(I64))
+    progress = progress + jnp.sum(fill, dtype=jnp.int32)
+    return ms.replace(counters=counters), progress

@@ -46,6 +46,7 @@ MSG_WB_REP = 8
 MSG_SH_REP = 9
 MSG_EX_REP = 10
 MSG_NULLIFY = 11
+MSG_EXCL_REP = 12   # MESI exclusive grant (`pr_l1_sh_l2_mesi`)
 
 # directory states (`directory_state.h`)
 DIR_UNCACHED = 0
@@ -174,39 +175,15 @@ class MemState:
     func_errors: jax.Array   # int64[] failed FLAG_CHECK loads
 
 
-def init_mem_state(mp: MemParams) -> MemState:
+def init_mem_common(mp: MemParams) -> dict:
+    """The protocol-independent state pieces (L1/L2 arrays, mailboxes,
+    requester machinery, counters, functional memory) — shared between the
+    private-L2 and shared-L2 engines."""
     T = mp.n_tiles
-    SW = mp.sharer_words
-    DS, DW = mp.dir_sets, mp.dir_ways
 
     def zi64():
         return jnp.zeros(T, I64)
 
-    directory = DirectoryArrays(
-        tags=jnp.full((T, DS, DW), -1, jnp.int32),
-        dstate=jnp.zeros((T, DS, DW), jnp.uint8),
-        owner=jnp.full((T, DS, DW), -1, jnp.int32),
-        sharers=jnp.zeros((T, DS, DW, SW), jnp.uint32),
-        nsharers=jnp.zeros((T, DS, DW), jnp.int32),
-    )
-    txn = TxnState(
-        active=jnp.zeros(T, jnp.bool_),
-        mtype=jnp.zeros(T, jnp.uint8),
-        line=jnp.zeros(T, jnp.int32),
-        requester=jnp.zeros(T, jnp.int32),
-        time_ps=zi64(),
-        pending=jnp.zeros((T, SW), jnp.uint32),
-        data_cached=jnp.zeros(T, jnp.bool_),
-        saved_valid=jnp.zeros(T, jnp.bool_),
-        saved_type=jnp.zeros(T, jnp.uint8),
-        saved_line=jnp.zeros(T, jnp.int32),
-        saved_requester=jnp.zeros(T, jnp.int32),
-        saved_time_ps=zi64(),
-        last_line=jnp.full(T, -1, jnp.int32),
-        last_done_ps=zi64(),
-        cdata_line=jnp.full(T, -1, jnp.int32),
-        cdata_valid=jnp.zeros(T, jnp.bool_),
-    )
     mail = MemMailboxes(
         req_type=jnp.zeros((T, T), jnp.uint8),
         req_line=jnp.zeros((T, T), jnp.int32),
@@ -244,17 +221,55 @@ def init_mem_state(mp: MemParams) -> MemState:
         dram_reads=zi64(), dram_writes=zi64(),
         dram_total_lat_ps=zi64(),
     )
-    return MemState(
+    return dict(
         l1i=make_cache(T, mp.l1i.num_sets, mp.l1i.num_ways),
         l1d=make_cache(T, mp.l1d.num_sets, mp.l1d.num_ways),
         l2=make_cache(T, mp.l2.num_sets, mp.l2.num_ways),
-        l2_cloc=jnp.zeros((T, mp.l2.num_sets, mp.l2.num_ways), jnp.uint8),
-        directory=directory,
-        txn=txn,
         mail=mail,
         req=req,
         counters=counters,
         # +1 scratch word absorbing masked-off dummy writes
         func_mem=jnp.zeros(max(mp.func_mem_words, 1) + 1, jnp.uint32),
         func_errors=jnp.zeros((), I64),
+    )
+
+
+def init_mem_state(mp: MemParams) -> MemState:
+    T = mp.n_tiles
+    SW = mp.sharer_words
+    DS, DW = mp.dir_sets, mp.dir_ways
+
+    def zi64():
+        return jnp.zeros(T, I64)
+
+    directory = DirectoryArrays(
+        tags=jnp.full((T, DS, DW), -1, jnp.int32),
+        dstate=jnp.zeros((T, DS, DW), jnp.uint8),
+        owner=jnp.full((T, DS, DW), -1, jnp.int32),
+        sharers=jnp.zeros((T, DS, DW, SW), jnp.uint32),
+        nsharers=jnp.zeros((T, DS, DW), jnp.int32),
+    )
+    txn = TxnState(
+        active=jnp.zeros(T, jnp.bool_),
+        mtype=jnp.zeros(T, jnp.uint8),
+        line=jnp.zeros(T, jnp.int32),
+        requester=jnp.zeros(T, jnp.int32),
+        time_ps=zi64(),
+        pending=jnp.zeros((T, SW), jnp.uint32),
+        data_cached=jnp.zeros(T, jnp.bool_),
+        saved_valid=jnp.zeros(T, jnp.bool_),
+        saved_type=jnp.zeros(T, jnp.uint8),
+        saved_line=jnp.zeros(T, jnp.int32),
+        saved_requester=jnp.zeros(T, jnp.int32),
+        saved_time_ps=zi64(),
+        last_line=jnp.full(T, -1, jnp.int32),
+        last_done_ps=zi64(),
+        cdata_line=jnp.full(T, -1, jnp.int32),
+        cdata_valid=jnp.zeros(T, jnp.bool_),
+    )
+    return MemState(
+        l2_cloc=jnp.zeros((T, mp.l2.num_sets, mp.l2.num_ways), jnp.uint8),
+        directory=directory,
+        txn=txn,
+        **init_mem_common(mp),
     )
