@@ -1,0 +1,31 @@
+"""Power/area modeling (SURVEY §2.9): the McPAT/DSENT-equivalent layer.
+
+Reference: two native C++ libraries (contrib/mcpat, contrib/dsent) wrapped
+by `McPATCoreInterface` / `McPATCacheInterface`
+(`common/mcpat/mcpat_core_interface.h:80-99`) and a DSENT interface
+(`simulator.cc:93-104`), fed by per-model event counters and queried for
+area + leakage + dynamic energy breakdowns.
+
+Here the analytical models live in the native library
+`native/energy/energy_model.cc` (built to libgraphite_energy.so, bound via
+ctypes — pybind11 is not in the image), and this package provides the
+interface classes that turn a SimResults' counters into the same
+area/leakage/dynamic-energy breakdown structure, with per-voltage scaling
+for DVFS (`mcpat_core_interface.h` per-voltage wrapper cache).
+"""
+
+from graphite_tpu.power.interface import (
+    DSENTInterface,
+    McPATCacheInterface,
+    McPATCoreInterface,
+    TileEnergyMonitor,
+    load_native,
+)
+
+__all__ = [
+    "DSENTInterface",
+    "McPATCacheInterface",
+    "McPATCoreInterface",
+    "TileEnergyMonitor",
+    "load_native",
+]

@@ -1,0 +1,108 @@
+"""Power/area model tests: native library build + interface behavior.
+
+Mirrors the reference's McPAT/DSENT roles (SURVEY §2.9): structure area,
+leakage, per-event dynamic energy, DVFS voltage scaling (dynamic ~ V^2,
+leakage falls with voltage), and the per-tile energy monitor summary.
+"""
+
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine import Simulator
+from graphite_tpu.power import (
+    DSENTInterface, McPATCacheInterface, McPATCoreInterface,
+    TileEnergyMonitor, load_native,
+)
+from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+
+class TestNativeLibrary:
+    def test_builds_and_loads(self):
+        lib = load_native()
+        assert lib.energy_model_abi_version() == 1
+
+    def test_cache_scales_with_size(self):
+        small = McPATCacheInterface(22, 32 * 1024, 4)
+        big = McPATCacheInterface(22, 512 * 1024, 8)
+        assert big.area_mm2() > small.area_mm2()
+        assert big.at_voltage(1.0).read_energy_j > \
+            small.at_voltage(1.0).read_energy_j
+        assert big.at_voltage(1.0).leakage_power_w > \
+            small.at_voltage(1.0).leakage_power_w
+
+    def test_dynamic_energy_scales_v_squared(self):
+        c = McPATCacheInterface(22, 64 * 1024, 4)
+        e_hi = c.at_voltage(1.0).read_energy_j
+        e_lo = c.at_voltage(0.8).read_energy_j
+        assert e_lo == pytest.approx(e_hi * 0.64, rel=1e-6)
+
+    def test_leakage_falls_with_voltage(self):
+        core = McPATCoreInterface(22)
+        assert core.at_voltage(0.8).leakage_power_w < \
+            core.at_voltage(1.0).leakage_power_w
+
+    def test_technology_scaling(self):
+        c22 = McPATCacheInterface(22, 64 * 1024, 4)
+        c45 = McPATCacheInterface(45, 64 * 1024, 4)
+        assert c22.area_mm2() < c45.area_mm2()
+        assert c22.at_voltage(1.0).read_energy_j < \
+            c45.at_voltage(1.0).read_energy_j
+
+    def test_noc_energy_positive(self):
+        d = DSENTInterface(22)
+        assert d.router_dynamic_energy_j(1.0, 100) > 0
+        assert d.link_dynamic_energy_j(1.0, 100) > 0
+        assert d.static_power_w(1.0) > 0
+
+
+class TestTileEnergyMonitor:
+    def _run(self):
+        sc = SimConfig(ConfigFile.from_string("""
+[general]
+total_cores = 2
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = true
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+mov = 1
+ialu = 1
+[clock_skew_management]
+scheme = lax
+"""))
+        b0 = TraceBuilder()
+        for i in range(20):
+            b0.store_value(i * 64, i)
+        for _ in range(30):
+            b0.instr(Op.IALU)
+        sim = Simulator(sc, TraceBatch.from_builders([b0, TraceBuilder()]))
+        return sim, sim.run()
+
+    def test_energy_breakdown_and_summary(self):
+        sim, results = self._run()
+        mon = TileEnergyMonitor(sim, results)
+        e = mon.tile_energy_j(0)
+        assert e["total"] > 0
+        assert e["core_dynamic"] > 0
+        assert e["l1d_dynamic"] > 0
+        assert e["dram_dynamic"] > 0
+        # the idle tile burns only leakage
+        e1 = mon.tile_energy_j(1)
+        assert e1["core_dynamic"] == 0
+        s = mon.output_summary()
+        assert "Tile Energy Monitor Summary" in s
+        assert "Total Energy (in J)" in s
+
+    def test_lower_voltage_lower_dynamic_energy(self):
+        sim, results = self._run()
+        mon = TileEnergyMonitor(sim, results)
+        assert mon.tile_energy_j(0, voltage=0.8)["core_dynamic"] < \
+            mon.tile_energy_j(0, voltage=1.0)["core_dynamic"]
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
