@@ -184,10 +184,15 @@ class Simulator:
                 )
         # Full hop-by-hop USER NoC with per-port contention
         user_hbh = None
+        user_atac = None
         if config.network_types[0] == "emesh_hop_by_hop":
             from graphite_tpu.models.network_hop_by_hop import HopByHopParams
 
             user_hbh = HopByHopParams.from_config(config, "user")
+        elif config.network_types[0] == "atac":
+            from graphite_tpu.models.network_atac import AtacParams
+
+            user_atac = AtacParams.from_config(config, "user")
         # Core model from the `[tile] model_list` (`carbon_sim.cfg:158-176`;
         # default model_list uses iocoom).  Homogeneous for now: tile 0's
         # core type selects the model.
@@ -218,6 +223,7 @@ class Simulator:
             dvfs=dvfs_params,
             mem=mem_params,
             user_hbh=user_hbh,
+            user_atac=user_atac,
         )
         # Clock-skew scheme (`carbon_sim.cfg:85-108`): lax_barrier uses the
         # config quantum; lax runs one unbounded quantum; lax_p2p is
@@ -263,6 +269,11 @@ class Simulator:
             from graphite_tpu.models.network_hop_by_hop import init_noc_state
 
             self.state = self.state.replace(noc_user=init_noc_state(user_hbh))
+        if user_atac is not None:
+            from graphite_tpu.models.network_atac import init_atac_state
+
+            self.state = self.state.replace(
+                noc_user=init_atac_state(user_atac))
         if iocoom_params is not None:
             from graphite_tpu.models.iocoom import init_iocoom_state
 

@@ -80,6 +80,8 @@ class EngineParams:
     mem: "object" = None       # MemParams | None
     # USER network full hop-by-hop model with per-port contention
     user_hbh: "object" = None  # HopByHopParams | None
+    # USER network ATAC optical model (clusters + hubs + waveguide)
+    user_atac: "object" = None  # AtacParams | None
 
 
 def _gather_field(field: jax.Array, idx: jax.Array) -> jax.Array:
@@ -240,6 +242,14 @@ def subquantum_iteration(
 
             noc_user, arrival_ps, _, _ = route_hop_by_hop(
                 params.user_hbh, state.noc_user, tiles, dst,
+                user_packet_bits(aux1), core.clock_ps, send_now, enabled)
+            lat_ps = arrival_ps - core.clock_ps
+        elif params.user_atac is not None:
+            from graphite_tpu.models.network_atac import route_atac
+            from graphite_tpu.models.network_user import user_packet_bits
+
+            noc_user, arrival_ps, _ = route_atac(
+                params.user_atac, state.noc_user, tiles, dst,
                 user_packet_bits(aux1), core.clock_ps, send_now, enabled)
             lat_ps = arrival_ps - core.clock_ps
         else:

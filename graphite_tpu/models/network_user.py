@@ -45,6 +45,10 @@ class UserNetworkParams:
         freq_mhz = _network_domain_freq_mhz(cfg)
         if kind == "magic":
             return cls(kind="magic", freq_mhz=freq_mhz)
+        if kind == "atac":
+            # routing/timing handled by AtacParams (models/network_atac);
+            # this placeholder only carries the domain frequency
+            return cls(kind="atac", freq_mhz=freq_mhz)
         if kind in ("emesh_hop_counter", "emesh_hop_by_hop"):
             # hop_by_hop zero-load reduces to hop_counter math; contention is
             # layered on separately (models/network_emesh_hop_by_hop).

@@ -221,8 +221,19 @@ class TileEnergyMonitor:
         seconds = r.clock_ps[tile] * 1e-12
         instr = int(r.instruction_count[tile])
         branches = int(r.bp_correct[tile] + r.bp_incorrect[tile])
+        # split the instruction mix from the available counters: memory
+        # ops from L1-D accesses, the remainder as integer ALU work
+        mem_ops = 0
+        if r.mem_counters is not None:
+            mc = r.mem_counters
+            mem_ops = int(mc["l1d_read_hits"][tile]
+                          + mc["l1d_read_misses"][tile]
+                          + mc["l1d_write_hits"][tile]
+                          + mc["l1d_write_misses"][tile])
+        int_ops = max(instr - mem_ops - branches, 0)
         core_dyn = self.core_if.dynamic_energy_j(
-            voltage, instructions=instr, int_ops=instr, branches=branches)
+            voltage, instructions=instr, int_ops=int_ops,
+            mem_ops=mem_ops, branches=branches)
         out = {
             "core_dynamic": core_dyn,
             "core_static": self.core_if.leakage_energy_j(voltage, seconds),
