@@ -36,6 +36,14 @@ Known divergences (documented for the parity harness):
  - directory NULLIFY picks the min-sharer victim of the set without the
    "not in request queue" exclusion (our serialization makes it moot);
  - DRAM queue-model contention is layered on separately (queue_models).
+
+Directory schemes (`directory_schemes/directory_entry_*.cc`): all five are
+supported — full_map, limited_no_broadcast (capacity-displacement INV of one
+tracked sharer), ackwise / limited_broadcast (broadcast INV sweeps on
+overflowed entries; acks awaited only from true holders), limitless
+(software-trap penalty on overflowed entries).  The sharers bitvector stays
+exact ground truth in all schemes — the schemes differ in *which messages
+travel* and *what they cost*, which is what the timing model observes.
 """
 
 from __future__ import annotations
@@ -970,13 +978,49 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     modified = eff_dstate == DIR_MODIFIED
     owned = eff_dstate == DIR_OWNED
 
+    # ---- directory-scheme variants (`directory_schemes/directory_entry_*.cc`,
+    # `directory_type.h:3`).  full_map tracks every sharer exactly; the
+    # other schemes cap the hardware sharer list at k = max_hw_sharers:
+    #  - limited_no_broadcast: a (k+1)-th sharer cannot be tracked — the
+    #    home invalidates one tracked sharer first (addSharer failure →
+    #    getSharerToInvalidate → INV, buffered request then proceeds);
+    #  - ackwise / limited_broadcast: beyond k the precise list degrades
+    #    (AckWise keeps the exact *count*); invalidation sweeps become a
+    #    broadcast to every tile, but the home still awaits acks only from
+    #    true holders (non-holders drop the INV silently);
+    #  - limitless: overflow handled in software — full_map behavior plus a
+    #    software-trap penalty on accesses to overflowed entries
+    #    (`[limitless] software_trap_penalty`, `carbon_sim.cfg:260-263`).
+    k = mp.max_hw_sharers
+    already = test_bit(v_sharers, rreq)
+    if mp.dir_type == "limited_no_broadcast":
+        sh_over = run_req & is_sh & (shared | owned) & (v_nsh >= k) & ~already
+        # MODIFIED entry already at capacity (k=1): the owner cannot stay a
+        # tracked sharer alongside the requester — its WB becomes a FLUSH
+        # (data + invalidation) and the entry empties before the SH finish
+        # adds the requester (addSharer failure on the M→S transition)
+        sh_over_m = run_req & is_sh & modified & (v_nsh >= k) & ~already
+    else:
+        sh_over = jnp.zeros((T,), jnp.bool_)
+        sh_over_m = jnp.zeros((T,), jnp.bool_)
+    if mp.dir_type == "limitless":
+        sw_mode = (v_nsh > k) | (is_sh & ~already & (v_nsh >= k)
+                                 & (shared | owned))
+        trap_ps = jnp.where(
+            enabled & starting & dfound & sw_mode,
+            cycles_to_ps(jnp.asarray(mp.limitless_trap_cycles, I64),
+                         mp.dir_freq_mhz),
+            0,
+        )
+        eff_time = eff_time + trap_ps
+
     # (a) immediate finishes: UNCACHED requests; MSI also serves SHARED+SH
     # straight from DRAM, while MOSI fetches cache-to-cache (below)
     imm_ex = run_req & is_ex & uncached
     if mp.is_mosi:
         imm_sh = run_req & is_sh & uncached
     else:
-        imm_sh = run_req & is_sh & (uncached | shared)
+        imm_sh = run_req & is_sh & (uncached | shared) & ~sh_over
     imm = imm_ex | imm_sh
     rbit = set_bit(jnp.zeros((T, mp.sharer_words), U32), rreq, imm)
     cur_sh = jnp.where(imm_sh[:, None] & shared[:, None], v_sharers,
@@ -1016,12 +1060,12 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     #     (mosi `dram_directory_cntlr.cc:430-520`)
     if mp.is_mosi:
         fan_inv = ((run_req & is_ex) | nullify_live) & (shared | owned)
-        sh_fetch = run_req & is_sh & (shared | owned)
+        sh_fetch = run_req & is_sh & (shared | owned) & ~sh_over
     else:
         fan_inv = (run_req & is_ex & shared) | (nullify_live & shared)
         sh_fetch = jnp.zeros((T,), jnp.bool_)
     fan_owner = ((run_req | nullify_live) & modified)
-    fan = fan_inv | fan_owner | sh_fetch
+    fan = fan_inv | fan_owner | sh_fetch | sh_over
     owner_bits = set_bit(jnp.zeros((T, mp.sharer_words), U32),
                          jnp.clip(v_owner, 0, T - 1), fan_owner)
     # cache-to-cache source: the owner when the entry is OWNED (it has the
@@ -1037,6 +1081,46 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     fwd_msg = jnp.where(
         fan_inv, MSG_INV_REQ,
         jnp.where(is_sh, MSG_WB_REQ, MSG_FLUSH_REQ)).astype(jnp.uint8)
+
+    if mp.dir_type == "limited_no_broadcast":
+        # victim sharer to evict so the requester fits in the hw list:
+        # lowest non-owner sharer (the owner holds dirty data); when the
+        # owner is the only sharer, it is flushed instead (data + invalidate)
+        owner_word = set_bit(jnp.zeros((T, mp.sharer_words), U32),
+                             jnp.clip(v_owner, 0, T - 1),
+                             owned & (v_owner >= 0))
+        victim0 = lowest_sharer(v_sharers & ~owner_word)
+        victim_is_owner = sh_over & (victim0 < 0)
+        victim = jnp.where(victim0 >= 0, victim0,
+                           jnp.clip(v_owner, 0, T - 1)).astype(jnp.int32)
+        victim_bits = set_bit(jnp.zeros((T, mp.sharer_words), U32),
+                              victim, sh_over)
+        # drop the victim from the entry now — its INV/FLUSH ack is consumed
+        # by this transaction, not the eviction path (one txn per home)
+        d = _dir_update(
+            d, sets, alloc_way, sh_over,
+            sharers=v_sharers & ~victim_bits,
+            nsharers=v_nsh - 1,
+            owner=jnp.where(victim_is_owner, -1, v_owner),
+            dstate=jnp.where(victim_is_owner, DIR_SHARED,
+                             eff_dstate).astype(jnp.uint8))
+        # acks awaited: the victim, plus the data-supplying owner (MOSI
+        # OWNED entries fetch cache-to-cache alongside the invalidation)
+        ow_pend = set_bit(jnp.zeros((T, mp.sharer_words), U32),
+                          jnp.clip(v_owner, 0, T - 1),
+                          sh_over & owned & ~victim_is_owner & (v_owner >= 0))
+        pending = jnp.where(sh_over[:, None], victim_bits | ow_pend, pending)
+        fwd_msg = jnp.where(sh_over, MSG_INV_REQ, fwd_msg).astype(jnp.uint8)
+        # M→S at capacity: FLUSH the owner instead of WB and empty the
+        # entry now (the SH finish then installs {requester} alone)
+        fwd_msg = jnp.where(sh_over_m, MSG_FLUSH_REQ, fwd_msg).astype(
+            jnp.uint8)
+        d = _dir_update(
+            d, sets, alloc_way, sh_over_m,
+            sharers=jnp.zeros((T, mp.sharer_words), U32),
+            nsharers=jnp.zeros(T, jnp.int32),
+            owner=jnp.full(T, -1, jnp.int32),
+            dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8))
 
     txn = txn.replace(
         active=txn.active | fan,
@@ -1066,6 +1150,26 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         msg_hs = jnp.where(
             pick_rows[:, None] & pick_col,
             jnp.uint8(MSG_FLUSH_REQ), msg_hs)
+    if mp.dir_type == "limited_no_broadcast" and mp.is_mosi:
+        # data supplier for the displaced SH: the victim FLUSHes when it
+        # must both leave and supply (clean c2c pick, or the owner-is-victim
+        # corner); otherwise the owner WBs alongside the victim's INV
+        victim_col = tiles[None, :] == victim[:, None]
+        owner_col = tiles[None, :] == jnp.clip(v_owner, 0, T - 1)[:, None]
+        msg_hs = jnp.where(
+            (sh_over & (shared | victim_is_owner))[:, None] & victim_col,
+            jnp.uint8(MSG_FLUSH_REQ), msg_hs)
+        msg_hs = jnp.where(
+            (sh_over & owned & ~victim_is_owner)[:, None] & owner_col,
+            jnp.uint8(MSG_WB_REQ), msg_hs)
+    if mp.dir_type in ("ackwise", "limited_broadcast"):
+        # overflowed entries lose sharer precision: the INV sweep goes to
+        # every tile (`directory_entry_ackwise.cc` / `..._limited_broadcast`);
+        # `pending` (acks awaited) stays the true holder set — non-holders
+        # drop the INV silently, exactly the sharer-side `silent` path
+        over_bc = fan_inv & (v_nsh > k)
+        send = send | over_bc[:, None]
+        send_t = send.T
     fwd_lat = mem_net_latency_ps(
         mp, tiles[:, None], tiles[None, :], mp.req_bits, enabled
     )  # [src=home? careful] — computed as [row, col] = (home, sharer)
@@ -1084,6 +1188,10 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         dram_total_lat_ps=ms.counters.dram_total_lat_ps
         + jnp.where(imm & ~cdata_imm & enabled, dram_lat_ps, 0),
     )
+    if mp.dir_type in ("ackwise", "limited_broadcast"):
+        counters = counters.replace(
+            dir_broadcasts=counters.dir_broadcasts
+            + (over_bc & enabled).astype(I64))
     progress = progress + jnp.sum(starting, dtype=jnp.int32)
     return ms.replace(directory=d, txn=txn, mail=mail,
                       counters=counters), progress

@@ -155,6 +155,7 @@ class MemCounters:
     evictions: jax.Array
     invalidations: jax.Array   # INV_REQs served with a valid line
     dir_accesses: jax.Array
+    dir_broadcasts: jax.Array  # ackwise/limited_broadcast INV sweeps sent to all tiles
     dram_reads: jax.Array
     dram_writes: jax.Array
     dram_total_lat_ps: jax.Array
@@ -217,7 +218,7 @@ def init_mem_common(mp: MemParams) -> dict:
         l1d_write_hits=zi64(), l1d_write_misses=zi64(),
         l2_hits=zi64(), l2_misses=zi64(),
         evictions=zi64(), invalidations=zi64(),
-        dir_accesses=zi64(),
+        dir_accesses=zi64(), dir_broadcasts=zi64(),
         dram_reads=zi64(), dram_writes=zi64(),
         dram_total_lat_ps=zi64(),
     )
