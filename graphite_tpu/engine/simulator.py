@@ -23,7 +23,7 @@ from graphite_tpu.engine.state import DeviceTrace, SimState, init_state
 from graphite_tpu.engine.step import EngineParams
 from graphite_tpu.models.dvfs import module_freq_mhz
 from graphite_tpu.models.network_user import UserNetworkParams
-from graphite_tpu.time_types import ns_to_ps, ps_to_ns
+from graphite_tpu.time_types import cycles_to_ps, ns_to_ps, ps_to_ns
 from graphite_tpu.trace.schema import STATIC_COST_KEYS, Op, TraceBatch
 
 class DeadlockError(RuntimeError):
@@ -182,6 +182,16 @@ class Simulator:
                     f"caching protocol {mem_params.protocol!r} pending "
                     f"(available: {', '.join(supported)})"
                 )
+            if (mem_params.protocol.startswith("pr_l1_sh_l2")
+                    and mem_params.dir_type != "full_map"):
+                # The embedded shared-L2 directory (`l2_directory_cfg.cc`)
+                # implements only full_map here so far; refuse rather than
+                # silently running the wrong scheme (PARITY.md §2.5 caveat).
+                raise NotImplementedError(
+                    "directory_type "
+                    f"{mem_params.dir_type!r} is only supported by the "
+                    "private-L2 protocols; shared-L2 runs full_map"
+                )
         # Full hop-by-hop USER NoC with per-port contention
         user_hbh = None
         user_atac = None
@@ -219,6 +229,10 @@ class Simulator:
             mailbox_depth=mailbox_depth,
             inner_block=inner_block,
             n_conds=n_conds,
+            # SYSTEM network is always magic (`config.cc:484`) and outside
+            # the DVFS domain map (only NETWORK_USER/NETWORK_MEMORY are
+            # tunable modules): 1 cycle each way to the MCP at 1 GHz
+            syscall_rt_ps=int(cycles_to_ps(2, 1000)),
             iocoom=iocoom_params,
             dvfs=dvfs_params,
             mem=mem_params,

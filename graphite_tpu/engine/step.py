@@ -70,6 +70,7 @@ class EngineParams:
     mailbox_depth: int = 8
     inner_block: int = 32      # trace records per tile per scan
     n_conds: int = 64          # cond-variable id space (sync tables)
+    syscall_rt_ps: int = 2000  # SYSTEM-net round trip to the MCP (2 cyc @1GHz)
     # iocoom core model (None = simple 1-IPC in-order model)
     iocoom: "object" = None    # IocoomParams | None
     # DVFS tables (always set by Simulator; the None fallback — a raw
@@ -192,11 +193,13 @@ def subquantum_iteration(
     is_join = op == Op.THREAD_JOIN
     is_bblock = op == Op.BBLOCK
     # Events that always complete in one iteration:
+    is_syscall = op == Op.SYSCALL
     is_simple_event = (
         (op == Op.THREAD_SPAWN)
         | is_binit | is_minit | is_munlock
         | (op == Op.ENABLE_MODELS) | (op == Op.DISABLE_MODELS)
         | (op == Op.DVFS_SET) | (op == Op.DVFS_GET)
+        | is_syscall  # blocking round trip to the MCP, charged as cost_ps
         | (op == Op.COND_INIT)  # effects applied in the mutex+cond block
         # COND_SIGNAL/COND_BROADCAST commit conditionally (cond_post_commit):
         # surplus same-iteration posters retry, so they are NOT simple
@@ -219,6 +222,11 @@ def subquantum_iteration(
     cost_ps = cycles_to_ps(cycles, core.freq_mhz.astype(I64))
     cost_ps = jnp.where(is_dynamic, dyn_ps, cost_ps)
     cost_ps = jnp.where(op < 20, cost_ps, 0)  # events carry no direct cost
+    # ... except syscalls: the app thread blocks for the SYSTEM-network
+    # round trip to the MCP's SyscallServer (`syscall_model.cc` marshalling;
+    # SYSTEM is always magic, `config.cc:484` → 1 cycle each way)
+    cost_ps = jnp.where(is_syscall, jnp.asarray(params.syscall_rt_ps, I64),
+                        cost_ps)
     # compressed run: aux1 = total cycles for aux0 instructions
     cost_ps = jnp.where(
         is_bblock,

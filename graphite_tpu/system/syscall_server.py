@@ -1,0 +1,189 @@
+"""Centralized syscall execution + simulated address-space layout.
+
+Reference: the SyscallServer runs on the MCP tile and executes application
+syscalls centrally so every process in a distributed simulation sees one
+OS view (`common/system/syscall_server.cc`, 1,174 LoC: open/read/write/
+close/lseek/access/mmap/brk/futex...); the client side marshals arguments
+over the SYSTEM network (`common/tile/core/syscall_model.cc:132-244`).
+VMManager lays out the simulated address space (`common/system/
+vm_manager.cc`: segments, brk, mmap regions).
+
+TPU-native form: functional execution is host-side (this module) against an
+in-memory file system — the simulated-OS view — while the trace carries one
+SYSCALL record per call (`Op.SYSCALL`) whose replay cost is the SYSTEM-net
+round trip to the MCP (engine/step.py).  Futex never reaches here: the
+frontend's mutex/cond/barrier map to the engine's sync machinery, the same
+way the reference special-cases futex into the SyncServer path.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# fcntl-style flags (subset the reference marshals)
+O_RDONLY = 0x0
+O_WRONLY = 0x1
+O_RDWR = 0x2
+O_CREAT = 0x40
+O_TRUNC = 0x200
+O_APPEND = 0x400
+
+SEEK_SET = 0
+SEEK_CUR = 1
+SEEK_END = 2
+
+
+class SimFile:
+    """One regular file in the simulated FS (central byte store)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes = b""):
+        self.data = bytearray(data)
+
+
+class SyscallServer:
+    """The MCP-side syscall executor over an in-memory simulated FS.
+
+    Thread-safe: every operation takes the server lock, mirroring the MCP
+    thread serializing all syscalls (`mcp.cc:59-146` dispatch).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._files: dict[str, SimFile] = {}
+        # fd -> [SimFile, pos, flags]: the fd holds the file object itself,
+        # so an unlinked file stays readable/writable until close (POSIX)
+        self._fds: dict[int, list] = {}
+        self._next_fd = 3  # 0/1/2 reserved (stdio pass-through)
+        self.counts: dict[str, int] = {}
+
+    def _count(self, name: str) -> None:
+        self.counts[name] = self.counts.get(name, 0) + 1
+
+    # ---- files ----------------------------------------------------------
+
+    def open(self, path: str, flags: int = O_RDONLY) -> int:
+        with self._lock:
+            self._count("open")
+            f = self._files.get(path)
+            if f is None:
+                if not (flags & O_CREAT):
+                    return -2  # -ENOENT
+                f = self._files[path] = SimFile()
+            if flags & O_TRUNC:
+                del f.data[:]  # in place: open fds share the object
+            pos = len(f.data) if (flags & O_APPEND) else 0
+            fd = self._next_fd
+            self._next_fd += 1
+            self._fds[fd] = [f, pos, flags]
+            return fd
+
+    def close(self, fd: int) -> int:
+        with self._lock:
+            self._count("close")
+            return 0 if self._fds.pop(fd, None) is not None else -9  # -EBADF
+
+    def read(self, fd: int, nbytes: int) -> bytes | int:
+        with self._lock:
+            self._count("read")
+            ent = self._fds.get(fd)
+            if ent is None:
+                return -9
+            f, pos, flags = ent
+            if (flags & 0x3) == O_WRONLY:
+                return -9  # -EBADF: not open for reading
+            data = bytes(f.data[pos:pos + nbytes])
+            ent[1] = pos + len(data)
+            return data
+
+    def write(self, fd: int, data: bytes) -> int:
+        with self._lock:
+            self._count("write")
+            ent = self._fds.get(fd)
+            if ent is None:
+                return -9
+            f, pos, flags = ent
+            if (flags & 0x3) == O_RDONLY:
+                return -9  # -EBADF: not open for writing
+            buf = f.data
+            if len(buf) < pos + len(data):
+                buf.extend(b"\x00" * (pos + len(data) - len(buf)))
+            buf[pos:pos + len(data)] = data
+            ent[1] = pos + len(data)
+            return len(data)
+
+    def lseek(self, fd: int, offset: int, whence: int = SEEK_SET) -> int:
+        with self._lock:
+            self._count("lseek")
+            ent = self._fds.get(fd)
+            if ent is None:
+                return -9
+            f, pos, _flags = ent
+            size = len(f.data)
+            new = {SEEK_SET: offset, SEEK_CUR: pos + offset,
+                   SEEK_END: size + offset}.get(whence, -1)
+            if new < 0:
+                return -22  # -EINVAL
+            ent[1] = new
+            return new
+
+    def access(self, path: str) -> int:
+        with self._lock:
+            self._count("access")
+            return 0 if path in self._files else -2
+
+    def unlink(self, path: str) -> int:
+        with self._lock:
+            self._count("unlink")
+            return 0 if self._files.pop(path, None) is not None else -2
+
+    def stat_size(self, path: str) -> int:
+        with self._lock:
+            self._count("stat")
+            f = self._files.get(path)
+            return len(f.data) if f is not None else -2
+
+
+class VMManager:
+    """Simulated address-space layout (`vm_manager.cc`): a data segment
+    grown by brk and a stack-down mmap region; munmap only unmaps whole
+    trailing regions (the reference's simplification)."""
+
+    def __init__(self, data_base: int = 0x1000_0000,
+                 mmap_top: int = 0x7000_0000, page: int = 4096):
+        self._lock = threading.Lock()
+        self.page = page
+        self.data_base = data_base
+        self.brk_ptr = data_base
+        self.mmap_top = mmap_top
+        self.mmap_ptr = mmap_top
+        self._regions: dict[int, int] = {}  # base -> length
+
+    def brk(self, addr: int) -> int:
+        with self._lock:
+            if addr == 0:
+                return self.brk_ptr
+            if addr < self.data_base or addr >= self.mmap_ptr:
+                return self.brk_ptr  # refused: return current (linux brk)
+            self.brk_ptr = addr
+            return self.brk_ptr
+
+    def mmap(self, length: int) -> int:
+        with self._lock:
+            length = -(-length // self.page) * self.page
+            self.mmap_ptr -= length
+            if self.mmap_ptr <= self.brk_ptr:
+                self.mmap_ptr += length
+                return -12  # -ENOMEM
+            self._regions[self.mmap_ptr] = length
+            return self.mmap_ptr
+
+    def munmap(self, base: int) -> int:
+        with self._lock:
+            length = self._regions.pop(base, None)
+            if length is None:
+                return -22
+            if base == self.mmap_ptr:
+                self.mmap_ptr += length
+            return 0

@@ -115,7 +115,31 @@ class Op(enum.IntEnum):
     # block-granularity approximation.  No memory operands, branches, or
     # events inside a run.
     BBLOCK = 50
+    # Syscall rerouted to the central SyscallServer on the MCP tile
+    # (`syscall_model.cc:132-244` marshals to MCP; `syscall_server.cc`
+    # executes): aux0 = syscall class (SYS_* below), aux1 = arg (bytes).
+    # Functional execution happens host-side (system/syscall_server.py);
+    # replay charges the SYSTEM-network round trip to the MCP.
+    SYSCALL = 51
     NOP = 255          # padding past THREAD_EXIT
+
+
+# Syscall classes marshalled to the MCP SyscallServer (the reference
+# handles ~25 in `syscall_model.cc:132-244`; ids here are internal).
+SYS_OPEN = 0
+SYS_CLOSE = 1
+SYS_READ = 2
+SYS_WRITE = 3
+SYS_LSEEK = 4
+SYS_ACCESS = 5
+SYS_UNLINK = 6
+SYS_STAT = 7
+SYS_BRK = 8
+SYS_MMAP = 9
+SYS_MUNMAP = 10
+SYS_FUTEX = 11
+SYS_GETPID = 12
+SYS_OTHER = 13
 
 
 N_STATIC_INSTRUCTION_TYPES = 20  # MAX_INSTRUCTION_COUNT (`instruction.h:42`)
@@ -334,6 +358,9 @@ class TraceBuilder:
 
     def exit(self) -> "TraceBuilder":
         return self._append(Op.THREAD_EXIT)
+
+    def syscall(self, sc_class: int, arg: int = 0) -> "TraceBuilder":
+        return self._append(Op.SYSCALL, aux0=sc_class, aux1=arg)
 
     def dvfs_set(self, domain: int, freq_mhz: int,
                  hold: bool = False) -> "TraceBuilder":
