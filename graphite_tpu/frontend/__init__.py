@@ -1,0 +1,48 @@
+"""Application frontends: the Carbon user API (live threaded apps recorded
+to traces) and trace capture helpers.
+
+The reference's frontend is Intel Pin (`pin/pin_sim.cc`) instrumenting x86
+binaries; on TPU hosts the frontend is a *trace producer* (SURVEY §7).  This
+package provides the lite-mode analog: apps written against the Carbon user
+API (`common/user/carbon_user.h`, `capi.h`, `sync_api.h`,
+`thread_support.h`) execute functionally as real host threads while every
+API call records trace events; the recorded per-tile streams then replay
+through the vectorized timing engine.
+"""
+
+from graphite_tpu.frontend.carbon_api import (  # noqa: F401
+    CAPI_message_receive_w,
+    CAPI_message_send_w,
+    CarbonApp,
+    CarbonBarrier,
+    CarbonCond,
+    CarbonMutex,
+    carbon_access,
+    carbon_barrier_init,
+    carbon_barrier_wait,
+    carbon_branch,
+    carbon_brk,
+    carbon_close,
+    carbon_disable_models,
+    carbon_enable_models,
+    carbon_get_affinity,
+    carbon_get_tile_id,
+    carbon_instr,
+    carbon_join_thread,
+    carbon_load,
+    carbon_lseek,
+    carbon_migrate_self,
+    carbon_mmap,
+    carbon_munmap,
+    carbon_open,
+    carbon_read,
+    carbon_set_affinity,
+    carbon_set_tile_frequency,
+    carbon_spawn_thread,
+    carbon_stat_size,
+    carbon_store,
+    carbon_unlink,
+    carbon_work,
+    carbon_write,
+    carbon_yield,
+)

@@ -1,0 +1,549 @@
+"""The Carbon user API as a live, trace-recording frontend.
+
+Mirrors `common/user/` (reference): `CarbonStartSim/StopSim`
+(`carbon_user.h:18-20`), CAPI messaging (`capi.h:18-24`),
+`CarbonSpawnThread/JoinThread` (`thread_support.h:66-71`),
+`CarbonMutex/Cond/Barrier*` (`sync_api.h:19-34`), DVFS get/set
+(`dvfs.h:42-48`), and `CarbonEnableModels/DisableModels`
+(`performance_counter_support.h:8-9`).
+
+Execution model (the lite-mode analog, `pin/lite/routine_replace.cc`):
+the app runs *functionally* as real host threads — messages move through
+host queues, sync uses host primitives, memory reads return live values —
+while every API call records a trace event on the calling tile's stream.
+The recorded per-tile streams then replay through the vectorized timing
+engine, which re-executes the synchronization/coherence state machines in
+simulated time.  Live load values are recorded as check oracles
+(FLAG_CHECK), so the replay cross-validates the functional execution.
+
+Compute between API calls is annotated with `carbon_work(...)` — the
+trace-driven equivalent of Pin's instruction instrumentation
+(`pin/instruction_modeling.cc`): a frontend that cannot observe every
+machine instruction asks the app to declare its basic blocks.
+
+Oversubscription (threads > tiles): the scheduler queues threads per tile
+and every blocking call is a scheduling point that releases the core
+(`ThreadManager::stallThread`).  Replay constraint: threads sharing a tile
+share one engine lane, so co-located threads may synchronize with each
+other through mutexes and joins (sequential on one lane) but NOT through
+barriers, condvars, or CAPI messages pairing two co-located threads — one
+lane cannot contribute two arrivals to the same rendezvous.  Cross-tile
+synchronization is unrestricted.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+_TLS = threading.local()
+_APP_LOCK = threading.Lock()
+_APP: "CarbonApp | None" = None
+
+
+def _app() -> "CarbonApp":
+    if _APP is None:
+        raise RuntimeError("no CarbonApp running (use CarbonApp.start)")
+    return _APP
+
+
+def _tile() -> int:
+    t = getattr(_TLS, "tile", None)
+    if t is None:
+        raise RuntimeError("not inside a Carbon app thread")
+    return t
+
+
+class CarbonApp:
+    """One simulated application: functional threads + recorded traces.
+
+    `CarbonStartSim` boots the simulator in-process and returns to `main`
+    (`carbon_user.cc:22-75`); here `start()` runs `main_fn` on tile 0 and
+    blocks until every spawned thread exits (`CarbonStopSim`), yielding the
+    recorded `TraceBatch`.  `run()` replays it through the timing engine.
+    """
+
+    def __init__(self, sim_config, max_threads: int | None = None):
+        from graphite_tpu.system.thread_scheduler import (
+            RoundRobinThreadScheduler,
+        )
+
+        self.sim_config = sim_config
+        self.n_tiles = sim_config.application_tiles
+        self.max_threads = max_threads or 4 * self.n_tiles
+        self.builders = [TraceBuilder() for _ in range(self.n_tiles)]
+        self._threads: dict[int, threading.Thread] = {}  # tid -> host thread
+        self._next_tid = 1
+        self._alloc_lock = threading.Lock()
+        # scheduling: per-tile FIFO run queues; queued threads block until
+        # the occupant exits or yields (the reference's cooperative scheme)
+        self.scheduler = RoundRobinThreadScheduler(self.n_tiles)
+        self._sched_cv = threading.Condition()
+        # functional state
+        self._channels: dict[tuple[int, int], list] = {}
+        self._chan_cv = threading.Condition()
+        self._memory: dict[int, int] = {}
+        self._mem_lock = threading.Lock()
+        self._mutexes: dict[int, threading.Lock] = {}
+        self._conds: dict[int, threading.Condition] = {}
+        self._barriers: dict[int, threading.Barrier] = {}
+        self._next_sync_id = [0]
+        self._errors: list = []
+        # centralized OS view (MCP-side servers)
+        from graphite_tpu.system.syscall_server import SyscallServer, VMManager
+
+        self.syscalls = SyscallServer()
+        self.vm = VMManager()
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def start(self, main_fn, *args) -> TraceBatch:
+        global _APP
+        with _APP_LOCK:
+            if _APP is not None:
+                raise RuntimeError("another CarbonApp is already running")
+            _APP = self
+        try:
+            with self._sched_cv:
+                self.scheduler.schedule(0, requested_tile=0)
+            t = self._spawn_thread(0, main_fn, args)
+            t.join()
+            # join every straggler (threads the app spawned but never joined)
+            while True:
+                with self._alloc_lock:
+                    live = [th for th in self._threads.values()
+                            if th.is_alive()]
+                if not live:
+                    break
+                for th in live:
+                    th.join()
+        finally:
+            with _APP_LOCK:
+                _APP = None
+        if self._errors:
+            raise self._errors[0]
+        # one stream-end marker per tile (co-located thread segments were
+        # serialized in scheduling order; joins synchronize on tile streams)
+        for b in self.builders:
+            b.exit()
+        return TraceBatch.from_builders(self.builders)
+
+    def run(self, **sim_kwargs):
+        """Record (if not yet recorded via start) and replay through the
+        timing engine, returning `SimResults`."""
+        from graphite_tpu.engine.simulator import Simulator
+
+        batch = TraceBatch.from_builders(self.builders)
+        sim = Simulator(self.sim_config, batch, **sim_kwargs)
+        return sim.run()
+
+    # ---- internals ------------------------------------------------------
+
+    def _spawn_thread(self, tid: int, fn, args) -> threading.Thread:
+        def runner():
+            _TLS.tid = tid
+            self._wait_for_core(tid)
+            _TLS.tile = self.scheduler.threads[tid].tile
+            try:
+                fn(*args)
+            except BaseException as e:  # surface app errors to start()
+                self._errors.append(e)
+            finally:
+                with self._sched_cv:
+                    self.scheduler.thread_exit(tid)
+                    self._sched_cv.notify_all()
+
+        th = threading.Thread(target=runner, name=f"carbon-thread-{tid}",
+                              daemon=True)
+        with self._alloc_lock:
+            self._threads[tid] = th
+        th.start()
+        return th
+
+    def _wait_for_core(self, tid: int) -> None:
+        """Block until this thread is the head of its tile's run queue."""
+        with self._sched_cv:
+            while True:
+                tile = self.scheduler.threads[tid].tile
+                if self.scheduler.running_on(tile) == tid:
+                    return
+                self._sched_cv.wait()
+
+    def _alloc_tid(self) -> int:
+        with self._alloc_lock:
+            if self._next_tid >= self.max_threads:
+                raise RuntimeError(
+                    f"out of threads ({self.max_threads}) for "
+                    "CarbonSpawnThread"
+                )
+            t = self._next_tid
+            self._next_tid += 1
+            return t
+
+    def _alloc_sync_id(self) -> int:
+        with self._alloc_lock:
+            i = self._next_sync_id[0]
+            self._next_sync_id[0] += 1
+            return i
+
+
+# ---- thread API (`thread_support.h:66-71`) ------------------------------
+
+
+def carbon_get_tile_id() -> int:
+    """`CarbonGetTileId` — the calling thread's tile."""
+    return _tile()
+
+
+def _blocking_wait(app: "CarbonApp", wait_fn):
+    """Run a host-blocking wait as a scheduling point
+    (`ThreadManager::stallThread`): release the tile's core so co-located
+    queued threads can run, wait, then reacquire the core."""
+    tid = _TLS.tid
+    with app._sched_cv:
+        app.scheduler.block_thread(tid)
+        app._sched_cv.notify_all()
+    try:
+        return wait_fn()
+    finally:
+        with app._sched_cv:
+            app.scheduler.unblock_thread(tid)
+            app._sched_cv.notify_all()
+        app._wait_for_core(tid)
+
+
+def carbon_spawn_thread(fn, *args, affinity=None) -> int:
+    """`CarbonSpawnThread`: the scheduler places the thread round-robin
+    over (affinity-allowed) tiles (`masterScheduleThread`); when every tile
+    is occupied the thread queues until its tile frees (cooperative
+    scheduling — the shipped reference scheme).  Returns the thread id for
+    `carbon_join_thread`."""
+    app = _app()
+    tid = app._alloc_tid()
+    with app._sched_cv:
+        target_tile = app.scheduler.schedule(tid, affinity=affinity)
+    app.builders[_tile()].thread_spawn(target_tile)
+    app._spawn_thread(tid, fn, args)
+    return tid
+
+
+def carbon_join_thread(tid: int) -> None:
+    """`CarbonJoinThread` — blocks until the target exits; replay pins the
+    joiner's clock at the target tile's stream end (`masterJoinThread`;
+    with co-located threads this is the tile's *last* exit — a documented
+    serialization approximation).
+
+    A join is a scheduling point (`ThreadManager::stallThread`): the joiner
+    releases its core while blocked so queued threads — including a target
+    queued on the joiner's own tile — can run.  A same-tile join records no
+    THREAD_JOIN (the serialized stream order already carries the timing)."""
+    app = _app()
+    target_tile = app.scheduler.threads[tid].tile
+    if target_tile != _tile():
+        app.builders[_tile()].thread_join(target_tile)
+    th = app._threads.get(tid)
+    if th is not None and th.is_alive():
+        _blocking_wait(app, th.join)
+
+
+def carbon_yield() -> None:
+    """`CarbonYieldThread` (`thread_scheduler.h:48` yieldThread): requeue
+    behind any waiting co-located thread; blocks until rescheduled."""
+    app = _app()
+    tid = _TLS.tid
+    with app._sched_cv:
+        app.scheduler.yield_thread(tid)
+        app._sched_cv.notify_all()
+    app._wait_for_core(tid)
+
+
+def carbon_migrate_self(dst_tile: int) -> None:
+    """`CarbonMigrateThread` (self-migration): subsequent records land on
+    the destination tile's stream; blocks until the destination grants."""
+    app = _app()
+    tid = _TLS.tid
+    with app._sched_cv:
+        app.scheduler.migrate(tid, dst_tile)
+        app._sched_cv.notify_all()
+    app._wait_for_core(tid)
+    _TLS.tile = dst_tile
+
+
+def carbon_set_affinity(tiles) -> None:
+    """`CarbonSchedSetAffinity` on the calling thread; migrates it when the
+    current tile leaves the mask (`masterSchedSetAffinity`)."""
+    app = _app()
+    tid = _TLS.tid
+    with app._sched_cv:
+        app.scheduler.set_affinity(tid, tiles)
+        app._sched_cv.notify_all()
+    app._wait_for_core(tid)
+    _TLS.tile = app.scheduler.threads[tid].tile
+
+
+def carbon_get_affinity():
+    """`CarbonSchedGetAffinity` on the calling thread."""
+    return _app().scheduler.get_affinity(_TLS.tid)
+
+
+# ---- CAPI messaging (`capi.h:18-24` → `core.cc:67-123`) -----------------
+
+
+def CAPI_message_send_w(sender: int, receiver: int, payload) -> None:
+    app = _app()
+    assert sender == _tile(), "CAPI send must come from the sending tile"
+    size = len(payload) if hasattr(payload, "__len__") else 8
+    app.builders[sender].send(receiver, size)
+    with app._chan_cv:
+        app._channels.setdefault((sender, receiver), []).append(payload)
+        app._chan_cv.notify_all()
+
+
+def CAPI_message_receive_w(sender: int, receiver: int, size: int = 8):
+    app = _app()
+    assert receiver == _tile(), "CAPI recv must run on the receiving tile"
+    app.builders[receiver].recv(sender, size)
+
+    def _wait():
+        with app._chan_cv:
+            while not app._channels.get((sender, receiver)):
+                app._chan_cv.wait()
+            return app._channels[(sender, receiver)].pop(0)
+
+    return _blocking_wait(app, _wait)
+
+
+# ---- sync API (`sync_api.h:19-34` → MCP SyncServer) ---------------------
+
+
+class CarbonMutex:
+    def __init__(self):
+        app = _app()
+        self.id = app._alloc_sync_id()
+        app._mutexes[self.id] = threading.Lock()
+        app.builders[_tile()].mutex_init(self.id)
+
+    def lock(self):
+        app = _app()
+        app.builders[_tile()].mutex_lock(self.id)
+        _blocking_wait(app, app._mutexes[self.id].acquire)
+
+    def unlock(self):
+        app = _app()
+        app.builders[_tile()].mutex_unlock(self.id)
+        app._mutexes[self.id].release()
+
+    def __enter__(self):
+        self.lock()
+        return self
+
+    def __exit__(self, *exc):
+        self.unlock()
+
+
+class CarbonCond:
+    def __init__(self, mutex: CarbonMutex):
+        app = _app()
+        self.id = app._alloc_sync_id()
+        self.mutex = mutex
+        app._conds[self.id] = threading.Condition(app._mutexes[mutex.id])
+        app.builders[_tile()].cond_init(self.id)
+
+    def wait(self):
+        app = _app()
+        app.builders[_tile()].cond_wait(self.id, self.mutex.id)
+        _blocking_wait(app, app._conds[self.id].wait)
+
+    def _notify(self, notify_all: bool) -> None:
+        # POSIX allows signaling without holding the mutex; Python's
+        # Condition does not — take the lock when the caller doesn't hold it
+        app = _app()
+        cond = app._conds[self.id]
+        fn = cond.notify_all if notify_all else cond.notify
+
+        def _locked():
+            with app._mutexes[self.mutex.id]:
+                fn()
+
+        try:
+            fn()
+        except RuntimeError:
+            _blocking_wait(app, _locked)
+
+    def signal(self):
+        _app().builders[_tile()].cond_signal(self.id)
+        self._notify(False)
+
+    def broadcast(self):
+        _app().builders[_tile()].cond_broadcast(self.id)
+        self._notify(True)
+
+
+class CarbonBarrier:
+    def __init__(self, count: int):
+        app = _app()
+        self.id = app._alloc_sync_id()
+        app._barriers[self.id] = threading.Barrier(count)
+        app.builders[_tile()].barrier_init(self.id, count)
+
+    def wait(self):
+        app = _app()
+        app.builders[_tile()].barrier_wait(self.id)
+        _blocking_wait(app, app._barriers[self.id].wait)
+
+
+def carbon_barrier_init(count: int) -> CarbonBarrier:
+    return CarbonBarrier(count)
+
+
+def carbon_barrier_wait(bar: CarbonBarrier) -> None:
+    bar.wait()
+
+
+# ---- memory (redirected ops → the coherence engine on replay) -----------
+
+
+def _wrap_i32(value: int) -> int:
+    """Wrap to signed 32-bit: trace aux fields are int32; the engine
+    compares them as uint32 bit patterns."""
+    return ((value & 0xFFFFFFFF) ^ 0x80000000) - 0x80000000
+
+
+def carbon_store(addr: int, value: int, size: int = 4) -> None:
+    """Store through the simulated memory hierarchy (replay runs the full
+    L1/L2/directory path; functionally a host-memory write)."""
+    app = _app()
+    app.builders[_tile()].store_value(addr, _wrap_i32(value), size)
+    with app._mem_lock:
+        app._memory[addr] = value & 0xFFFFFFFF
+
+
+def carbon_load(addr: int, size: int = 4, check: bool = False) -> int:
+    """Load (live host value returned; replay runs the full coherence path).
+
+    With check=True the live value becomes the replay's check oracle
+    (FLAG_CHECK) and a disagreement reports func_errors.  Only valid for
+    *order-deterministic* reads — e.g. barrier-separated single-writer
+    data.  Values ordered by mutexes/condvars are NOT replay-checkable:
+    the engine grants locks in simulated-time order, which legitimately
+    differs from the host interleaving the recording observed."""
+    app = _app()
+    with app._mem_lock:
+        value = app._memory.get(addr, 0)
+    b = app.builders[_tile()]
+    if check:
+        b.load_check(addr, _wrap_i32(value), size)
+    else:
+        b.load(addr, size)
+    return value
+
+
+# ---- compute annotation (`pin/instruction_modeling.cc` analog) ----------
+
+
+def carbon_work(n_instr: int, cycles: int | None = None) -> None:
+    """Declare a straight-line run of `n_instr` instructions costing
+    `cycles` (default 1 IPC) — recorded at basic-block granularity
+    (Op.BBLOCK), the engine's native compressed form."""
+    if n_instr <= 0:
+        return
+    _app().builders[_tile()].bblock(n_instr, cycles if cycles is not None
+                                    else n_instr)
+
+
+def carbon_instr(op: Op = Op.IALU, pc: int = 0) -> None:
+    """Record one instruction (fine-grained form of carbon_work)."""
+    _app().builders[_tile()].instr(op, pc=pc)
+
+
+def carbon_branch(taken: bool, pc: int = 0) -> None:
+    _app().builders[_tile()].branch(taken, pc=pc)
+
+
+# ---- model toggles + DVFS (`performance_counter_support.h`, `dvfs.h`) ---
+
+
+def carbon_enable_models() -> None:
+    b = _app().builders[_tile()]
+    b._append(Op.ENABLE_MODELS)
+
+
+def carbon_disable_models() -> None:
+    b = _app().builders[_tile()]
+    b._append(Op.DISABLE_MODELS)
+
+
+def carbon_set_tile_frequency(domain: int, freq_mhz: int) -> None:
+    """`CarbonSetDVFS` (`dvfs.h:42-48`) — takes effect on replay."""
+    _app().builders[_tile()].dvfs_set(domain, freq_mhz)
+
+
+# ---- syscalls (SyscallMdl client → MCP SyscallServer) -------------------
+# Each call executes against the app's central simulated-OS view and
+# records one SYSCALL trace event; replay charges the SYSTEM-network round
+# trip to the MCP (`syscall_model.cc` marshalling, `syscall_server.cc`).
+
+from graphite_tpu.trace.schema import (  # noqa: E402
+    SYS_ACCESS, SYS_BRK, SYS_CLOSE, SYS_LSEEK, SYS_MMAP, SYS_MUNMAP,
+    SYS_OPEN, SYS_READ, SYS_STAT, SYS_UNLINK, SYS_WRITE,
+)
+
+
+def _sysrec(sc_class: int, arg: int = 0) -> None:
+    _app().builders[_tile()].syscall(sc_class, arg)
+
+
+def carbon_open(path: str, flags: int = 0) -> int:
+    _sysrec(SYS_OPEN)
+    return _app().syscalls.open(path, flags)
+
+
+def carbon_close(fd: int) -> int:
+    _sysrec(SYS_CLOSE)
+    return _app().syscalls.close(fd)
+
+
+def carbon_read(fd: int, nbytes: int):
+    _sysrec(SYS_READ, nbytes)
+    return _app().syscalls.read(fd, nbytes)
+
+
+def carbon_write(fd: int, data: bytes) -> int:
+    _sysrec(SYS_WRITE, len(data))
+    return _app().syscalls.write(fd, data)
+
+
+def carbon_lseek(fd: int, offset: int, whence: int = 0) -> int:
+    _sysrec(SYS_LSEEK)
+    return _app().syscalls.lseek(fd, offset, whence)
+
+
+def carbon_access(path: str) -> int:
+    _sysrec(SYS_ACCESS)
+    return _app().syscalls.access(path)
+
+
+def carbon_unlink(path: str) -> int:
+    _sysrec(SYS_UNLINK)
+    return _app().syscalls.unlink(path)
+
+
+def carbon_stat_size(path: str) -> int:
+    _sysrec(SYS_STAT)
+    return _app().syscalls.stat_size(path)
+
+
+def carbon_brk(addr: int = 0) -> int:
+    _sysrec(SYS_BRK)
+    return _app().vm.brk(addr)
+
+
+def carbon_mmap(length: int) -> int:
+    _sysrec(SYS_MMAP, length)
+    return _app().vm.mmap(length)
+
+
+def carbon_munmap(base: int) -> int:
+    _sysrec(SYS_MUNMAP)
+    return _app().vm.munmap(base)

@@ -1,0 +1,184 @@
+"""Thread scheduling: placement, run queues, yield, migrate, affinity.
+
+Reference: `common/system/thread_scheduler.{h,cc}` +
+`round_robin_thread_scheduler.cc` — `masterScheduleThread` places a spawned
+thread on a core and enqueues it (running head + waiters), `yieldThread`
+requeues the head to the tail, `masterMigrateThread` moves a thread between
+cores, `masterSchedSetAffinity` restricts placement and migrates if the
+current core leaves the mask.  The shipped reference hardcodes the
+cooperative scheme (`thread_scheduler.cc:22,71-72`: scheme "none", the
+`thread_scheduling/*` config reads commented out); preemptive quantum
+rotation exists only as the round_robin requeue primitive, which we expose
+the same way.
+
+Here scheduling is a host-side (MCP-analog) concern: decisions order the
+per-tile trace segments the frontend records; the engine replays each
+tile's stream in that order (SURVEY §2.10 — centralized services run
+host-side between quanta).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+
+
+@dataclasses.dataclass
+class ThreadInfo:
+    tid: int
+    tile: int | None = None          # current tile (None until scheduled)
+    affinity: frozenset | None = None  # allowed tiles (None = all)
+    state: str = "new"               # new | queued | running | done
+
+
+class RoundRobinThreadScheduler:
+    """Round-robin placement over tiles + per-tile FIFO run queues.
+
+    Queue head = the running thread (`m_waiter_queue` in the reference);
+    `yield_thread` rotates head→tail (`round_robin_thread_scheduler.cc:21`).
+    """
+
+    def __init__(self, n_tiles: int):
+        self.n_tiles = n_tiles
+        self.queues = [collections.deque() for _ in range(n_tiles)]
+        self.threads: dict[int, ThreadInfo] = {}
+        self._next_tile = 0  # masterScheduleThread round-robin pointer
+
+    # ---- placement (`masterScheduleThread`) -----------------------------
+
+    def _allowed(self, info: ThreadInfo) -> list:
+        if info.affinity is None:
+            return list(range(self.n_tiles))
+        return sorted(info.affinity)
+
+    def schedule(self, tid: int, affinity=None,
+                 requested_tile: int | None = None) -> int:
+        """Place a new thread; returns its tile.  Prefers an idle allowed
+        tile scanning round-robin from the placement pointer; otherwise
+        enqueues on the least-loaded allowed tile."""
+        info = self.threads.setdefault(tid, ThreadInfo(tid))
+        if affinity is not None:
+            info.affinity = frozenset(affinity)
+        allowed = self._allowed(info)
+        if not allowed:
+            raise ValueError(f"thread {tid}: empty affinity mask")
+        if requested_tile is not None:
+            if not (0 <= requested_tile < self.n_tiles):
+                raise ValueError(
+                    f"thread {tid}: requested tile {requested_tile} out of "
+                    f"range [0, {self.n_tiles})")
+            if requested_tile not in allowed:
+                raise ValueError(
+                    f"thread {tid}: requested tile {requested_tile} not in "
+                    "affinity mask")
+            tile = requested_tile
+        else:
+            tile = None
+            for i in range(self.n_tiles):
+                cand = (self._next_tile + i) % self.n_tiles
+                if cand in allowed and not self.queues[cand]:
+                    tile = cand
+                    break
+            if tile is None:
+                tile = min(allowed, key=lambda t: len(self.queues[t]))
+            self._next_tile = (tile + 1) % self.n_tiles
+        info.tile = tile
+        info.state = "running" if not self.queues[tile] else "queued"
+        self.queues[tile].append(tid)
+        return tile
+
+    def running_on(self, tile: int) -> int | None:
+        q = self.queues[tile]
+        return q[0] if q else None
+
+    # ---- lifecycle (`masterOnThreadExit` → `masterStartThread`) ---------
+
+    def thread_exit(self, tid: int) -> int | None:
+        """Remove an exiting thread; returns the next thread to run on its
+        tile (the new queue head), if any."""
+        info = self.threads[tid]
+        q = self.queues[info.tile]
+        q.remove(tid)
+        info.state = "done"
+        if q:
+            self.threads[q[0]].state = "running"
+            return q[0]
+        return None
+
+    # ---- stall/resume (`ThreadManager::stallThread/resumeThread`) -------
+
+    def block_thread(self, tid: int) -> int | None:
+        """Take a blocking thread off its tile's run queue (join/stall) so
+        queued threads can run; returns the tile's new running thread."""
+        info = self.threads[tid]
+        q = self.queues[info.tile]
+        was_head = q and q[0] == tid
+        q.remove(tid)
+        info.state = "blocked"
+        if was_head and q:
+            self.threads[q[0]].state = "running"
+            return q[0]
+        return None
+
+    def unblock_thread(self, tid: int) -> None:
+        """Re-enqueue a previously blocked thread on its tile."""
+        info = self.threads[tid]
+        q = self.queues[info.tile]
+        info.state = "running" if not q else "queued"
+        q.append(tid)
+
+    # ---- yield (`masterYieldThread` + round-robin requeue) --------------
+
+    def yield_thread(self, tid: int) -> int:
+        """Requeue the running head to the tail; returns the thread now at
+        the head (may be the yielder itself if alone)."""
+        info = self.threads[tid]
+        q = self.queues[info.tile]
+        assert q and q[0] == tid, "only the running thread may yield"
+        if len(q) > 1:
+            q.rotate(-1)
+            info.state = "queued"
+            self.threads[q[0]].state = "running"
+        return q[0]
+
+    # ---- migration (`masterMigrateThread`) ------------------------------
+
+    def migrate(self, tid: int, dst_tile: int) -> int | None:
+        """Move a thread to another tile's queue; returns the thread that
+        now runs on the source tile (if the migrant was running there)."""
+        info = self.threads[tid]
+        if info.affinity is not None and dst_tile not in info.affinity:
+            raise ValueError(
+                f"thread {tid}: tile {dst_tile} not in affinity mask")
+        src_q = self.queues[info.tile]
+        was_head = src_q and src_q[0] == tid
+        src_q.remove(tid)
+        next_tid = None
+        if was_head and src_q:
+            next_tid = src_q[0]
+            self.threads[next_tid].state = "running"
+        info.tile = dst_tile
+        dst_q = self.queues[dst_tile]
+        info.state = "running" if not dst_q else "queued"
+        dst_q.append(tid)
+        return next_tid
+
+    # ---- affinity (`masterSchedSetAffinity/GetAffinity`) ----------------
+
+    def set_affinity(self, tid: int, tiles) -> int | None:
+        """Restrict a thread to `tiles`; migrates it (round-robin pick from
+        the mask) when its current tile falls outside — the reference's
+        masterSchedSetAffinity behavior.  Returns the source tile's new
+        running thread when a migration displaced the head."""
+        info = self.threads[tid]
+        info.affinity = frozenset(tiles)
+        if info.tile is not None and info.tile not in info.affinity:
+            allowed = self._allowed(info)
+            idle = [t for t in allowed if not self.queues[t]]
+            dst = idle[0] if idle else min(
+                allowed, key=lambda t: len(self.queues[t]))
+            return self.migrate(tid, dst)
+        return None
+
+    def get_affinity(self, tid: int) -> frozenset | None:
+        return self.threads[tid].affinity

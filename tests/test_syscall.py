@@ -1,0 +1,187 @@
+"""Syscall server + VMManager + SYSCALL replay timing.
+
+Mirrors the reference's file-I/O unit test (`tests/unit/` file_io: threads
+write their rank into a shared file through the central SyscallServer and
+read it back) and the `vm_manager.cc` brk/mmap layout rules.
+"""
+
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.frontend import CarbonApp, CarbonBarrier, carbon_spawn_thread
+from graphite_tpu.frontend.carbon_api import (
+    carbon_access,
+    carbon_brk,
+    carbon_close,
+    carbon_join_thread,
+    carbon_lseek,
+    carbon_mmap,
+    carbon_munmap,
+    carbon_open,
+    carbon_read,
+    carbon_unlink,
+    carbon_work,
+    carbon_write,
+)
+from graphite_tpu.system.syscall_server import (
+    O_CREAT,
+    O_RDWR,
+    SEEK_SET,
+    SyscallServer,
+    VMManager,
+)
+from graphite_tpu.trace.schema import Op, TraceBuilder, TraceBatch, SYS_OPEN
+
+
+def make_config(n_tiles):
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = false
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+generic = 1
+mov = 1
+ialu = 1
+[branch_predictor]
+type = one_bit
+mispredict_penalty = 14
+size = 1024
+[clock_skew_management]
+scheme = lax_barrier
+[clock_skew_management/lax_barrier]
+quantum = 1000
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+class TestSyscallServer:
+    def test_open_write_read(self):
+        s = SyscallServer()
+        fd = s.open("/tmp/x", O_CREAT | O_RDWR)
+        assert fd >= 3
+        assert s.write(fd, b"hello") == 5
+        assert s.lseek(fd, 0, SEEK_SET) == 0
+        assert s.read(fd, 5) == b"hello"
+        assert s.close(fd) == 0
+        assert s.close(fd) == -9
+
+    def test_enoent_and_unlink(self):
+        s = SyscallServer()
+        assert s.open("/nope") == -2
+        assert s.access("/nope") == -2
+        fd = s.open("/a", O_CREAT)
+        s.close(fd)
+        assert s.access("/a") == 0
+        assert s.unlink("/a") == 0
+        assert s.unlink("/a") == -2
+
+    def test_unlinked_fd_stays_alive(self):
+        """POSIX: an open fd keeps an unlinked file readable/writable
+        until close."""
+        s = SyscallServer()
+        fd = s.open("/tmp/x", O_CREAT | O_RDWR)
+        assert s.unlink("/tmp/x") == 0
+        assert s.write(fd, b"hello") == 5
+        assert s.lseek(fd, 0, SEEK_SET) == 0
+        assert s.read(fd, 5) == b"hello"
+        assert s.access("/tmp/x") == -2  # gone from the namespace
+        assert s.close(fd) == 0
+
+    def test_sparse_write_via_lseek(self):
+        s = SyscallServer()
+        fd = s.open("/f", O_CREAT | O_RDWR)
+        s.lseek(fd, 8, SEEK_SET)
+        s.write(fd, b"zz")
+        assert s.stat_size("/f") == 10
+        s.lseek(fd, 0, SEEK_SET)
+        assert s.read(fd, 10) == b"\x00" * 8 + b"zz"
+
+
+class TestVMManager:
+    def test_brk_grow_and_query(self):
+        vm = VMManager()
+        base = vm.brk(0)
+        assert vm.brk(base + 4096) == base + 4096
+        assert vm.brk(0) == base + 4096
+        # refused below the data segment
+        assert vm.brk(1) == base + 4096
+
+    def test_mmap_stack_down_and_munmap(self):
+        vm = VMManager()
+        a = vm.mmap(1000)            # rounded to one page
+        b = vm.mmap(4096)
+        assert b == a - 4096
+        assert vm.munmap(b) == 0
+        assert vm.munmap(b) == -22
+        c = vm.mmap(4096)
+        assert c == b                # trailing region reused
+
+
+class TestFileIOApp:
+    def test_ranks_file_io(self):
+        """Each thread writes its rank at offset rank*4 through the central
+        server; after the barrier every thread reads the whole file back."""
+        T = 4
+        app = CarbonApp(make_config(T))
+
+        def worker(bar, me):
+            fd = carbon_open("/ranks", O_CREAT | O_RDWR)
+            carbon_lseek(fd, me * 4, SEEK_SET)
+            carbon_write(fd, me.to_bytes(4, "little"))
+            carbon_close(fd)
+            bar.wait()
+            fd = carbon_open("/ranks", O_RDWR)
+            data = carbon_read(fd, 4 * T)
+            carbon_close(fd)
+            for r in range(T):
+                assert int.from_bytes(data[r * 4:(r + 1) * 4], "little") == r
+
+        def main():
+            bar = CarbonBarrier(T)
+            tids = [carbon_spawn_thread(worker, bar, i + 1)
+                    for i in range(T - 1)]
+            worker(bar, 0)
+            for t in tids:
+                carbon_join_thread(t)
+
+        app.start(main)
+        res = app.run()
+        assert res.func_errors == 0
+        assert app.syscalls.counts["open"] == 2 * T
+        assert app.syscalls.counts["write"] == T
+
+    def test_mmap_brk_from_app(self):
+        app = CarbonApp(make_config(1))
+
+        def main():
+            b0 = carbon_brk(0)
+            assert carbon_brk(b0 + 8192) == b0 + 8192
+            m = carbon_mmap(4096)
+            assert m > 0
+            assert carbon_munmap(m) == 0
+
+        app.start(main)
+        res = app.run()
+        assert res.func_errors == 0
+
+
+class TestSyscallTiming:
+    def test_round_trip_cost(self):
+        """A syscall blocks for the SYSTEM-net round trip to the MCP
+        (magic net: 1 cycle each way at 1 GHz = 2 ns)."""
+        sc = make_config(1)
+        b = TraceBuilder()
+        b.instr(Op.IALU)     # 1 ns
+        b.syscall(SYS_OPEN)  # 2 ns
+        b.instr(Op.IALU)     # 1 ns
+        from graphite_tpu.engine.simulator import Simulator
+
+        res = Simulator(sc, TraceBatch.from_builders([b])).run()
+        assert res.clock_ps[0] == 4_000
+        # syscalls are not instructions
+        assert res.instruction_count[0] == 2

@@ -1,0 +1,69 @@
+"""RoundRobinThreadScheduler unit tests (`thread_scheduler.cc`,
+`round_robin_thread_scheduler.cc`): placement, run queues, yield rotation,
+migration, affinity-driven migration."""
+
+import pytest
+
+from graphite_tpu.system.thread_scheduler import RoundRobinThreadScheduler
+
+
+def test_round_robin_placement_prefers_idle():
+    s = RoundRobinThreadScheduler(4)
+    tiles = [s.schedule(t) for t in range(4)]
+    assert tiles == [0, 1, 2, 3]
+    # all busy: least-loaded (first) gets the 5th
+    assert s.schedule(4) == 0
+    assert s.running_on(0) == 0
+    assert list(s.queues[0]) == [0, 4]
+
+
+def test_exit_promotes_next():
+    s = RoundRobinThreadScheduler(2)
+    for t in range(4):
+        s.schedule(t)
+    assert s.running_on(0) == 0
+    assert s.thread_exit(0) == 2
+    assert s.running_on(0) == 2
+    assert s.thread_exit(2) is None
+
+
+def test_yield_rotates_head_to_tail():
+    s = RoundRobinThreadScheduler(1)
+    for t in range(3):
+        s.schedule(t)
+    assert s.running_on(0) == 0
+    assert s.yield_thread(0) == 1
+    assert list(s.queues[0]) == [1, 2, 0]
+    # alone after others exit: yield is a no-op
+    s.thread_exit(1)
+    s.thread_exit(2)
+    assert s.yield_thread(0) == 0
+
+
+def test_migrate_moves_and_promotes():
+    s = RoundRobinThreadScheduler(2)
+    for t in range(3):
+        s.schedule(t)          # 0->t0, 1->t1, 2->t0 queued
+    nxt = s.migrate(0, 1)
+    assert nxt == 2            # tile 0's queue head now thread 2
+    assert list(s.queues[1]) == [1, 0]
+    assert s.threads[0].state == "queued"
+
+
+def test_affinity_restricts_and_migrates():
+    s = RoundRobinThreadScheduler(4)
+    s.schedule(0)              # tile 0
+    s.set_affinity(0, {2, 3})
+    assert s.threads[0].tile in (2, 3)
+    assert s.get_affinity(0) == frozenset({2, 3})
+    with pytest.raises(ValueError):
+        s.migrate(0, 1)
+    # placement respects the mask
+    s.schedule(1, affinity={3})
+    assert s.threads[1].tile == 3
+
+
+def test_empty_affinity_rejected():
+    s = RoundRobinThreadScheduler(2)
+    with pytest.raises(ValueError):
+        s.schedule(0, affinity=set())
