@@ -102,10 +102,11 @@ class AtacParams:
             "link_model/optical/waveguide_delay_per_mm", 10e-3)
         eo = cfg.get_int("link_model/optical/E-O_conversion_delay", 1)
         oe = cfg.get_int("link_model/optical/O-E_conversion_delay", 1)
-        cycle_ps = 10**6 // freq_mhz
+        from graphite_tpu.time_types import cycles_to_ps
+
         optical_link_ps = int(
             math.ceil(wg_ns_per_mm * length_mm * 1000)
-            + (eo + oe) * cycle_ps)
+            + cycles_to_ps(eo + oe, freq_mhz))
         qtype = cfg.get_string(f"{sec}/queue_model/type", "history_tree")
         return cls(
             n_tiles=sc.application_tiles,

@@ -1,0 +1,5 @@
+"""Host-side tools: output parsing and regression driving — the analog of
+the reference's `tools/` directory (`tools/parse_output.py`,
+`tools/regress/run_tests.py`).  Multi-machine spawn helpers
+(`tools/spawn*.py`, `schedule.py`) have no TPU analog: distribution is
+`shard_map` over the device mesh, not process spawning (SURVEY §2.10)."""

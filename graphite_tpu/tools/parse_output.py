@@ -1,0 +1,65 @@
+"""Parse a `sim.out` summary into a nested dict — the analog of the
+reference's `tools/parse_output.py` (consumed by the regress aggregation,
+`tools/regress/aggregate_results.py`).
+
+Usage: python -m graphite_tpu.tools.parse_output results/sim.out
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+
+def parse_sim_out(text: str) -> dict:
+    """Returns {"target_completion_time_ns", "total_instructions",
+    "tiles": {tile_id: {flat summary keys}}}."""
+    out: dict = {"tiles": {}}
+    tile = None
+    section: list[str] = []
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        if not line.strip():
+            continue
+        m = re.match(r"Target Completion Time \(in nanoseconds\): (\d+)", line)
+        if m:
+            out["target_completion_time_ns"] = int(m.group(1))
+            continue
+        m = re.match(r"Total Instructions: (\d+)", line)
+        if m and tile is None:
+            out["total_instructions"] = int(m.group(1))
+            continue
+        m = re.match(r"Tile (\d+) Summary:", line)
+        if m:
+            tile = int(m.group(1))
+            out["tiles"][tile] = {}
+            section = []
+            continue
+        if tile is None:
+            continue
+        indent = len(line) - len(line.lstrip())
+        depth = max(0, indent // 2 - 1)
+        key_part = line.strip()
+        m = re.match(r"(.+?):\s*(-?\d+(?:\.\d+)?)$", key_part)
+        if m:
+            key, raw_value = m.group(1), m.group(2)
+            value = float(raw_value) if "." in raw_value else int(raw_value)
+            full = " / ".join(section[:depth] + [key])
+            out["tiles"][tile][full] = value
+        else:
+            header = key_part.rstrip(": ")
+            section = section[:depth] + [header]
+    return out
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "results/sim.out"
+    with open(path) as f:
+        parsed = parse_sim_out(f.read())
+    json.dump(parsed, sys.stdout, indent=1)
+    print()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,167 @@
+"""SPLASH-2-style benchmark trace programs.
+
+The reference's benchmark tier runs the SPLASH-2 suite under Pin
+(`tests/benchmarks/Makefile:4`; FFT/RADIX are the BASELINE.json graduated
+configs) plus synthetic traffic generators.  On the TPU frontend the
+benchmarks are *algorithmic trace programs*: each generator reproduces the
+computation/communication/synchronization skeleton of the app — phase
+structure, message pattern, per-phase instruction mix, memory footprint —
+as per-tile trace streams replayed through the full timing stack.
+
+Kernels:
+ - fft:           radix-sqrt(N) six-step FFT — local butterflies + 3
+                  all-to-all transposes + barriers (SPLASH-2 `kernels/fft`)
+ - radix:         parallel radix sort — histogram, tree prefix-sum,
+                  permutation all-to-all (SPLASH-2 `kernels/radix`)
+ - blackscholes:  embarrassingly parallel option pricing, one barrier per
+                  sweep (PARSEC `blackscholes`)
+ - canneal:       random-access element swaps over a large footprint with
+                  accept/reject branches (PARSEC `canneal`)
+
+Per-instruction costs ride the `[core/static_instruction_costs]` table;
+instruction *mixes* below (falu/fmul vs ialu ratios, loads per element)
+follow the kernels' inner loops, not measured counts — documented
+approximations, tunable per config.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+# Barrier ids used by the generators (one id space per app run).
+_BAR = 0
+
+
+def _all_to_all_phase(builders, n_tiles, bytes_per_msg, me_first=True):
+    """Tile t sends one message to every other tile, then receives one from
+    every other tile — the transpose/permutation skeleton.  Staggered start
+    offsets avoid every tile hammering tile 0 first."""
+    for t, b in enumerate(builders):
+        for i in range(1, n_tiles):
+            b.send((t + i) % n_tiles, bytes_per_msg)
+        for i in range(1, n_tiles):
+            b.recv((t - i) % n_tiles, bytes_per_msg)
+
+
+def _barrier(builders):
+    for b in builders:
+        b.barrier_wait(_BAR)
+
+
+def _new_run(builders, count):
+    global _BAR
+    _BAR = 0
+    builders[0].barrier_init(_BAR, count)
+
+
+def fft_trace(n_tiles: int, points_per_tile: int = 256,
+              use_memory: bool = False) -> TraceBatch:
+    """Six-step FFT: transpose, column FFTs, twiddle, transpose, row FFTs,
+    transpose (SPLASH-2 fft.C structure).  Butterfly cost: ~10 fp ops per
+    point per log2 stage (complex mul + add) → FMUL/FALU bblocks."""
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    _new_run(builders, n_tiles)
+    stages = max(1, int(np.log2(max(2, points_per_tile))))
+    fly_instr = points_per_tile * stages * 10
+    msg_bytes = max(8, (points_per_tile // max(1, n_tiles)) * 16)
+    for phase in range(3):  # the three transposes bracket two FFT passes
+        _barrier(builders)
+        _all_to_all_phase(builders, n_tiles, msg_bytes)
+        if phase < 2:
+            for t, b in enumerate(builders):
+                if use_memory:
+                    base = (t * points_per_tile) * 64
+                    for i in range(min(points_per_tile, 32)):
+                        b.load(base + i * 64)
+                b.bblock(fly_instr, fly_instr)  # 1-IPC fp pipeline
+    _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+def radix_trace(n_tiles: int, keys_per_tile: int = 1024,
+                radix: int = 16) -> TraceBatch:
+    """Radix sort iteration: local histogram (ialu), log-tree prefix sum
+    (point-to-point up/down sweeps), permutation all-to-all (SPLASH-2
+    radix.C structure)."""
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    _new_run(builders, n_tiles)
+    digits = max(1, 32 // max(1, int(np.log2(radix))))
+    for d in range(min(digits, 4)):
+        # histogram: ~4 int ops per key
+        for b in builders:
+            b.bblock(keys_per_tile * 4, keys_per_tile * 4)
+        _barrier(builders)
+        # tree prefix-sum: up-sweep + down-sweep over log2(T) rounds
+        levels = max(1, int(np.log2(max(2, n_tiles))))
+        for lvl in range(levels):
+            stride = 1 << lvl
+            for t, b in enumerate(builders):
+                if (t % (stride * 2)) == 0 and t + stride < n_tiles:
+                    b.recv(t + stride, radix * 4)
+                elif (t % (stride * 2)) == stride:
+                    b.send(t - stride, radix * 4)
+            for b in builders:
+                b.bblock(radix, radix)
+        for lvl in reversed(range(levels)):
+            stride = 1 << lvl
+            for t, b in enumerate(builders):
+                if (t % (stride * 2)) == 0 and t + stride < n_tiles:
+                    b.send(t + stride, radix * 4)
+                elif (t % (stride * 2)) == stride:
+                    b.recv(t - stride, radix * 4)
+        _barrier(builders)
+        # permutation: every tile scatters its keys
+        _all_to_all_phase(builders, n_tiles,
+                          max(8, keys_per_tile * 4 // max(1, n_tiles)))
+        _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+def blackscholes_trace(n_tiles: int, options_per_tile: int = 512,
+                       sweeps: int = 4) -> TraceBatch:
+    """Embarrassingly parallel pricing: ~200 fp ops per option (CNDF +
+    exp/log/sqrt approximations), one barrier per sweep (PARSEC
+    blackscholes.c bs_thread loop)."""
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    _new_run(builders, n_tiles)
+    per_sweep = options_per_tile * 200
+    for s in range(sweeps):
+        for b in builders:
+            b.bblock(per_sweep, per_sweep)
+        _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+def canneal_trace(n_tiles: int, footprint_lines: int = 4096,
+                  swaps_per_tile: int = 64, seed: int = 1234,
+                  use_memory: bool = True) -> TraceBatch:
+    """Simulated-annealing element swaps: random-access loads over a large
+    shared footprint (cache-hostile), ~60 int/fp ops to evaluate each swap,
+    a taken/not-taken accept branch, and occasional stores (PARSEC canneal
+    netlist swap loop)."""
+    rng = np.random.default_rng(seed)
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    _new_run(builders, n_tiles)
+    for t, b in enumerate(builders):
+        for s in range(swaps_per_tile):
+            if use_memory:
+                a1 = int(rng.integers(footprint_lines)) * 64
+                a2 = int(rng.integers(footprint_lines)) * 64
+                b.load(a1)
+                b.load(a2)
+            b.bblock(60, 60)
+            b.branch(bool(rng.integers(2)), pc=s & 0x3FF)
+            if use_memory and rng.random() < 0.3:
+                b.store(int(rng.integers(footprint_lines)) * 64)
+    _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+BENCHMARKS = {
+    "fft": fft_trace,
+    "radix": radix_trace,
+    "blackscholes": blackscholes_trace,
+    "canneal": canneal_trace,
+}

@@ -1,0 +1,86 @@
+"""Benchmark trace programs replay end-to-end through the full stack
+(SURVEY §4 tier 3 — the SPLASH-2/PARSEC benchmark tier, small sizes)."""
+
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine.simulator import Simulator
+from graphite_tpu.trace.benchmarks import (
+    BENCHMARKS,
+    blackscholes_trace,
+    canneal_trace,
+    fft_trace,
+    radix_trace,
+)
+
+
+def make_config(n_tiles, shared_mem=False, network="emesh_hop_counter"):
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = {"true" if shared_mem else "false"}
+[network]
+user = {network}
+memory = {network}
+[network/emesh_hop_counter]
+flit_width = 64
+[network/emesh_hop_counter/router]
+delay = 1
+[network/emesh_hop_counter/link]
+delay = 1
+[core/static_instruction_costs]
+generic = 1
+mov = 1
+ialu = 1
+falu = 3
+fmul = 5
+[branch_predictor]
+type = one_bit
+mispredict_penalty = 14
+size = 1024
+[clock_skew_management]
+scheme = lax_barrier
+[clock_skew_management/lax_barrier]
+quantum = 1000
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+class TestBenchmarkTraces:
+    def test_fft_completes_and_balances(self):
+        res = Simulator(make_config(8),
+                        fft_trace(8, points_per_tile=64)).run()
+        assert res.func_errors == 0
+        # all-to-all + barriers: every tile finishes within one barrier
+        # epoch of the others
+        assert res.clock_ps.min() > 0
+        assert res.packets_sent.sum() >= 8 * 7 * 3  # 3 transposes
+
+    def test_radix_tree_prefix_sum(self):
+        res = Simulator(make_config(8),
+                        radix_trace(8, keys_per_tile=64)).run()
+        assert res.func_errors == 0
+        assert res.packets_sent.sum() > 0
+
+    def test_blackscholes_parallel(self):
+        res = Simulator(make_config(4),
+                        blackscholes_trace(4, options_per_tile=16,
+                                           sweeps=2)).run()
+        assert res.func_errors == 0
+        # uniform work: clocks nearly equal across tiles
+        assert res.clock_ps.max() - res.clock_ps.min() <= 2_000_000
+
+    def test_canneal_memory_stress(self):
+        res = Simulator(
+            make_config(4, shared_mem=True, network="magic"),
+            canneal_trace(4, footprint_lines=256, swaps_per_tile=8),
+        ).run()
+        assert res.func_errors == 0
+        mc = res.mem_counters
+        assert mc["l1d_read_misses"].sum() > 0  # random access misses
+
+    def test_all_generators_registered(self):
+        assert set(BENCHMARKS) == {"fft", "radix", "blackscholes",
+                                   "canneal"}

@@ -1,0 +1,41 @@
+"""tools/parse_output analog: sim.out round-trips through the parser."""
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine.simulator import Simulator
+from graphite_tpu.tools.parse_output import parse_sim_out
+from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+
+def test_parse_sim_out_roundtrip(tmp_path):
+    text = """
+[general]
+total_cores = 2
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = false
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+ialu = 1
+[branch_predictor]
+type = one_bit
+mispredict_penalty = 14
+size = 1024
+[clock_skew_management]
+scheme = lax_barrier
+[clock_skew_management/lax_barrier]
+quantum = 1000
+"""
+    sc = SimConfig(ConfigFile.from_string(text))
+    b0 = TraceBuilder()
+    for _ in range(5):
+        b0.instr(Op.IALU)
+    sim = Simulator(sc, TraceBatch.from_builders([b0, TraceBuilder()]))
+    res = sim.run()
+    out_path = sim.write_output(res, output_dir=str(tmp_path))
+    parsed = parse_sim_out(open(out_path).read())
+    assert parsed["total_instructions"] == 5
+    assert parsed["target_completion_time_ns"] == 5
+    assert parsed["tiles"][0]["Core Summary / Total Instructions"] == 5
+    assert parsed["tiles"][1]["Core Summary / Total Instructions"] == 0
