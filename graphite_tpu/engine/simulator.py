@@ -203,17 +203,22 @@ class Simulator:
             from graphite_tpu.models.network_atac import AtacParams
 
             user_atac = AtacParams.from_config(config, "user")
-        # Core model from the `[tile] model_list` (`carbon_sim.cfg:158-176`;
-        # default model_list uses iocoom).  Homogeneous for now: tile 0's
-        # core type selects the model.
         iocoom_params = None
-        core_type = config.tile_specs[0].core_type
-        if core_type == "iocoom":
+        # Per-tile core models (`[tile] model_list` heterogeneity,
+        # `config.cc:365-472`): iocoom tiles run the pipeline algebra, the
+        # rest the simple 1-IPC path, mixed freely within one mesh
+        core_types = [config.tile_spec(t).core_type for t in range(n_tiles)]
+        unknown = {t for t in core_types
+                   if t not in ("iocoom", "simple", "default", "magic")}
+        if unknown:
+            raise NotImplementedError(f"core model(s) {sorted(unknown)!r}")
+        iocoom_tiles = None
+        if "iocoom" in core_types:
             from graphite_tpu.models.iocoom import IocoomParams
 
             iocoom_params = IocoomParams.from_config(cfg)
-        elif core_type not in ("simple", "default", "magic"):
-            raise NotImplementedError(f"core model {core_type!r}")
+            if any(t != "iocoom" for t in core_types):
+                iocoom_tiles = tuple(t == "iocoom" for t in core_types)
         from graphite_tpu.models.dvfs import DvfsParams
 
         dvfs_params = DvfsParams.from_config(cfg)
@@ -234,6 +239,7 @@ class Simulator:
             # tunable modules): 1 cycle each way to the MCP at 1 GHz
             syscall_rt_ps=int(cycles_to_ps(2, 1000)),
             iocoom=iocoom_params,
+            iocoom_tiles=iocoom_tiles,
             dvfs=dvfs_params,
             mem=mem_params,
             user_hbh=user_hbh,

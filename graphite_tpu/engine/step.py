@@ -73,6 +73,10 @@ class EngineParams:
     syscall_rt_ps: int = 2000  # SYSTEM-net round trip to the MCP (2 cyc @1GHz)
     # iocoom core model (None = simple 1-IPC in-order model)
     iocoom: "object" = None    # IocoomParams | None
+    # heterogeneous cores (`[tile] model_list`, `config.cc:365-472`): which
+    # tiles run the iocoom model (None = all, when iocoom is set); the rest
+    # use the simple 1-IPC path
+    iocoom_tiles: "tuple | None" = None
     # DVFS tables (always set by Simulator; the None fallback — a raw
     # frequency poke without validation — serves direct engine-level use)
     dvfs: "object" = None      # DvfsParams | None
@@ -340,8 +344,14 @@ def subquantum_iteration(
         # live update (a plain masked .set would).
         bar = jnp.clip(aux0, 0, sync.barrier_count.shape[0] - 1)
         binit_now = active & is_binit
+        # several tiles may init the same barrier in one iteration (the
+        # vectorized trace generators do); elect one writer per id so the
+        # add-a-delta stays idempotent instead of summing every lane's delta
+        n_bars = sync.barrier_count.shape[0]
+        init_best = _elect_min(binit_now, bar, tiles.astype(I64), n_bars)
+        init_win = binit_now & (tiles.astype(I64) == init_best[bar])
         barrier_count = sync.barrier_count.at[bar].add(
-            jnp.where(binit_now, aux1 - sync.barrier_count[bar], 0)
+            jnp.where(init_win, aux1 - sync.barrier_count[bar], 0)
         )
         new_arrival = active & is_bwait & ~sync.barrier_waiting
         arr_tgt = jnp.where(new_arrival, bar, 0)
@@ -581,7 +591,11 @@ def subquantum_iteration(
 
         slot_lat = (mem_out.slot_lat_ps if params.mem is not None
                     else jnp.zeros((T, 3), I64))
-        ioc_commit_mask = advance & instr_like
+        # heterogeneous tiles: non-iocoom lanes take the simple path below
+        ioc_tiles = (jnp.asarray(params.iocoom_tiles, jnp.bool_)
+                     if params.iocoom_tiles is not None
+                     else jnp.ones((T,), jnp.bool_))
+        ioc_commit_mask = advance & instr_like & ioc_tiles
         new_ioc, ioc_clock, ioc_mem_stall, ioc_exec_stall = iocoom_commit(
             params.iocoom, state.ioc,
             commit=ioc_commit_mask,
@@ -599,11 +613,14 @@ def subquantum_iteration(
             slot_lat_ps=slot_lat,
             enabled=enabled,
         )
+        simple_instr = instr_like & ~ioc_tiles
         clock = jnp.where(advance & (is_bblock
                                      | (is_dynamic & ~is_spawn_instr)
-                                     | is_simple_event | is_send),
+                                     | is_simple_event | is_send
+                                     | simple_instr),
                           clock + cost_ps
-                          + jnp.where(is_bblock, mem_acc_ps, 0),
+                          + jnp.where(is_bblock | simple_instr,
+                                      mem_acc_ps, 0),
                           clock)
         clock = jnp.where(ioc_commit_mask, ioc_clock, clock)
     else:
@@ -697,11 +714,13 @@ def subquantum_iteration(
         + recv_charged.astype(I64)
         + sync_charged.astype(I64),
         memory_stall_ps=core.memory_stall_ps
-        + (jnp.where(advance & is_bblock, mem_acc_ps, 0) + ioc_mem_stall
+        + (jnp.where(advance & (is_bblock | simple_instr), mem_acc_ps, 0)
+           + ioc_mem_stall
            if params.iocoom is not None else
            jnp.where(advance & (instr_like | is_bblock), mem_acc_ps, 0)),
         execution_stall_ps=core.execution_stall_ps
-        + (jnp.where(advance & is_bblock, cost_ps, 0) + ioc_exec_stall
+        + (jnp.where(advance & (is_bblock | simple_instr), cost_ps, 0)
+           + ioc_exec_stall
            if params.iocoom is not None else
            jnp.where(advance & (is_static | is_branch | is_bblock),
                      cost_ps, 0)),
