@@ -1,9 +1,12 @@
 """Benchmark: aggregate simulated instructions/second on one chip.
 
-North star (BASELINE.json): ≥10M aggregate simulated instr/s at 1024 tiles.
-The kernel: a compute+message workload (BENCH_TILES, default 1024 tiles) (nearest-neighbor
-pattern over the e-mesh, hop-counter NoC timing) replayed through the full
-vectorized core/network/sync stack.  Prints exactly one JSON line.
+North star (BASELINE.json): ≥10M aggregate simulated instr/s on the
+1024-tile e-mesh running SPLASH-2 FFT.  Default workload: the six-step FFT
+trace program (`trace/benchmarks.py` — butterflies + three all-to-all
+transposes + barriers, BENCH_POINTS points per tile) replayed through the
+full vectorized core/network/sync stack on hop-counter NoC timing.  Set
+BENCH_WORKLOAD=ring for the legacy compute+message ring.  Prints exactly
+one JSON line.
 """
 
 import json
@@ -12,6 +15,10 @@ import sys
 import time
 
 N_TILES = int(os.environ.get("BENCH_TILES", "1024"))
+WORKLOAD = os.environ.get("BENCH_WORKLOAD", "fft")
+# fft: simulated FFT size = BENCH_TILES * BENCH_POINTS points
+N_POINTS = int(os.environ.get("BENCH_POINTS", "2048"))
+# ring workload knobs
 N_ROUNDS = int(os.environ.get("BENCH_ROUNDS", "64"))
 COMPUTE_PER_ROUND = int(os.environ.get("BENCH_COMPUTE", "62"))
 # Basic-block-granularity replay (one BBLOCK record per straight-line run,
@@ -61,11 +68,32 @@ size = 1024
 scheme = lax
 """
     sc = SimConfig(ConfigFile.from_string(cfg_text))
-    batch = synthetic.message_ring_batch(
-        N_TILES, n_rounds=N_ROUNDS, compute_per_round=COMPUTE_PER_ROUND,
-        compressed=COMPRESSED,
-    )
-    sim = Simulator(sc, batch, mailbox_depth=8, inner_block=64)
+    if WORKLOAD == "fft":
+        from graphite_tpu.trace.benchmarks import fft_trace
+
+        batch = fft_trace(N_TILES, points_per_tile=N_POINTS)
+        desc = f"SPLASH-2 FFT {N_TILES * N_POINTS}-point"
+    elif WORKLOAD == "ring":
+        batch = synthetic.message_ring_batch(
+            N_TILES, n_rounds=N_ROUNDS, compute_per_round=COMPUTE_PER_ROUND,
+            compressed=COMPRESSED,
+        )
+        desc = "compute+message workload"
+    else:
+        from graphite_tpu.trace.benchmarks import BENCHMARKS
+
+        if WORKLOAD not in BENCHMARKS:
+            raise SystemExit(
+                f"unknown BENCH_WORKLOAD {WORKLOAD!r} "
+                f"(choose from: fft, ring, {', '.join(BENCHMARKS)})"
+            )
+        batch = BENCHMARKS[WORKLOAD](N_TILES)
+        desc = WORKLOAD
+    # FFT: at most one in-flight message per (src,dst) pair between
+    # barriers, so depth-2 rings suffice (overflow raises, never corrupts);
+    # smaller [T,T,depth] rings cut per-iteration HBM traffic ~1.4x
+    depth = 2 if WORKLOAD == "fft" else 8
+    sim = Simulator(sc, batch, mailbox_depth=depth, inner_block=64)
 
     # Warm-up: compile (and run once) the full device-side simulation loop.
     sim.warmup()
@@ -80,7 +108,7 @@ scheme = lax
         json.dumps(
             {
                 "metric": f"simulated instr/s ({N_TILES}-tile emesh, "
-                f"compute+message workload, "
+                f"{desc}, "
                 f"{'bblock' if COMPRESSED else 'per-instr'} trace)",
                 "value": round(ips),
                 "unit": "instr/s",

@@ -30,11 +30,11 @@ import numpy as np
 
 from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
 
-# Barrier ids used by the generators (one id space per app run).
+# All generators use barrier id 0 (one barrier per app run, reused).
 _BAR = 0
 
 
-def _all_to_all_phase(builders, n_tiles, bytes_per_msg, me_first=True):
+def _all_to_all_phase(builders, n_tiles, bytes_per_msg):
     """Tile t sends one message to every other tile, then receives one from
     every other tile — the transpose/permutation skeleton.  Staggered start
     offsets avoid every tile hammering tile 0 first."""
@@ -50,31 +50,71 @@ def _barrier(builders):
         b.barrier_wait(_BAR)
 
 
-def _new_run(builders, count):
-    global _BAR
-    _BAR = 0
-    builders[0].barrier_init(_BAR, count)
-
-
 def fft_trace(n_tiles: int, points_per_tile: int = 256,
               use_memory: bool = False) -> TraceBatch:
     """Six-step FFT: transpose, column FFTs, twiddle, transpose, row FFTs,
     transpose (SPLASH-2 fft.C structure).  Butterfly cost: ~10 fp ops per
-    point per log2 stage (complex mul + add) → FMUL/FALU bblocks."""
-    builders = [TraceBuilder() for _ in range(n_tiles)]
-    _new_run(builders, n_tiles)
+    point per log2 stage (complex mul + add) → FMUL/FALU bblocks.
+
+    The default (no-memory) form is built as vectorized [T, L] numpy
+    columns — the per-record Python-append path is O(T^2) at 1024 tiles
+    (6M+ appends) and would dominate bench startup."""
     stages = max(1, int(np.log2(max(2, points_per_tile))))
     fly_instr = points_per_tile * stages * 10
     msg_bytes = max(8, (points_per_tile // max(1, n_tiles)) * 16)
+    if use_memory:
+        return _fft_trace_with_memory(n_tiles, points_per_tile, fly_instr,
+                                      msg_bytes)
+
+    from graphite_tpu.trace.synthetic import _batch_from_columns
+
+    T = n_tiles
+    t = np.arange(T, dtype=np.int64)[:, None]
+    i = np.arange(1, T, dtype=np.int64)[None, :]
+
+    def col(op, aux0, aux1):
+        return (np.full((T, 1), int(op), np.uint8),
+                np.broadcast_to(np.asarray(aux0, np.int64), (T, 1)),
+                np.full((T, 1), aux1, np.int64))
+
+    ops, a0s, a1s = [], [], []
+
+    def emit(op_block, aux0_block, aux1_block):
+        ops.append(op_block)
+        a0s.append(aux0_block)
+        a1s.append(aux1_block)
+
+    # BARRIER_INIT on every tile: idempotent count set, zero cost
+    emit(*col(Op.BARRIER_INIT, np.zeros((T, 1)), T))
+    a2a_send = (np.full((T, T - 1), int(Op.SEND), np.uint8),
+                (t + i) % T, np.full((T, T - 1), msg_bytes, np.int64))
+    a2a_recv = (np.full((T, T - 1), int(Op.NET_RECV), np.uint8),
+                (t - i) % T, np.full((T, T - 1), msg_bytes, np.int64))
     for phase in range(3):  # the three transposes bracket two FFT passes
+        emit(*col(Op.BARRIER_WAIT, np.zeros((T, 1)), 0))
+        emit(*a2a_send)
+        emit(*a2a_recv)
+        if phase < 2:
+            emit(*col(Op.BBLOCK, np.full((T, 1), fly_instr), fly_instr))
+    emit(*col(Op.BARRIER_WAIT, np.zeros((T, 1)), 0))
+    return _batch_from_columns(
+        np.concatenate(ops, axis=1),
+        aux0=np.concatenate(a0s, axis=1),
+        aux1=np.concatenate(a1s, axis=1),
+    )
+
+
+def _fft_trace_with_memory(n_tiles, points_per_tile, fly_instr, msg_bytes):
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    builders[0].barrier_init(_BAR, n_tiles)
+    for phase in range(3):
         _barrier(builders)
         _all_to_all_phase(builders, n_tiles, msg_bytes)
         if phase < 2:
             for t, b in enumerate(builders):
-                if use_memory:
-                    base = (t * points_per_tile) * 64
-                    for i in range(min(points_per_tile, 32)):
-                        b.load(base + i * 64)
+                base = (t * points_per_tile) * 64
+                for j in range(min(points_per_tile, 32)):
+                    b.load(base + j * 64)
                 b.bblock(fly_instr, fly_instr)  # 1-IPC fp pipeline
     _barrier(builders)
     return TraceBatch.from_builders(builders)
@@ -86,7 +126,7 @@ def radix_trace(n_tiles: int, keys_per_tile: int = 1024,
     (point-to-point up/down sweeps), permutation all-to-all (SPLASH-2
     radix.C structure)."""
     builders = [TraceBuilder() for _ in range(n_tiles)]
-    _new_run(builders, n_tiles)
+    builders[0].barrier_init(_BAR, n_tiles)
     digits = max(1, 32 // max(1, int(np.log2(radix))))
     for d in range(min(digits, 4)):
         # histogram: ~4 int ops per key
@@ -125,7 +165,7 @@ def blackscholes_trace(n_tiles: int, options_per_tile: int = 512,
     exp/log/sqrt approximations), one barrier per sweep (PARSEC
     blackscholes.c bs_thread loop)."""
     builders = [TraceBuilder() for _ in range(n_tiles)]
-    _new_run(builders, n_tiles)
+    builders[0].barrier_init(_BAR, n_tiles)
     per_sweep = options_per_tile * 200
     for s in range(sweeps):
         for b in builders:
@@ -143,7 +183,7 @@ def canneal_trace(n_tiles: int, footprint_lines: int = 4096,
     netlist swap loop)."""
     rng = np.random.default_rng(seed)
     builders = [TraceBuilder() for _ in range(n_tiles)]
-    _new_run(builders, n_tiles)
+    builders[0].barrier_init(_BAR, n_tiles)
     for t, b in enumerate(builders):
         for s in range(swaps_per_tile):
             if use_memory:
