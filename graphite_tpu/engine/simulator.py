@@ -182,16 +182,6 @@ class Simulator:
                     f"caching protocol {mem_params.protocol!r} pending "
                     f"(available: {', '.join(supported)})"
                 )
-            if (mem_params.protocol.startswith("pr_l1_sh_l2")
-                    and mem_params.dir_type != "full_map"):
-                # The embedded shared-L2 directory (`l2_directory_cfg.cc`)
-                # implements only full_map here so far; refuse rather than
-                # silently running the wrong scheme (PARITY.md §2.5 caveat).
-                raise NotImplementedError(
-                    "directory_type "
-                    f"{mem_params.dir_type!r} is only supported by the "
-                    "private-L2 protocols; shared-L2 runs full_map"
-                )
         # Full hop-by-hop USER NoC with per-port contention
         user_hbh = None
         user_atac = None

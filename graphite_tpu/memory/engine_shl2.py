@@ -33,7 +33,7 @@ from graphite_tpu.memory.cache_array import (
     state_readable, state_writable,
 )
 from graphite_tpu.memory.engine import (
-    MemStepOut, RecView, _row_earliest, clear_bit,
+    MemStepOut, RecView, _row_earliest, clear_bit, lowest_sharer,
     mem_net_latency_ps, set_bit, test_bit, unpack_sharers,
 )
 from graphite_tpu.memory.params import MemParams
@@ -734,6 +734,47 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     pending = clear_bit(pending, jnp.clip(rreq, 0, T - 1),
                         upgrade_clear & test_bit(pending, rreq))
 
+    # ---- directory-scheme variants on the embedded L1-sharer directory
+    # (`l2_directory_cfg.cc` analog; same semantics as the private-L2
+    # engine's schemes — see memory/engine.py)
+    k = mp.max_hw_sharers
+    already = test_bit(sharers, rreq)
+    sh_over = jnp.zeros((T,), jnp.bool_)
+    over_bc = jnp.zeros((T,), jnp.bool_)
+    if mp.dir_type == "limited_no_broadcast":
+        # SH on SHARED at capacity: displace the lowest tracked sharer
+        sh_over = served & is_sh & shared & (nsh >= k) & ~already
+        victim = lowest_sharer(sharers)
+        victim_bits = set_bit(jnp.zeros((T, mp.sharer_words), U32),
+                              jnp.clip(victim, 0, T - 1),
+                              sh_over & (victim >= 0))
+        d = _dir_set(d, tiles=tiles, sets=eff_sets, way=eff_way,
+                     mask=sh_over,
+                     sharers=sharers & ~victim_bits, nsharers=nsh - 1)
+        pending = jnp.where(sh_over[:, None], victim_bits, pending)
+        fwd_msg = jnp.where(sh_over, MSG_INV_REQ, fwd_msg).astype(jnp.uint8)
+        fan = fan | sh_over
+        # M/E at capacity (k=1): the owner's WB becomes a FLUSH and the
+        # entry empties (addSharer failure on the downgrade); the finish
+        # then installs {requester} alone (MESI re-grants EXCLUSIVE)
+        sh_over_m = served & is_sh & owned_like & (nsh >= k) & ~already
+        fwd_msg = jnp.where(sh_over_m, MSG_FLUSH_REQ,
+                            fwd_msg).astype(jnp.uint8)
+        d = _dir_set(d, tiles=tiles, sets=eff_sets, way=eff_way,
+                     mask=sh_over_m,
+                     dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
+                     owner=jnp.full(T, -1, jnp.int32),
+                     sharers=jnp.zeros((T, mp.sharer_words), U32),
+                     nsharers=jnp.zeros(T, jnp.int32))
+    if mp.dir_type == "limitless":
+        sw_mode = (nsh > k) | (is_sh & ~already & (nsh >= k)
+                               & (shared | owned_like))
+        eff_time = eff_time + jnp.where(
+            enabled & starting & sw_mode,
+            cycles_to_ps(jnp.asarray(mp.limitless_trap_cycles, I64),
+                         mp.dir_freq_mhz),
+            0)
+
     activate = fan | data_missing | served | nullify_live
     txn = txn.replace(
         active=txn.active | (starting & activate),
@@ -753,6 +794,13 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     # multicast forwards
     targets = unpack_sharers(pending, T)
     send = fan[:, None] & targets
+    if mp.dir_type in ("ackwise", "limited_broadcast"):
+        # overflowed entries lose sharer precision: INV sweeps broadcast to
+        # every tile except the requester (its upgrade copy must survive);
+        # acks still awaited only from true holders (non-holders silent)
+        over_bc = fan_inv & (nsh > k)
+        send = send | (over_bc[:, None]
+                       & (tiles[None, :] != jnp.clip(rreq, 0, T - 1)[:, None]))
     send_t = send.T
     fwd_lat = mem_net_latency_ps(
         mp, tiles[:, None], tiles[None, :], mp.req_bits, enabled)
@@ -765,6 +813,8 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     counters = ms.counters.replace(
         dir_accesses=ms.counters.dir_accesses
         + (starting & enabled).astype(I64),
+        dir_broadcasts=ms.counters.dir_broadcasts
+        + (over_bc & enabled).astype(I64),
         l2_hits=ms.counters.l2_hits
         + (run_req & ~data_missing & enabled).astype(I64),
         l2_misses=ms.counters.l2_misses

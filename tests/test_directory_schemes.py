@@ -173,3 +173,36 @@ class TestFullMapUnchanged:
                 4, scheme, k=2,
                 protocol="pr_l1_pr_l2_dram_directory_mosi")
             assert res.func_errors == 0, scheme
+
+
+class TestSharedL2Schemes:
+    """The embedded shared-L2 directory (`l2_directory_cfg.cc` analog)
+    supports the same schemes over its L1-sharer lists."""
+
+    def test_shl2_ackwise_broadcast(self):
+        res = run_sharers_then_write(4, "ackwise", k=2,
+                                     protocol="pr_l1_sh_l2_msi")
+        assert res.func_errors == 0
+        assert res.mem_counters["dir_broadcasts"].sum() >= 1
+
+    def test_shl2_limited_no_broadcast(self):
+        lim = run_sharers_then_write(4, "limited_no_broadcast", k=2,
+                                     protocol="pr_l1_sh_l2_msi")
+        assert lim.func_errors == 0
+        assert lim.mem_counters["invalidations"].sum() >= 2
+        assert lim.mem_counters["dir_broadcasts"].sum() == 0
+
+    def test_shl2_limitless_trap(self):
+        full = run_sharers_then_write(4, "full_map",
+                                      protocol="pr_l1_sh_l2_mesi")
+        lim = run_sharers_then_write(4, "limitless", k=2, trap=200,
+                                     protocol="pr_l1_sh_l2_mesi")
+        assert lim.func_errors == 0
+        assert lim.completion_time_ps > full.completion_time_ps
+
+    def test_shl2_mesi_capacity_downgrade(self):
+        """k=1 on MESI: the E owner is flushed out when a second reader
+        arrives; EXCLUSIVE is re-granted to the newcomer."""
+        res = run_sharers_then_write(2, "limited_no_broadcast", k=1,
+                                     protocol="pr_l1_sh_l2_mesi")
+        assert res.func_errors == 0
