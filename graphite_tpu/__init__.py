@@ -24,12 +24,25 @@ Simulated time is exact integer picoseconds throughout
 jax_enable_x64 at import.  Hot per-quantum deltas still use int32 internally.
 """
 
+import os
+
 import jax
 
 # Picosecond-resolution simulated time needs 64-bit integers (a 1 GHz tile
 # overflows int32 picoseconds after ~2ms of simulated time).  TPUs emulate
 # int64 in pairs of int32 ops; the hot kernels keep deltas in int32.
 jax.config.update("jax_enable_x64", True)
+
+# The compiled quantum loop is a large program (core + protocol + NoC +
+# sync FSMs fused into one while_loop); cold compiles run 1-3 minutes at
+# large tile counts.  Cache compilations persistently so repeat runs of
+# the same topology start in seconds.  GRAPHITE_TPU_NO_CACHE=1 opts out.
+if (not os.environ.get("GRAPHITE_TPU_NO_CACHE")
+        and jax.config.jax_compilation_cache_dir is None):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.expanduser("~"), ".cache", "graphite_tpu_xla"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 __version__ = "0.1.0"
 

@@ -479,6 +479,14 @@ def carbon_set_tile_frequency(domain: int, freq_mhz: int) -> None:
     _app().builders[_tile()].dvfs_set(domain, freq_mhz)
 
 
+def carbon_get_tile_frequency(domain: int) -> None:
+    """`CarbonGetDVFS` — records the DVFS-network query round trip; the
+    frequency itself is a replay-side quantity (the live frontend has no
+    simulated clock), so the call returns None."""
+    b = _app().builders[_tile()]
+    b._append(Op.DVFS_GET, aux0=domain)
+
+
 # ---- syscalls (SyscallMdl client → MCP SyscallServer) -------------------
 # Each call executes against the app's central simulated-OS view and
 # records one SYSCALL trace event; replay charges the SYSTEM-network round
