@@ -226,11 +226,13 @@ def subquantum_iteration(
     cost_ps = cycles_to_ps(cycles, core.freq_mhz.astype(I64))
     cost_ps = jnp.where(is_dynamic, dyn_ps, cost_ps)
     cost_ps = jnp.where(op < 20, cost_ps, 0)  # events carry no direct cost
-    # ... except syscalls: the app thread blocks for the SYSTEM-network
-    # round trip to the MCP's SyscallServer (`syscall_model.cc` marshalling;
-    # SYSTEM is always magic, `config.cc:484` → 1 cycle each way)
-    cost_ps = jnp.where(is_syscall, jnp.asarray(params.syscall_rt_ps, I64),
-                        cost_ps)
+    # ... except syscalls and DVFS queries: the app thread blocks for a
+    # round trip — to the MCP's SyscallServer over the SYSTEM network
+    # (`syscall_model.cc` marshalling) or to the target DVFS manager over
+    # the DVFS network (`dvfs_manager.cc` remote get).  Both networks are
+    # always magic (`config.cc:484-485` → 1 cycle each way).
+    cost_ps = jnp.where(is_syscall | (op == Op.DVFS_GET),
+                        jnp.asarray(params.syscall_rt_ps, I64), cost_ps)
     # compressed run: aux1 = total cycles for aux0 instructions
     cost_ps = jnp.where(
         is_bblock,

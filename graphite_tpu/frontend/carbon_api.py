@@ -480,9 +480,10 @@ def carbon_set_tile_frequency(domain: int, freq_mhz: int) -> None:
 
 
 def carbon_get_tile_frequency(domain: int) -> None:
-    """`CarbonGetDVFS` — records the DVFS-network query round trip; the
-    frequency itself is a replay-side quantity (the live frontend has no
-    simulated clock), so the call returns None."""
+    """`CarbonGetDVFS` — the replay charges the DVFS-network round trip to
+    the queried manager (1 magic-network cycle each way, like a syscall's
+    SYSTEM-net trip); the frequency itself is a replay-side quantity (the
+    live frontend has no simulated clock), so the call returns None."""
     b = _app().builders[_tile()]
     b._append(Op.DVFS_GET, aux0=domain)
 

@@ -1,0 +1,141 @@
+"""Configuration-sweep regression driver — the analog of the reference's
+`tools/regress/run_tests.py` + `aggregate_results.py` (compile & schedule
+SPLASH-2 x machines x modes with config overrides, aggregate results).
+
+Sweeps the model matrix on small traces: caching protocol x directory
+scheme x NoC model x core model, replaying a benchmark trace through each,
+and prints one result row per config (completion time, instructions,
+func_errors).  Exit code is nonzero if any config fails.
+
+Usage:
+  python -m graphite_tpu.tools.regress [--tiles 8] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import sys
+import time
+
+
+BASE_CFG = """
+[general]
+total_cores = {tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = {shared_mem}
+[tile]
+model_list = <{tiles}, {core}>
+[caching_protocol]
+type = {protocol}
+[dram_directory]
+directory_type = {scheme}
+max_hw_sharers = 2
+[network]
+user = {network}
+memory = {network}
+[network/emesh_hop_counter]
+flit_width = 64
+[network/emesh_hop_counter/router]
+delay = 1
+[network/emesh_hop_counter/link]
+delay = 1
+[core/static_instruction_costs]
+generic = 1
+mov = 1
+ialu = 1
+falu = 3
+[branch_predictor]
+type = one_bit
+mispredict_penalty = 14
+size = 1024
+[clock_skew_management]
+scheme = lax_barrier
+[clock_skew_management/lax_barrier]
+quantum = 1000
+"""
+
+PROTOCOLS = (
+    "pr_l1_pr_l2_dram_directory_msi",
+    "pr_l1_pr_l2_dram_directory_mosi",
+    "pr_l1_sh_l2_msi",
+    "pr_l1_sh_l2_mesi",
+)
+SCHEMES = ("full_map", "limited_no_broadcast", "ackwise", "limitless")
+NETWORKS = ("magic", "emesh_hop_counter", "emesh_hop_by_hop")
+CORES = ("simple", "iocoom")
+
+
+def run_one(tiles, protocol, scheme, network, core, workload):
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.engine.simulator import Simulator
+    from graphite_tpu.trace.benchmarks import BENCHMARKS
+
+    shared = workload == "canneal"
+    cfg = ConfigFile.from_string(BASE_CFG.format(
+        tiles=tiles, protocol=protocol, scheme=scheme, network=network,
+        core=core, shared_mem="true" if shared else "false"))
+    if workload == "canneal":
+        batch = BENCHMARKS[workload](tiles, footprint_lines=256,
+                                     swaps_per_tile=6)
+    else:
+        batch = BENCHMARKS[workload](tiles, points_per_tile=32)
+    sim = Simulator(SimConfig(cfg), batch)
+    res = sim.run()
+    return res
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiles", type=int, default=8)
+    ap.add_argument("--quick", action="store_true",
+                    help="one representative config per axis instead of "
+                    "the cross product")
+    args = ap.parse_args()
+
+    if args.quick:
+        matrix = [
+            ("pr_l1_pr_l2_dram_directory_msi", "full_map", "magic",
+             "simple", "canneal"),
+            ("pr_l1_pr_l2_dram_directory_mosi", "ackwise",
+             "emesh_hop_counter", "iocoom", "canneal"),
+            ("pr_l1_sh_l2_mesi", "limited_no_broadcast",
+             "emesh_hop_by_hop", "simple", "canneal"),
+            ("pr_l1_pr_l2_dram_directory_msi", "full_map",
+             "emesh_hop_counter", "iocoom", "fft"),
+        ]
+    else:
+        # memory sweep: protocol x scheme (network/core fixed), then
+        # network x core (protocol fixed) on the fft kernel
+        matrix = [(p, s, "magic", "simple", "canneal")
+                  for p, s in itertools.product(PROTOCOLS, SCHEMES)]
+        matrix += [("pr_l1_pr_l2_dram_directory_msi", "full_map", n, c,
+                    "fft")
+                   for n, c in itertools.product(NETWORKS, CORES)]
+
+    failures = 0
+    print(f"{'protocol':38} {'scheme':22} {'network':18} {'core':7} "
+          f"{'workload':8} {'ns':>10} {'instrs':>10} ok")
+    for protocol, scheme, network, core, workload in matrix:
+        t0 = time.perf_counter()
+        try:
+            res = run_one(args.tiles, protocol, scheme, network, core,
+                          workload)
+            ok = res.func_errors == 0
+            failures += 0 if ok else 1
+            print(f"{protocol:38} {scheme:22} {network:18} {core:7} "
+                  f"{workload:8} {res.completion_time_ps // 1000:>10} "
+                  f"{res.total_instructions:>10} "
+                  f"{'PASS' if ok else 'FAIL'}  ({time.perf_counter()-t0:.0f}s)")
+        except Exception as e:  # noqa: BLE001 — a sweep reports, not raises
+            failures += 1
+            print(f"{protocol:38} {scheme:22} {network:18} {core:7} "
+                  f"{workload:8} {'-':>10} {'-':>10} FAIL  {type(e).__name__}: "
+                  f"{str(e)[:80]}")
+    print(f"{failures} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -185,3 +185,18 @@ class TestSyscallTiming:
         assert res.clock_ps[0] == 4_000
         # syscalls are not instructions
         assert res.instruction_count[0] == 2
+
+
+class TestDvfsGetTiming:
+    def test_dvfs_get_round_trip_cost(self):
+        """DVFS_GET blocks for the DVFS-network round trip (magic net:
+        2 cycles at 1 GHz = 2 ns), mirroring the syscall path."""
+        sc = make_config(1)
+        b = TraceBuilder()
+        b.instr(Op.IALU)          # 1 ns
+        b._append(Op.DVFS_GET, aux0=0)  # 2 ns
+        b.instr(Op.IALU)          # 1 ns
+        from graphite_tpu.engine.simulator import Simulator
+
+        res = Simulator(sc, TraceBatch.from_builders([b])).run()
+        assert res.clock_ps[0] == 4_000
