@@ -123,5 +123,19 @@ scheme = lax
     )
 
 
+def _main_with_retry() -> None:
+    """The tunnel can hand a fresh client UNAVAILABLE right after another
+    TPU process exits; re-exec once so a transient never fails the bench."""
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001
+        if ("UNAVAILABLE" in str(e)
+                and not os.environ.get("GRAPHITE_BENCH_RETRIED")):
+            os.environ["GRAPHITE_BENCH_RETRIED"] = "1"
+            time.sleep(10)
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        raise
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_main_with_retry())

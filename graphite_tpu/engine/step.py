@@ -501,8 +501,33 @@ def subquantum_iteration(
         grant_key = eff_clock * jnp.asarray(T, I64) + tiles.astype(I64)
         best_key = _elect_min(lock_candidate, lmux, grant_key, NM)
         grantable = mutex_locked == 0
-        granted = lock_candidate & grantable[lmux] & (
-            grant_key == best_key[lmux])
+        # Time-order completeness guard (mirrors cond delivery): a grant
+        # may only commit when nothing can still produce an earlier
+        # (time, tile) request for ANY mutex:
+        #  - lanes at non-blocking records will request at >= their current
+        #    clock (conservatively keyed with tile 0);
+        #  - candidates on other FREE mutexes could commit and re-emerge at
+        #    their own (earlier) clock — so only the earliest candidate
+        #    among grantable ones commits per iteration;
+        #  - candidates on LOCKED mutexes re-emerge no earlier than their
+        #    holder's future unlock (>= the holder's current clock), so
+        #    they are bounded transitively through the holder and may be
+        #    excluded — excluding them is also what keeps lock-ordered
+        #    nesting deadlock-free (a waiter on a held mutex must not veto
+        #    the holder's own acquisition of its next lock);
+        #  - recv/join/barrier-parked lanes re-emerge at wake times bounded
+        #    below by some running lane's clock, so they are covered by
+        #    the advancing-lane bound transitively.
+        cur_blocking = (is_recv | is_join | is_bwait | is_mlock | is_cwait)
+        advancing = ~done & ~cur_blocking
+        min_adv_key = jnp.min(jnp.where(
+            advancing, core.clock_ps * jnp.asarray(T, I64), BIG))
+        free_cand_min = jnp.min(jnp.where(
+            lock_candidate & grantable[lmux], grant_key, BIG))
+        granted = (lock_candidate & grantable[lmux]
+                   & (grant_key == best_key[lmux])
+                   & (grant_key == free_cand_min)
+                   & (grant_key <= min_adv_key))
         mutex_grab_time = sync.mutex_time_ps[lmux]
         # wait until: the mutex handoff, and for woken waiters the signal
         # time — clock_new = clock + wait = max(clock, wake, grab)

@@ -1,0 +1,12 @@
+"""Golden sequential oracle for differential testing.
+
+The reference framework's role of "second implementation to diff against"
+(the cycle-parity harness of SURVEY §4) is played here by an independent
+event-driven Python interpreter of the same trace semantics: it shares no
+code with the vectorized engine and orders every decision by simulated
+time, so engine-vs-oracle equality on random traces checks that the
+masked-iteration engine implements exactly the time-ordered semantics it
+claims.
+"""
+
+from graphite_tpu.golden.interpreter import GoldenResult, run_golden  # noqa: F401

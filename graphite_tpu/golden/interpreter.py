@@ -1,0 +1,340 @@
+"""Event-driven sequential interpreter of the trace semantics (the oracle).
+
+Independent second implementation for differential testing: a classic
+discrete-event loop (always advance the runnable tile with the smallest
+clock; blocked tiles park until their wake event exists).  Every
+synchronization decision is ordered by (simulated time, tile id) — the
+semantics the vectorized engine (`engine/step.py`) claims to implement
+with masked iterations:
+
+ - costs: static table cycles at the tile frequency (ceil ps conversion),
+   one-bit branch predictor (predict last outcome, pc % size), BBLOCK runs
+   aux1 cycles / aux0 instructions, dynamic records carry their cost;
+ - SEND: zero-load arrival = clock + route latency (magic 1 cycle;
+   hop-counter XY hops * (router+link) + receive serialization flits,
+   self-sends skip serialization); RECV: clock = max(clock, arrival),
+   charged as an instruction only when it waited;
+ - BARRIER: release at the maximum arrival time (`SimBarrier`);
+ - MUTEX: handoff at unlock time to the waiter with the earliest
+   (clock, tile) key (`SimMutex`);
+ - COND: wait releases the mutex; a signal at time S wakes the earliest
+   eligible waiter (wait began at or before S) at time S, which then
+   re-acquires the mutex; signals with no eligible waiter are lost;
+   broadcast wakes every eligible waiter (`SimCond`);
+ - THREAD_JOIN: clock pinned at max(clock, target stream's exit clock);
+   Op.SPAWN (dynamic) sets clock = max(clock, value);
+ - SYSCALL / DVFS_GET: the MCP / DVFS-manager round trip (2 cycles at
+   1 GHz — both networks are magic);
+ - ENABLE/DISABLE_MODELS: zero cost and no counters while disabled.
+
+Scope (v1): everything except the shared-memory hierarchy and DVFS
+retuning — run with enable_shared_mem=false and a fixed frequency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from graphite_tpu.trace.schema import FLAG_BRANCH_TAKEN, Op, TraceBatch
+
+ANY_SENDER = -1  # CAPI wildcard sender (`engine/step.py:57`)
+
+HEADER_BYTES = 64  # NetPacket header (`network.h:27-53`)
+FAR = 2**62
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def cycles_to_ps(cycles: int, freq_mhz: int) -> int:
+    return _ceil_div(cycles * 10**6, freq_mhz)
+
+
+@dataclasses.dataclass
+class GoldenResult:
+    clock_ps: np.ndarray
+    instruction_count: np.ndarray
+    recv_instructions: np.ndarray
+    sync_instructions: np.ndarray
+    bp_correct: np.ndarray
+    bp_incorrect: np.ndarray
+
+
+class _Net:
+    def __init__(self, kind, freq_mhz, mesh_width, hop_cycles, flit_bits):
+        self.kind = kind
+        self.freq_mhz = freq_mhz
+        self.w = mesh_width
+        self.hop_cycles = hop_cycles
+        self.flit_bits = flit_bits
+
+    def latency_ps(self, src, dst, payload_bytes, enabled):
+        if self.kind == "magic":
+            return cycles_to_ps(1, self.freq_mhz)
+        hops = abs(src % self.w - dst % self.w) + abs(
+            src // self.w - dst // self.w)
+        cycles = hops * self.hop_cycles
+        if src != dst and self.flit_bits > 0:
+            cycles += _ceil_div((HEADER_BYTES + payload_bytes) * 8,
+                                self.flit_bits)
+        return cycles_to_ps(cycles, self.freq_mhz) if enabled else 0
+
+
+class _Tile:
+    __slots__ = ("tid", "clock", "idx", "done", "blocked", "counts")
+
+    def __init__(self, tid):
+        self.tid = tid
+        self.clock = 0
+        self.idx = 0
+        self.done = False
+        self.blocked = None  # None | ("recv", src) | ("barrier", b)
+        #                       | ("mutex", m) | ("join", t) | ("cond", c, m)
+        self.counts = dict(instr=0, recv=0, sync=0, bp_ok=0, bp_bad=0)
+
+
+def run_golden(sim_config, batch: TraceBatch,
+               syscall_rt_ps: int = 2000) -> GoldenResult:
+    cfg = sim_config.cfg
+    T = batch.n_tiles
+    freq_mhz = int(cfg.get_float("general/max_frequency", 1.0) * 1000)
+
+    # static cost table
+    from graphite_tpu.trace.schema import STATIC_COST_KEYS
+
+    costs = [cfg.get_int(f"core/static_instruction_costs/{k}", 0)
+             for k in STATIC_COST_KEYS]
+
+    net_kind = cfg.get_string("network/user", "magic")
+    if net_kind == "magic":
+        net = _Net("magic", 1000, 0, 0, -1)
+    else:
+        from graphite_tpu.models.network_user import mesh_dims
+
+        w, _ = mesh_dims(T)
+        router = cfg.get_int(f"network/{net_kind}/router/delay", 1)
+        link = cfg.get_int(f"network/{net_kind}/link/delay", 1)
+        flit = cfg.get_int(f"network/{net_kind}/flit_width", 64)
+        net = _Net("emesh", 1000, w, router + link, flit)
+
+    bp_size = cfg.get_int("branch_predictor/size", 1024)
+    bp_penalty = cfg.get_int("branch_predictor/mispredict_penalty", 14)
+    bp_bits = np.zeros((T, bp_size), np.uint8)
+
+    tiles = [_Tile(t) for t in range(T)]
+    enabled = [True]  # models toggle is GLOBAL (PerformanceCounterManager)
+    # messages: (src,dst) -> FIFO of (arrival_ps,)
+    channels: dict[tuple, list] = {}
+    barriers: dict[int, dict] = {}   # id -> {count, arrived:[(clock,tile)]}
+    mutexes: dict[int, dict] = {}    # id -> {locked, handoff, waiters}
+    conds: dict[int, list] = {}      # id -> [(arrival, tile, mutex_id)]
+    exit_clock: dict[int, int] = {}
+
+    def runnable(t: _Tile) -> bool:
+        if t.done or t.blocked is not None:
+            return False
+        return t.idx < batch.length
+
+    def rec(t, field):
+        return int(getattr(batch, field)[t.tid, t.idx])
+
+    def grant_mutex(m: int):
+        """Hand the mutex to the waiter with the smallest (eff_clock, tile)
+        key, at the unlock handoff time (`SimMutex`)."""
+        mx = mutexes.setdefault(m, dict(locked=False, handoff=0, waiters=[]))
+        if mx["locked"] or not mx["waiters"]:
+            return
+        mx["waiters"].sort()
+        eff_clock, wtid, wake = mx["waiters"].pop(0)
+        mx["locked"] = True
+        t = tiles[wtid]
+        new_clock = max(eff_clock, mx["handoff"], wake)
+        if new_clock > t.clock and enabled[0]:
+            t.counts["sync"] += 1
+        t.clock = new_clock
+        t.blocked = None
+
+    def try_unblock(t: _Tile):
+        """Re-check a parked tile's wake condition."""
+        kind = t.blocked[0]
+        if kind == "recv":
+            src = t.blocked[1]
+            if src == ANY_SENDER:
+                cand = [(q[0], s) for (s, d), q in channels.items()
+                        if d == t.tid and q]
+                if not cand:
+                    return
+                arrival, src = min(cand)
+            else:
+                q = channels.get((src, t.tid))
+                if not q:
+                    return
+                arrival = q[0]
+            channels[(src, t.tid)].pop(0)
+            if arrival > t.clock:
+                if enabled[0]:
+                    t.counts["recv"] += 1
+                t.clock = arrival
+            t.blocked = None
+            t.idx += 1
+        elif kind == "join":
+            target = t.blocked[1]
+            if target in exit_clock:
+                t.clock = max(t.clock, exit_clock[target])
+                t.blocked = None
+                t.idx += 1
+
+    def step(t: _Tile):
+        op = rec(t, "op")
+        aux0, aux1 = rec(t, "aux0"), rec(t, "aux1")
+        advance = True
+        if op == Op.THREAD_EXIT or op == Op.NOP:
+            t.done = True
+            exit_clock[t.tid] = t.clock
+            for other in tiles:
+                if other.blocked and other.blocked[0] == "join" \
+                        and other.blocked[1] == t.tid:
+                    try_unblock(other)
+            return
+        if op < Op.DYNAMIC_MISC and op != Op.BRANCH:   # static instr
+            if enabled[0]:
+                t.clock += cycles_to_ps(costs[op], freq_mhz)
+                t.counts["instr"] += 1
+        elif op == Op.BRANCH:
+            pc = rec(t, "pc") % bp_size
+            taken = 1 if (rec(t, "flags") & FLAG_BRANCH_TAKEN) else 0
+            ok = bp_bits[t.tid, pc] == taken
+            bp_bits[t.tid, pc] = taken
+            cycles = 1 if ok else bp_penalty
+            if enabled[0]:
+                t.clock += cycles_to_ps(cycles, freq_mhz)
+                t.counts["instr"] += 1
+                t.counts["bp_ok" if ok else "bp_bad"] += 1
+        elif op < 20:                                   # dynamic
+            dyn = int(batch.dyn_ps[t.tid, t.idx])
+            if op == Op.SPAWN:
+                t.clock = max(t.clock, dyn)
+            else:
+                if enabled[0]:
+                    t.clock += dyn
+                    t.counts["instr"] += 1
+        elif op == Op.BBLOCK:
+            if enabled[0]:
+                t.clock += cycles_to_ps(aux1, freq_mhz)
+                t.counts["instr"] += aux0
+        elif op == Op.SEND:
+            lat = net.latency_ps(t.tid, aux0, aux1, enabled[0])
+            channels.setdefault((t.tid, aux0), []).append(t.clock + lat)
+            for other in tiles:
+                if other.blocked and other.blocked[0] == "recv":
+                    try_unblock(other)
+        elif op == Op.NET_RECV:
+            t.blocked = ("recv", aux0)
+            try_unblock(t)
+            return  # try_unblock advances idx on success
+        elif op == Op.BARRIER_INIT:
+            b = barriers.setdefault(aux0, dict(count=0, arrived=[]))
+            b["count"] = aux1  # re-arm the count; arrivals stay
+        elif op == Op.BARRIER_WAIT:
+            b = barriers[aux0]
+            b["arrived"].append(t.tid)
+            t.blocked = ("barrier", aux0)
+            t.idx += 1  # the record commits at release time
+            if len(b["arrived"]) >= b["count"]:
+                release = max(tiles[x].clock for x in b["arrived"])
+                for x in b["arrived"]:
+                    tx = tiles[x]
+                    if release > tx.clock and enabled[0]:
+                        tx.counts["sync"] += 1
+                    tx.clock = max(tx.clock, release)
+                    tx.blocked = None
+                b["arrived"] = []
+            return
+        elif op == Op.MUTEX_INIT:
+            mutexes[aux0] = dict(locked=False, handoff=0, waiters=[])
+        elif op == Op.MUTEX_LOCK:
+            mutexes.setdefault(
+                aux0, dict(locked=False, handoff=0, waiters=[]))
+            mutexes[aux0]["waiters"].append((t.clock, t.tid, 0))
+            t.blocked = ("mutex", aux0)
+            t.idx += 1
+            grant_mutex(aux0)
+            return
+        elif op == Op.MUTEX_UNLOCK:
+            mx = mutexes[aux0]
+            mx["locked"] = False
+            mx["handoff"] = t.clock
+            grant_mutex(aux0)
+        elif op == Op.COND_INIT:
+            conds[aux0] = []
+        elif op == Op.COND_WAIT:
+            # release the mutex, park on the cond
+            mx = mutexes[aux1]
+            mx["locked"] = False
+            mx["handoff"] = t.clock
+            conds.setdefault(aux0, []).append((t.clock, t.tid, aux1))
+            t.blocked = ("cond", aux0, aux1)
+            t.idx += 1
+            grant_mutex(aux1)
+            return
+        elif op in (Op.COND_SIGNAL, Op.COND_BROADCAST):
+            S = t.clock
+            waiters = conds.setdefault(aux0, [])
+            elig = sorted(w for w in waiters if w[0] <= S)
+            wake = elig if op == Op.COND_BROADCAST else elig[:1]
+            for (arr, wtid, m) in wake:
+                waiters.remove((arr, wtid, m))
+                # woken waiter re-acquires its mutex; its grant key is its
+                # effective clock max(clock, wake time S)
+                mutexes[m]["waiters"].append(
+                    (max(tiles[wtid].clock, S), wtid, S))
+                tiles[wtid].blocked = ("mutex", m)
+                grant_mutex(m)
+            # no eligible waiter: the signal is lost
+        elif op == Op.THREAD_SPAWN:
+            pass  # functionally nothing: streams are pre-laid-out
+        elif op == Op.THREAD_JOIN:
+            t.blocked = ("join", aux0)
+            try_unblock(t)
+            return
+        elif op == Op.ENABLE_MODELS:
+            enabled[0] = True
+        elif op == Op.DISABLE_MODELS:
+            enabled[0] = False
+        elif op in (Op.SYSCALL, Op.DVFS_GET):
+            if enabled[0]:
+                t.clock += syscall_rt_ps
+        elif op == Op.DVFS_SET:
+            pass  # fixed-frequency scope (v1)
+        else:
+            raise NotImplementedError(f"golden: op {op}")
+        if advance:
+            t.idx += 1
+
+    # main loop: smallest-clock runnable tile first
+    while True:
+        run = [t for t in tiles if runnable(t)]
+        if not run:
+            # every tile done, or deadlock (mirrors the engine's detector)
+            if all(t.done or t.idx >= batch.length for t in tiles):
+                break
+            stuck = [t.tid for t in tiles if not t.done]
+            raise RuntimeError(f"golden: deadlock, blocked tiles {stuck}")
+        t = min(run, key=lambda x: (x.clock, x.tid))
+        step(t)
+
+    return GoldenResult(
+        clock_ps=np.asarray([t.clock for t in tiles], np.int64),
+        instruction_count=np.asarray(
+            [t.counts["instr"] for t in tiles], np.int64),
+        recv_instructions=np.asarray(
+            [t.counts["recv"] for t in tiles], np.int64),
+        sync_instructions=np.asarray(
+            [t.counts["sync"] for t in tiles], np.int64),
+        bp_correct=np.asarray([t.counts["bp_ok"] for t in tiles], np.int64),
+        bp_incorrect=np.asarray(
+            [t.counts["bp_bad"] for t in tiles], np.int64),
+    )

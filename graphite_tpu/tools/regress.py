@@ -19,42 +19,7 @@ import sys
 import time
 
 
-BASE_CFG = """
-[general]
-total_cores = {tiles}
-mode = lite
-max_frequency = 1.0
-enable_shared_mem = {shared_mem}
-[tile]
-model_list = <{tiles}, {core}>
-[caching_protocol]
-type = {protocol}
-[dram_directory]
-directory_type = {scheme}
-max_hw_sharers = 2
-[network]
-user = {network}
-memory = {network}
-[network/emesh_hop_counter]
-flit_width = 64
-[network/emesh_hop_counter/router]
-delay = 1
-[network/emesh_hop_counter/link]
-delay = 1
-[core/static_instruction_costs]
-generic = 1
-mov = 1
-ialu = 1
-falu = 3
-[branch_predictor]
-type = one_bit
-mispredict_penalty = 14
-size = 1024
-[clock_skew_management]
-scheme = lax_barrier
-[clock_skew_management/lax_barrier]
-quantum = 1000
-"""
+from graphite_tpu.tools._template import config_text
 
 PROTOCOLS = (
     "pr_l1_pr_l2_dram_directory_msi",
@@ -73,9 +38,9 @@ def run_one(tiles, protocol, scheme, network, core, workload):
     from graphite_tpu.trace.benchmarks import BENCHMARKS
 
     shared = workload == "canneal"
-    cfg = ConfigFile.from_string(BASE_CFG.format(
-        tiles=tiles, protocol=protocol, scheme=scheme, network=network,
-        core=core, shared_mem="true" if shared else "false"))
+    cfg = ConfigFile.from_string(config_text(
+        tiles, protocol=protocol, scheme=scheme, network=network,
+        core=core, shared_mem=shared))
     if workload == "canneal":
         batch = BENCHMARKS[workload](tiles, footprint_lines=256,
                                      swaps_per_tile=6)
