@@ -27,8 +27,12 @@ with masked iterations:
    1 GHz — both networks are magic);
  - ENABLE/DISABLE_MODELS: zero cost and no counters while disabled.
 
-Scope (v1): everything except the shared-memory hierarchy and DVFS
-retuning — run with enable_shared_mem=false and a fixed frequency.
+Scope: core timing + sync/messaging as above, plus — when shared memory
+is enabled and the trace touches memory — the full private-L1/L2
+dram-directory hierarchy via `golden.memory_model.GoldenMemory` (an
+independent sequential implementation; see its docstring for the
+ordering discipline and the exact-vs-envelope test contract).  DVFS
+retuning remains out of scope — run with a fixed frequency.
 """
 
 from __future__ import annotations
@@ -61,6 +65,9 @@ class GoldenResult:
     sync_instructions: np.ndarray
     bp_correct: np.ndarray
     bp_incorrect: np.ndarray
+    # per-tile memory-hierarchy counters ({name: np.ndarray[T]}), None
+    # when the run had no memory model
+    mem_counters: dict | None = None
 
 
 class _Net:
@@ -100,7 +107,11 @@ def run_golden(sim_config, batch: TraceBatch,
                syscall_rt_ps: int = 2000) -> GoldenResult:
     cfg = sim_config.cfg
     T = batch.n_tiles
-    freq_mhz = int(cfg.get_float("general/max_frequency", 1.0) * 1000)
+    # per-tile core frequency comes from the CORE DVFS domain, exactly as
+    # the simulator initializes it (`simulator.py` core_freq)
+    from graphite_tpu.models.dvfs import module_freq_mhz
+
+    freq_mhz = int(module_freq_mhz(cfg, "CORE"))
 
     # static cost table
     from graphite_tpu.trace.schema import STATIC_COST_KEYS
@@ -123,6 +134,30 @@ def run_golden(sim_config, batch: TraceBatch,
     bp_size = cfg.get_int("branch_predictor/size", 1024)
     bp_penalty = cfg.get_int("branch_predictor/mispredict_penalty", 14)
     bp_bits = np.zeros((T, bp_size), np.uint8)
+
+    # memory hierarchy (same gating as the engine, `simulator.py`):
+    # enable_shared_mem AND the trace actually touches memory
+    from graphite_tpu.trace.schema import FLAG_MEM0_VALID, FLAG_MEM1_VALID
+
+    has_mem = bool(
+        np.any(batch.flags & (FLAG_MEM0_VALID | FLAG_MEM1_VALID))
+    ) or cfg.get_bool("general/enable_icache_modeling", False)
+    # scope guard: the golden core model is the simple 1-IPC in-order
+    # pipeline; iocoom tiles overlap memory latencies in the scoreboard
+    # (`iocoom_core_model.cc:120-280`) which this oracle does not model
+    for tt in range(T):
+        ct = sim_config.tile_spec(tt).core_type
+        if ct not in ("simple", "magic"):
+            raise NotImplementedError(
+                f"golden oracle models the simple core only; tile {tt} "
+                f"is {ct!r}")
+    mem = None
+    if sim_config.enable_shared_mem and has_mem:
+        from graphite_tpu.golden.memory_model import GoldenMemory
+        from graphite_tpu.memory.params import MemParams
+
+        mem = GoldenMemory(MemParams.from_config(sim_config),
+                           module_freq_mhz(cfg, "CORE"))
 
     tiles = [_Tile(t) for t in range(T)]
     enabled = [True]  # models toggle is GLOBAL (PerformanceCounterManager)
@@ -199,9 +234,20 @@ def run_golden(sim_config, batch: TraceBatch,
                         and other.blocked[1] == t.tid:
                     try_unblock(other)
             return
+        def mem_acc():
+            """Memory latency of this record's slots (0 without a model);
+            data slots mutate cache/directory state even when models are
+            disabled (the icache slot exists only while enabled)."""
+            if mem is None:
+                return 0
+            return mem.access_record(
+                t.tid, op, rec(t, "flags"), rec(t, "pc"),
+                rec(t, "addr0"), rec(t, "addr1"), t.clock, enabled[0])
+
         if op < Op.DYNAMIC_MISC and op != Op.BRANCH:   # static instr
+            acc = mem_acc()
             if enabled[0]:
-                t.clock += cycles_to_ps(costs[op], freq_mhz)
+                t.clock += cycles_to_ps(costs[op], freq_mhz) + acc
                 t.counts["instr"] += 1
         elif op == Op.BRANCH:
             pc = rec(t, "pc") % bp_size
@@ -209,8 +255,9 @@ def run_golden(sim_config, batch: TraceBatch,
             ok = bp_bits[t.tid, pc] == taken
             bp_bits[t.tid, pc] = taken
             cycles = 1 if ok else bp_penalty
+            acc = mem_acc()
             if enabled[0]:
-                t.clock += cycles_to_ps(cycles, freq_mhz)
+                t.clock += cycles_to_ps(cycles, freq_mhz) + acc
                 t.counts["instr"] += 1
                 t.counts["bp_ok" if ok else "bp_bad"] += 1
         elif op < 20:                                   # dynamic
@@ -222,8 +269,9 @@ def run_golden(sim_config, batch: TraceBatch,
                     t.clock += dyn
                     t.counts["instr"] += 1
         elif op == Op.BBLOCK:
+            acc = mem_acc()
             if enabled[0]:
-                t.clock += cycles_to_ps(aux1, freq_mhz)
+                t.clock += cycles_to_ps(aux1, freq_mhz) + acc
                 t.counts["instr"] += aux0
         elif op == Op.SEND:
             lat = net.latency_ps(t.tid, aux0, aux1, enabled[0])
@@ -337,4 +385,7 @@ def run_golden(sim_config, batch: TraceBatch,
         bp_correct=np.asarray([t.counts["bp_ok"] for t in tiles], np.int64),
         bp_incorrect=np.asarray(
             [t.counts["bp_bad"] for t in tiles], np.int64),
+        mem_counters=(
+            {k: np.asarray(v, np.int64) for k, v in mem.counters.items()}
+            if mem is not None else None),
     )

@@ -1,0 +1,645 @@
+"""Sequential golden model of the private-L1/L2 dram-directory protocols.
+
+Independent second implementation of the memory-hierarchy semantics for
+differential testing of `memory/engine.py` (the vectorized MSI/MOSI
+engine).  Written as a classic one-access-at-a-time interpreter over
+plain Python data structures — per-tile caches as lists, the directory as
+dicts of sets — deliberately sharing **no code** with the engine beyond
+`MemParams` (the config-derived geometry/timing constants, which are
+inputs, not the logic under test).
+
+Semantics modeled (reference citations, same as the engine's):
+ - requester path `l1_cache_cntlr.cc:90-180` / `l2_cache_cntlr.cc:181-292`:
+   instruction-buffer fast path, L1 lookup, L2 fill, upgrade-as-refetch,
+   miss request to the home tile;
+ - directory FSM `dram_directory_cntlr.cc:44-559`: immediate grants from
+   UNCACHED/SHARED, INV multicast on EX, FLUSH/WB to the owner on
+   MODIFIED, NULLIFY on directory-set conflict with the original request
+   saved and resumed, per-home same-address completion floor;
+ - sharer service `l2_cache_cntlr.cc:295-503`: INV/FLUSH invalidate
+   L1+L2, WB downgrades (MSI M->S; MOSI M->O keeps the dirty line);
+ - MOSI extras (`pr_l1_pr_l2_dram_directory_mosi/`): O state,
+   cache-to-cache SH fetches, INV_FLUSH_COMBINED data supplier;
+ - all five directory schemes (`directory_schemes/directory_entry_*.cc`):
+   full_map, limited_no_broadcast displacement, ackwise /
+   limited_broadcast sweeps, limitless software-trap penalty;
+ - timing: cache/tag cycles at per-tile frequency, DVFS-domain
+   synchronization delays, directory access cycles, DRAM latency +
+   processing, MEMORY-net zero-load hop + serialization latency.
+
+Ordering discipline: accesses are processed **synchronously** in the
+order the caller (the golden core interpreter) presents them — smallest
+core clock first.  The vectorized engine instead interleaves protocol
+phases across subquantum iterations; the two orderings agree exactly
+whenever concurrent transactions touch disjoint lines (message-carried
+timestamps make disjoint transactions commutative) and may diverge by a
+bounded race window when two tiles race for the same line, an eviction
+races a re-request, or directory-set victims race.  The differential
+tests therefore assert bit-exactness on serialized/disjoint workloads
+and a quantified envelope on racy ones.
+"""
+
+from __future__ import annotations
+
+from graphite_tpu.memory.params import MemParams
+from graphite_tpu.memory.state import (
+    MOD_CORE, MOD_DIR, MOD_L1D, MOD_L1I, MOD_L2, MOD_NET_MEM,
+)
+from graphite_tpu.trace.schema import (
+    FLAG_MEM0_VALID, FLAG_MEM0_WRITE, FLAG_MEM1_VALID, FLAG_MEM1_WRITE, Op,
+)
+
+# cache states (`cache_state.h`) — redeclared, not imported: the oracle
+# must not share logic tables with the engine
+INVALID, SHARED, MODIFIED, EXCLUSIVE, OWNED = 0, 1, 2, 3, 4
+
+DIR_UNCACHED, DIR_SHARED, DIR_MODIFIED, DIR_OWNED = 0, 1, 2, 3
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _cycles_to_ps(cycles: int, freq_mhz: int) -> int:
+    return _ceil_div(cycles * 10**6, freq_mhz)
+
+
+def _readable(st: int) -> bool:
+    return st in (SHARED, MODIFIED, EXCLUSIVE, OWNED)
+
+
+def _writable(st: int) -> bool:
+    return st in (MODIFIED, EXCLUSIVE)
+
+
+class _Cache:
+    """Per-tile set-associative cache mirroring `Cache` semantics
+    (`cache.h:26-135`): modulo set hash, LRU with invalid-way-first
+    victims, invalidate keeps the tag (state-only)."""
+
+    __slots__ = ("sets", "ways", "tags", "state", "lru")
+
+    def __init__(self, num_sets: int, num_ways: int):
+        self.sets = num_sets
+        self.ways = num_ways
+        self.tags = [[-1] * num_ways for _ in range(num_sets)]
+        self.state = [[INVALID] * num_ways for _ in range(num_sets)]
+        self.lru = [list(range(num_ways)) for _ in range(num_sets)]
+
+    def _set(self, line: int) -> int:
+        return line % self.sets
+
+    def lookup(self, line: int):
+        """(hit, way, state) — first matching valid way."""
+        s = self._set(line)
+        for w in range(self.ways):
+            if self.tags[s][w] == line and self.state[s][w] != INVALID:
+                return True, w, self.state[s][w]
+        return False, 0, INVALID
+
+    def touch(self, line: int, way: int) -> None:
+        s = self._set(line)
+        rank = self.lru[s][way]
+        for w in range(self.ways):
+            if self.lru[s][w] < rank:
+                self.lru[s][w] += 1
+        self.lru[s][way] = 0
+
+    def pick_victim(self, line: int):
+        """(way, victim_valid, victim_line, victim_state): first invalid
+        way, else the max-LRU-rank way."""
+        s = self._set(line)
+        for w in range(self.ways):
+            if self.state[s][w] == INVALID:
+                return w, False, self.tags[s][w], INVALID
+        w = max(range(self.ways), key=lambda x: self.lru[s][x])
+        return w, True, self.tags[s][w], self.state[s][w]
+
+    def insert_at(self, line: int, way: int, st: int) -> None:
+        s = self._set(line)
+        self.tags[s][way] = line
+        self.state[s][way] = st
+        self.touch(line, way)
+
+    def set_state(self, line: int, way: int, st: int) -> None:
+        self.state[self._set(line)][way] = st
+
+    def invalidate(self, line: int) -> None:
+        hit, way, _ = self.lookup(line)
+        if hit:
+            self.set_state(line, way, INVALID)
+
+
+class _DirEntry:
+    __slots__ = ("tag", "dstate", "owner", "sharers")
+
+    def __init__(self):
+        self.tag = -1
+        self.dstate = DIR_UNCACHED
+        self.owner = -1
+        self.sharers: set[int] = set()
+
+
+class _Home:
+    """One home tile's directory slice + serialization bookkeeping."""
+
+    __slots__ = ("entries", "last_line", "last_done_ps",
+                 "cdata_line", "cdata_valid")
+
+    def __init__(self, dir_sets: int, dir_ways: int):
+        self.entries = [[_DirEntry() for _ in range(dir_ways)]
+                        for _ in range(dir_sets)]
+        self.last_line = -1
+        self.last_done_ps = 0
+        self.cdata_line = -1
+        self.cdata_valid = False
+
+
+class GoldenMemory:
+    """Callable memory hierarchy for the golden interpreter.
+
+    `access_record(tile, op, flags, pc, addr0, addr1, clock_ps, enabled)`
+    processes every memory slot of one trace record (icache fetch, mem
+    operand 0, mem operand 1 — `fillNumMemoryOperands`,
+    `pin/instruction_modeling.cc:33-124`) and returns the record's total
+    memory latency in ps, mutating global cache/directory state.
+    """
+
+    def __init__(self, mp: MemParams, freq_mhz):
+        self.mp = mp
+        T = mp.n_tiles
+        self.freq = [int(f) for f in freq_mhz] if hasattr(
+            freq_mhz, "__len__") else [int(freq_mhz)] * T
+        self.l1i = [_Cache(mp.l1i.num_sets, mp.l1i.num_ways)
+                    for _ in range(T)]
+        self.l1d = [_Cache(mp.l1d.num_sets, mp.l1d.num_ways)
+                    for _ in range(T)]
+        self.l2 = [_Cache(mp.l2.num_sets, mp.l2.num_ways) for _ in range(T)]
+        # which L1 caches each L2 entry ((set, way) -> MOD_L1I/MOD_L1D/0)
+        self.l2_cloc = [dict() for _ in range(T)]
+        self.homes = {h: _Home(mp.dir_sets, mp.dir_ways)
+                      for h in mp.mc_tiles}
+        self.instr_buf = [-1] * T
+        self.counters = {
+            k: [0] * T
+            for k in ("l1i_hits", "l1i_misses", "l1d_read_hits",
+                      "l1d_read_misses", "l1d_write_hits",
+                      "l1d_write_misses", "l2_hits", "l2_misses",
+                      "evictions", "invalidations", "dir_accesses",
+                      "dir_broadcasts", "dram_reads", "dram_writes",
+                      "dram_total_lat_ps")
+        }
+
+    # -- timing helpers ----------------------------------------------------
+
+    def _cc(self, t: int, n: int, enabled: bool) -> int:
+        return _cycles_to_ps(n, self.freq[t]) if enabled else 0
+
+    def _dir_ps(self, n: int, enabled: bool) -> int:
+        return _cycles_to_ps(n, self.mp.dir_freq_mhz) if enabled else 0
+
+    def _net_ps(self, src: int, dst: int, bits: int, enabled: bool) -> int:
+        mp = self.mp
+        if mp.net_kind == "magic":
+            return _cycles_to_ps(1, mp.net_freq_mhz) if enabled else 0
+        w = mp.mesh_width
+        hops = abs(src % w - dst % w) + abs(src // w - dst // w)
+        cycles = hops * mp.hop_latency_cycles
+        if src != dst:
+            cycles += _ceil_div(bits, mp.flit_width_bits)
+        return _cycles_to_ps(cycles, mp.net_freq_mhz) if enabled else 0
+
+    def _dram_ps(self, enabled: bool) -> int:
+        mp = self.mp
+        return ((mp.dram_latency_ns + mp.dram_processing_ns) * 1000
+                if enabled else 0)
+
+    def _sync(self, t: int, a: int, b: int, enabled: bool) -> int:
+        return self._cc(t, self.mp.sync_cycles(a, b), enabled)
+
+    def _dsync(self, a: int, b: int, enabled: bool) -> int:
+        return self._dir_ps(self.mp.sync_cycles(a, b), enabled)
+
+    def _home_of(self, line: int) -> int:
+        return self.mp.mc_tiles[line % len(self.mp.mc_tiles)]
+
+    # -- eviction messages (`l2_cache_cntlr.cc:75-116 insertCacheLine` ->
+    #    `processInvRepFromL2Cache`/`processFlushRep...` eviction branches)
+
+    def _apply_eviction(self, src: int, line: int, is_flush: bool,
+                        etime: int, enabled: bool) -> None:
+        home = self._home_of(line)
+        hm = self.homes[home]
+        if enabled:
+            self.counters["evictions"][home] += 1
+            if is_flush:
+                self.counters["dram_writes"][home] += 1
+        if is_flush:
+            # park the flushed line in the home's one-entry data buffer
+            # (`_cached_data_list`): a later request skips the DRAM read
+            hm.cdata_line = line
+            hm.cdata_valid = True
+        e = self._dir_find(hm, line)
+        if e is None:
+            return
+        e.sharers.discard(src)
+        if is_flush:
+            e.owner = -1
+        if not e.sharers:
+            e.dstate = DIR_UNCACHED
+        elif is_flush:
+            e.dstate = DIR_SHARED  # MOSI O departure leaves clean sharers
+
+    def _dir_find(self, hm: _Home, line: int):
+        row = hm.entries[line % self.mp.dir_sets]
+        for e in row:
+            if e.tag == line:
+                return e
+        return None
+
+    # -- sharer-side FWD service (`l2_cache_cntlr.cc:295-503`) -------------
+
+    def _serve_fwd(self, s: int, kind: str, line: int, ftime: int,
+                   home: int, enabled: bool):
+        """Serve one INV/FLUSH/WB request at sharer `s`; returns
+        (ack_time, supplies_data)."""
+        mp = self.mp
+        hit, way, st = self.l2[s].lookup(line)
+        assert hit, (
+            f"golden: FWD {kind} to tile {s} for line {line:#x} not held "
+            "(directory/cache divergence)")
+        l2_cost = self._cc(
+            s, mp.l2.tags_cycles if kind == "inv"
+            else mp.l2.data_and_tags_cycles, enabled)
+        done = (ftime + self._sync(s, MOD_L2, MOD_NET_MEM, enabled) + l2_cost
+                + self._cc(s, mp.l1d.tags_cycles, enabled)
+                + 2 * self._sync(s, MOD_L1D, MOD_L2, enabled))
+        cloc = self.l2_cloc[s].get((line % mp.l2.num_sets, way), 0)
+        if kind in ("inv", "flush"):
+            if cloc == MOD_L1I:
+                self.l1i[s].invalidate(line)
+            elif cloc == MOD_L1D:
+                self.l1d[s].invalidate(line)
+            self.l2[s].set_state(line, way, INVALID)
+            self.l2_cloc[s].pop((line % mp.l2.num_sets, way), None)
+            if enabled and kind == "inv":
+                self.counters["invalidations"][s] += 1
+        else:  # wb: downgrade, keep the line
+            if mp.is_mosi:
+                wb_state = OWNED if st == MODIFIED else st
+            else:
+                wb_state = SHARED
+            l1 = (self.l1i[s] if cloc == MOD_L1I
+                  else self.l1d[s] if cloc == MOD_L1D else None)
+            if l1 is not None:
+                l1_hit, l1_way, _ = l1.lookup(line)
+                if l1_hit:
+                    l1.set_state(line, l1_way, wb_state)
+            self.l2[s].set_state(line, way, wb_state)
+        ack_bits = mp.req_bits if kind == "inv" else mp.rep_bits
+        supplies = kind in ("flush", "wb")
+        return done + self._net_ps(s, home, ack_bits, enabled), supplies
+
+    # -- the directory transaction (`dram_directory_cntlr.cc:44-559`) ------
+
+    def _home_txn(self, home: int, requester: int, line: int,
+                  is_write: bool, arrival: int, enabled: bool,
+                  _resumed: bool = False):
+        """Run one EX/SH request at `home`; returns the reply arrival time
+        at the requester."""
+        mp = self.mp
+        hm = self.homes[home]
+        if _resumed:
+            rtime = arrival  # saved request: message sync already charged
+        else:
+            rtime = arrival + (
+                self._dsync(MOD_DIR, MOD_L2, enabled) if requester == home
+                else self._dsync(MOD_DIR, MOD_NET_MEM, enabled))
+        if line == hm.last_line:
+            rtime = max(rtime, hm.last_done_ps)
+        if enabled:
+            self.counters["dir_accesses"][home] += 1
+
+        # entry lookup / allocation (`processDirectoryEntryAllocationReq`)
+        row = hm.entries[line % mp.dir_sets]
+        entry = self._dir_find(hm, line)
+        if entry is None:
+            entry = next((e for e in row if e.tag == -1), None)
+            if entry is None:
+                # victim: min sharer count, first way on ties
+                entry = min(row, key=lambda e: len(e.sharers))
+                victim_live = entry.dstate != DIR_UNCACHED
+                v_line, v_state = entry.tag, entry.dstate
+                v_owner, v_sharers = entry.owner, set(entry.sharers)
+                # install the new entry immediately (`replaceDirectoryEntry`)
+                entry.tag, entry.dstate = line, DIR_UNCACHED
+                entry.owner, entry.sharers = -1, set()
+                if victim_live:
+                    # NULLIFY the victim line, then resume the original
+                    # request; the resumed request's time does NOT wait on
+                    # the nullify (message-carried clocks; only the floor
+                    # and dir state couple them)
+                    self._run_protocol(
+                        home, hm, requester, v_line, "nullify", rtime,
+                        v_state, v_owner, v_sharers, None, enabled)
+                    return self._home_txn(home, requester, line, is_write,
+                                          rtime, enabled, _resumed=True)
+            else:
+                entry.tag, entry.dstate = line, DIR_UNCACHED
+                entry.owner, entry.sharers = -1, set()
+        return self._run_protocol(
+            home, hm, requester, line, "ex" if is_write else "sh", rtime,
+            entry.dstate, entry.owner, set(entry.sharers), entry, enabled)
+
+    def _run_protocol(self, home, hm: _Home, requester, line, mtype, rtime,
+                      dstate, owner, sharers, entry, enabled):
+        """The per-state FSM for one EX/SH/NULLIFY transaction."""
+        mp = self.mp
+        eff_time = rtime + self._dir_ps(mp.dir_access_cycles, enabled)
+        is_ex = mtype == "ex"
+        is_sh = mtype == "sh"
+        is_nullify = mtype == "nullify"
+        uncached = dstate == DIR_UNCACHED
+        shared = dstate == DIR_SHARED
+        modified = dstate == DIR_MODIFIED
+        owned = dstate == DIR_OWNED
+        k = mp.max_hw_sharers
+        already = requester in sharers
+
+        sh_over = sh_over_m = False
+        if mp.dir_type == "limited_no_broadcast":
+            sh_over = (is_sh and (shared or owned) and len(sharers) >= k
+                       and not already)
+            sh_over_m = (is_sh and modified and len(sharers) >= k
+                         and not already)
+        if mp.dir_type == "limitless" and entry is not None and enabled:
+            sw_mode = (len(sharers) > k) or (
+                is_sh and not already and len(sharers) >= k
+                and (shared or owned))
+            if sw_mode:
+                eff_time += self._dir_ps(mp.limitless_trap_cycles, True)
+
+        # (a) immediate grants (UNCACHED; MSI also SHARED+SH from DRAM)
+        if mp.is_mosi:
+            imm = (is_ex and uncached) or (is_sh and uncached)
+        else:
+            imm = (is_ex and uncached) or (
+                is_sh and (uncached or shared) and not sh_over)
+        if imm:
+            if is_ex:
+                entry.dstate = DIR_MODIFIED
+                entry.owner = requester
+                entry.sharers = {requester}
+            else:
+                entry.dstate = DIR_SHARED
+                entry.owner = -1
+                if not shared:
+                    entry.sharers = set()
+                entry.sharers.add(requester)
+            cdata_hit = hm.cdata_valid and hm.cdata_line == line
+            rep_ready = eff_time + (0 if cdata_hit else self._dram_ps(enabled))
+            if cdata_hit:
+                hm.cdata_valid = False
+            elif enabled:
+                self.counters["dram_reads"][home] += 1
+                self.counters["dram_total_lat_ps"][home] += \
+                    self._dram_ps(True)
+            hm.last_line, hm.last_done_ps = line, rep_ready
+            return rep_ready + self._net_ps(home, requester, mp.rep_bits,
+                                            enabled)
+
+        # (b) fan-out: build the (target -> message kind) map
+        if mp.is_mosi:
+            fan_inv = (is_ex or is_nullify) and (shared or owned)
+            sh_fetch = is_sh and (shared or owned) and not sh_over
+        else:
+            fan_inv = (is_ex or is_nullify) and shared
+            sh_fetch = False
+        fan_owner = modified
+        targets: dict[int, str] = {}
+        if fan_inv:
+            for s in sharers:
+                targets[s] = "inv"
+            if mp.is_mosi and (owned or (is_ex and shared)):
+                # one sweep target supplies the data (`INV_FLUSH_COMBINED`)
+                pick = owner if (owned and owner >= 0) else (
+                    min(sharers) if sharers else -1)
+                if pick >= 0:
+                    targets[pick] = "flush"
+        elif sh_fetch:
+            src = owner if (owned and owner >= 0) else (
+                min(sharers) if sharers else -1)
+            if src >= 0:
+                targets[src] = "wb"
+        elif fan_owner:
+            targets[owner] = "wb" if is_sh else "flush"
+
+        if sh_over:
+            # displacement: invalidate the lowest non-owner sharer (or
+            # flush the owner when it is the only sharer) so the requester
+            # fits in the hardware sharer list
+            non_owner = sorted(s for s in sharers
+                               if not (owned and s == owner))
+            victim_is_owner = not non_owner
+            victim = non_owner[0] if non_owner else owner
+            entry.sharers.discard(victim)
+            if victim_is_owner:
+                entry.owner = -1
+                entry.dstate = DIR_SHARED
+            targets = {victim: "inv"}
+            if mp.is_mosi and (shared or victim_is_owner):
+                targets[victim] = "flush"
+            if owned and not victim_is_owner and owner >= 0:
+                targets[owner] = "wb"
+        if sh_over_m:
+            # M entry at capacity: FLUSH the owner, entry empties before
+            # the SH finish installs {requester} alone
+            targets = {owner: "flush"}
+            entry.dstate = DIR_UNCACHED
+            entry.owner = -1
+            entry.sharers = set()
+            modified = False
+
+        if (mp.dir_type in ("ackwise", "limited_broadcast") and fan_inv
+                and len(sharers) > k and enabled):
+            self.counters["dir_broadcasts"][home] += 1
+
+        # serve each forwarded request; acks gate the finish
+        txn_time = eff_time
+        got_data = False
+        dir_acc = self._dir_ps(mp.dir_access_cycles, enabled)
+        for s in sorted(targets):
+            f_arrive = eff_time + self._net_ps(home, s, mp.req_bits, enabled)
+            ack_time, supplies = self._serve_fwd(
+                s, targets[s], line, f_arrive, home, enabled)
+            txn_time = max(txn_time, ack_time + dir_acc)
+            got_data = got_data or supplies
+            if targets[s] == "wb" and not mp.is_mosi and enabled:
+                # MSI writes WB data through to DRAM (entry turns clean)
+                self.counters["dram_writes"][home] += 1
+            if targets[s] in ("inv", "flush") and entry is not None:
+                entry.sharers.discard(s)
+                if s == entry.owner:
+                    entry.owner = -1
+
+        # finish: directory end-state + reply
+        if entry is not None and not is_nullify:
+            if is_ex:
+                entry.dstate = DIR_MODIFIED
+                entry.owner = requester
+                entry.sharers = {requester}
+            else:
+                from_dirty = mp.is_mosi and (modified or owned)
+                entry.dstate = DIR_OWNED if from_dirty else DIR_SHARED
+                if not from_dirty:
+                    entry.owner = -1
+                entry.sharers.add(requester)
+        cdata_hit = hm.cdata_valid and hm.cdata_line == line
+        need_dram = not (got_data or cdata_hit) and not is_nullify
+        if cdata_hit:
+            hm.cdata_valid = False
+        rep_ready = txn_time + (self._dram_ps(enabled) if need_dram else 0)
+        if need_dram and enabled:
+            self.counters["dram_reads"][home] += 1
+            self.counters["dram_total_lat_ps"][home] += self._dram_ps(True)
+        hm.last_line, hm.last_done_ps = line, rep_ready
+        if is_nullify:
+            return None
+        return rep_ready + self._net_ps(home, requester, mp.rep_bits,
+                                        enabled)
+
+    # -- requester slot (`l1_cache_cntlr.cc:90-180` + reply fill) ----------
+
+    def _slot(self, t: int, is_icache: bool, addr: int, write: bool,
+              clock_ps: int, enabled: bool) -> int:
+        mp = self.mp
+        line = (addr & 0xFFFFFFFF) >> mp.line_bits
+        comp = MOD_L1I if is_icache else MOD_L1D
+        l1 = self.l1i[t] if is_icache else self.l1d[t]
+        lp = mp.l1i if is_icache else mp.l1d
+        c = self.counters
+
+        # instruction-buffer fast path (`core.cc:205-220`)
+        if is_icache:
+            ibuf_hit = line == self.instr_buf[t]
+            self.instr_buf[t] = line
+            if ibuf_hit:
+                if enabled:
+                    c["l1i_hits"][t] += 1
+                return self._cc(t, 1, enabled)
+
+        sclock = clock_ps + self._sync(t, MOD_CORE, comp, enabled)
+        l1_dat = self._cc(t, lp.data_and_tags_cycles, enabled)
+        l1_tag = self._cc(t, lp.tags_cycles, enabled)
+
+        hit, way, st = l1.lookup(line)
+        if hit and (_writable(st) if write else _readable(st)):
+            l1.touch(line, way)
+            if enabled:
+                if is_icache:
+                    c["l1i_hits"][t] += 1
+                elif write:
+                    c["l1d_write_hits"][t] += 1
+                else:
+                    c["l1d_read_hits"][t] += 1
+            return sclock + l1_dat - clock_ps
+
+        # L1 miss: invalidate the stale L1 line, try L2
+        l1.invalidate(line)
+        if enabled:
+            if is_icache:
+                c["l1i_misses"][t] += 1
+            elif write:
+                c["l1d_write_misses"][t] += 1
+            else:
+                c["l1d_read_misses"][t] += 1
+
+        l2 = self.l2[t]
+        l2_hit, l2_way, l2_st = l2.lookup(line)
+        if l2_hit and (_writable(l2_st) if write else _readable(l2_st)):
+            if enabled:
+                c["l2_hits"][t] += 1
+            done = (sclock + l1_tag + self._sync(t, comp, MOD_L2, enabled)
+                    + self._cc(t, mp.l2.data_and_tags_cycles, enabled)
+                    + l1_dat)
+            self._fill_l1(t, is_icache, line, l2_st, l2_way)
+            l2.touch(line, l2_way)
+            return done - clock_ps
+
+        if enabled:
+            c["l2_misses"][t] += 1
+        req_send = sclock + l1_tag + self._cc(t, mp.l2.tags_cycles, enabled)
+        home = self._home_of(line)
+
+        # upgrade: write to a readable-but-unwritable L2 line — invalidate
+        # + eviction to the home, then a full EX refetch
+        # (`processExReqFromL1Cache`; documented engine simplification)
+        if l2_hit and write and l2_st in (SHARED, OWNED):
+            dirty = l2_st == OWNED
+            l2.set_state(line, l2_way, INVALID)
+            self.l2_cloc[t].pop((line % mp.l2.num_sets, l2_way), None)
+            self._apply_eviction(
+                t, line, dirty,
+                req_send + self._net_ps(t, home, mp.req_bits, enabled),
+                enabled)
+
+        arrival = req_send + self._net_ps(t, home, mp.req_bits, enabled)
+        rep_time = self._home_txn(home, t, line, write, arrival, enabled)
+
+        # reply fill (`handleMsgFromDramDirectory` + insertCacheLine)
+        new_state = MODIFIED if write else SHARED
+        fill_l2 = (rep_time + self._sync(t, MOD_L2, MOD_NET_MEM, enabled)
+                   + self._cc(t, mp.l2.data_and_tags_cycles, enabled))
+        v_way, v_valid, v_line, v_state = l2.pick_victim(line)
+        if v_valid:
+            if enabled:
+                c["evictions"][t] += 1
+            v_dirty = v_state in (MODIFIED, OWNED)
+            v_home = self._home_of(v_line)
+            e_lat = self._net_ps(
+                t, v_home, mp.rep_bits if v_dirty else mp.req_bits, enabled)
+            self.l2_cloc[t].pop((v_line % mp.l2.num_sets, v_way), None)
+            self._apply_eviction(t, v_line, v_dirty, fill_l2 + e_lat,
+                                 enabled)
+        l2.insert_at(line, v_way, new_state)
+        self._fill_l1(t, is_icache, line, new_state, v_way)
+        done = fill_l2 + l1_dat
+        return done - clock_ps
+
+    def _fill_l1(self, t: int, is_icache: bool, line: int, st: int,
+                 l2_way: int) -> None:
+        """Insert into the right L1 (`insertCacheLineInL1`), tracking the
+        L2 entry's cached-location byte and clearing the L1 victim's."""
+        mp = self.mp
+        l1 = self.l1i[t] if is_icache else self.l1d[t]
+        way, v_valid, v_line, _ = l1.pick_victim(line)
+        if v_valid:
+            vh, vw, _ = self.l2[t].lookup(v_line)
+            if vh:
+                self.l2_cloc[t].pop((v_line % mp.l2.num_sets, vw), None)
+        l1.insert_at(line, way, st)
+        self.l2_cloc[t][(line % mp.l2.num_sets, l2_way)] = (
+            MOD_L1I if is_icache else MOD_L1D)
+
+    # -- public entry ------------------------------------------------------
+
+    def access_record(self, t: int, op: int, flags: int, pc: int,
+                      addr0: int, addr1: int, clock_ps: int,
+                      enabled: bool) -> int:
+        """Total memory latency (ps) of one record's slots; every slot's
+        latency is measured from the record's base clock (the per-operand
+        costs land on the clock together, `simple_core_model.cc:53-90`)."""
+        mp = self.mp
+        acc = 0
+        is_instr = op < 15 or op == int(Op.BBLOCK)
+        if mp.icache_modeling and enabled and is_instr:
+            acc += self._slot(t, True, pc, False, clock_ps, enabled)
+        if flags & FLAG_MEM0_VALID:
+            acc += self._slot(t, False, addr0,
+                              bool(flags & FLAG_MEM0_WRITE), clock_ps,
+                              enabled)
+        if flags & FLAG_MEM1_VALID:
+            acc += self._slot(t, False, addr1,
+                              bool(flags & FLAG_MEM1_WRITE), clock_ps,
+                              enabled)
+        return acc

@@ -401,6 +401,12 @@ def memory_engine_step(
     l1i_upd = ca.touch_lru(ms.l1i, s_line, l1i_way, l1_hit_now & s_comp_l1i)
     l1d_upd = ca.touch_lru(ms.l1d, s_line, l1d_way, l1_hit_now & ~s_comp_l1i)
 
+    # L1 line invalidated on miss before L2 is consulted
+    # (`l1_cache_cntlr.cc:137`) — must precede the L2-hit fill below, so
+    # the fill lands in the just-freed way and survives
+    l1i_upd = ca.invalidate(l1i_upd, s_line, l1_miss & s_comp_l1i)
+    l1d_upd = ca.invalidate(l1d_upd, s_line, l1_miss & ~s_comp_l1i)
+
     # --- apply the L2-hit path (fill L1 from L2) -------------------------
     # timing: L1 tags (miss) + L2 sync + L2 data+tags + L1 data+tags
     l2_hit_done_ps = sclock + l1_tag + sync_l1_l2 + ccycles(
@@ -434,9 +440,6 @@ def memory_engine_step(
     # `processExReqFromL1Cache`/`processShReqFromL1Cache`: request time =
     # entry sync + L1 tags + L2 tags
     req_send_ps = sclock + l1_tag + ccycles(mp.l2.tags_cycles)
-    # L1 line invalidated on miss before going to L2 (`l1_cache_cntlr.cc:137`)
-    l1i_upd = ca.invalidate(l1i_upd, s_line, l1_miss & s_comp_l1i)
-    l1d_upd = ca.invalidate(l1d_upd, s_line, l1_miss & ~s_comp_l1i)
     # upgrade: invalidate L2 + eviction message (INV_REP clean, FLUSH_REP
     # for a dirty OWNED line)
     l2_upd = ca.invalidate(l2_upd, s_line, upgrade & ~stall_start)

@@ -1,0 +1,251 @@
+"""Differential testing of the memory hierarchy: vectorized MSI/MOSI
+engine vs the sequential golden model (`golden/memory_model.py`).
+
+Contract (see the golden model's ordering-discipline docstring):
+ - bit-exact on serialized or line-disjoint workloads — clocks AND all
+   memory counters (the message-carried-timestamp algebra makes disjoint
+   transactions commutative, so iteration order cannot matter);
+ - a quantified envelope on free-running racy workloads, where the
+   engine's iteration interleaving and the oracle's clock ordering may
+   resolve same-line races differently (BASELINE's <=2% divergence
+   budget applied per tile).
+
+Reference semantics under test: `l1_cache_cntlr.cc:90-180`,
+`l2_cache_cntlr.cc:181-503`, `dram_directory_cntlr.cc:44-559`,
+`directory_schemes/directory_entry_*.cc`.
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine.simulator import Simulator
+from graphite_tpu.golden import run_golden
+from graphite_tpu.trace import synthetic
+from graphite_tpu.trace.schema import TraceBatch, TraceBuilder
+
+MSI = "pr_l1_pr_l2_dram_directory_msi"
+MOSI = "pr_l1_pr_l2_dram_directory_mosi"
+
+
+def make_config(n_tiles, proto=MSI, net="magic", extra=""):
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = true
+[network]
+user = magic
+memory = {net}
+[network/emesh_hop_counter]
+flit_width = 64
+[network/emesh_hop_counter/router]
+delay = 1
+[network/emesh_hop_counter/link]
+delay = 1
+[caching_protocol]
+type = {proto}
+[core/static_instruction_costs]
+mov = 1
+ialu = 1
+{extra}
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+def assert_exact(sc, batch):
+    res = Simulator(sc, batch).run()
+    gold = run_golden(sc, batch)
+    np.testing.assert_array_equal(res.clock_ps, gold.clock_ps,
+                                  err_msg="clock")
+    for k, g in gold.mem_counters.items():
+        np.testing.assert_array_equal(np.asarray(res.mem_counters[k]), g,
+                                      err_msg=k)
+    return res, gold
+
+
+# ---- workload builders ----------------------------------------------------
+
+
+def mutex_rmw(n, rounds, base=0x900000, lines=1):
+    """Mutex-serialized read-modify-write of shared lines: at any moment
+    exactly one tile touches the shared data, so engine iteration order
+    and oracle clock order coincide."""
+    bs = [TraceBuilder() for _ in range(n)]
+    bs[0].mutex_init(0)
+    bs[0].barrier_init(9, n)
+    for b in bs:
+        b.barrier_wait(9)
+    for r in range(n * rounds):
+        t = r % n
+        addr = base + (r % lines) * 64
+        bs[t].mutex_lock(0)
+        bs[t].load(addr, 8)
+        bs[t].store(addr, 8)
+        bs[t].mutex_unlock(0)
+    return TraceBatch.from_builders(bs)
+
+
+def share_then_write(n, lines=4, rounds=2, base=0xA00000):
+    """Readers build up a sharer list (serialized), then one writer
+    triggers the INV multicast — exercises fan-out + scheme variants."""
+    bs = [TraceBuilder() for _ in range(n)]
+    bs[0].mutex_init(0)
+    bs[0].barrier_init(9, n)
+    for b in bs:
+        b.barrier_wait(9)
+    for r in range(rounds):
+        for li in range(lines):
+            addr = base + li * 64
+            for t in range(1, n):
+                bs[t].mutex_lock(0)
+                bs[t].load(addr, 8)
+                bs[t].mutex_unlock(0)
+            for b in bs:
+                b.barrier_wait(9)
+            bs[0].mutex_lock(0)
+            bs[0].store(addr, 8)
+            bs[0].mutex_unlock(0)
+            for b in bs:
+                b.barrier_wait(9)
+    return TraceBatch.from_builders(bs)
+
+
+def wb_pattern(rounds=6, base=0xB00000):
+    """Alternating writer/reader on one line: SH on MODIFIED (the WB
+    downgrade path; MSI M->S write-through, MOSI M->O c2c)."""
+    bs = [TraceBuilder() for _ in range(2)]
+    bs[0].mutex_init(0)
+    bs[0].barrier_init(9, 2)
+    for b in bs:
+        b.barrier_wait(9)
+    for r in range(rounds):
+        bs[0].mutex_lock(0)
+        bs[0].store(base, 8)
+        bs[0].mutex_unlock(0)
+        for b in bs:
+            b.barrier_wait(9)
+        bs[1].mutex_lock(0)
+        bs[1].load(base, 8)
+        bs[1].mutex_unlock(0)
+        for b in bs:
+            b.barrier_wait(9)
+    return TraceBatch.from_builders(bs)
+
+
+def line_stream(n_lines, base=0x100000, write_first=True):
+    """Single tile streaming writes then reads over many lines — directory
+    set conflicts (NULLIFY) and L2 evictions with a tiny directory."""
+    b = TraceBuilder()
+    for i in range(n_lines):
+        (b.store if write_first else b.load)(base + i * 64, 8)
+    for i in range(n_lines):
+        b.load(base + i * 64, 8)
+    return TraceBatch.from_builders([b])
+
+
+# ---- bit-exact tests ------------------------------------------------------
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_single_tile_random(proto):
+    sc = make_config(1, proto)
+    batch = synthetic.memory_stress_trace(
+        1, n_accesses=300, working_set_bytes=1 << 16, seed=3)
+    assert_exact(sc, batch)
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_disjoint_working_sets(proto):
+    sc = make_config(4, proto)
+    batch = synthetic.memory_stress_trace(
+        4, n_accesses=150, working_set_bytes=1 << 15, seed=5)
+    assert_exact(sc, batch)
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_mutex_serialized_sharing(proto):
+    res, gold = assert_exact(make_config(4, proto), mutex_rmw(4, 6))
+    if proto == MSI:
+        # MSI: the EX after a read-share INVs the old sharer.  MOSI
+        # instead FLUSHes the owner (data travels with the invalidation),
+        # which the invalidations counter deliberately excludes.
+        assert gold.mem_counters["invalidations"].sum() > 0
+    assert gold.mem_counters["l2_misses"].sum() > 0
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_wb_downgrade(proto):
+    res, gold = assert_exact(make_config(2, proto), wb_pattern())
+    if proto == MSI:
+        # MSI writes WB data through to DRAM
+        assert gold.mem_counters["dram_writes"].sum() > 0
+
+
+@pytest.mark.parametrize("scheme", [
+    "full_map", "limited_no_broadcast", "ackwise", "limited_broadcast",
+    "limitless"])
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_directory_scheme(scheme, proto):
+    extra = (f"[dram_directory]\ndirectory_type = {scheme}\n"
+             "max_hw_sharers = 2\n[limitless]\n"
+             "software_trap_penalty = 200\n")
+    res, gold = assert_exact(make_config(4, proto, extra=extra),
+                             share_then_write(4))
+    if scheme in ("ackwise", "limited_broadcast"):
+        assert gold.mem_counters["dir_broadcasts"].sum() > 0
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_nullify_tiny_directory(proto):
+    extra = "[dram_directory]\ntotal_entries = 16\nassociativity = 2\n"
+    res, gold = assert_exact(make_config(1, proto, extra=extra),
+                             line_stream(64))
+    # 64 lines through 8 sets x 2 ways must have displaced entries
+    assert gold.mem_counters["dir_accesses"].sum() > 64
+
+
+def test_hop_counter_memory_net():
+    assert_exact(make_config(4, MSI, net="emesh_hop_counter"),
+                 mutex_rmw(4, 5))
+
+
+def test_icache_modeling():
+    extra = "enable_icache_modeling = true\n"
+    sc = make_config(
+        1, MSI, extra=f"[general]\n{extra}")
+    from graphite_tpu.trace.schema import Op
+
+    b = TraceBuilder()
+    for i in range(200):
+        b.instr(Op.IALU, pc=0x4000 + (i % 40) * 64)
+    res, gold = assert_exact(sc, TraceBatch.from_builders([b]))
+    assert gold.mem_counters["l1i_hits"].sum() > 0
+
+
+# ---- envelope test on a racy workload -------------------------------------
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_racy_shared_envelope(proto):
+    """Free-running tiles with a 30% shared-line mix: same-line races may
+    resolve in different orders between the engine and the oracle; per
+    BASELINE the per-tile completion clocks must agree within 2%."""
+    sc = make_config(4, proto)
+    batch = synthetic.memory_stress_trace(
+        4, n_accesses=200, working_set_bytes=1 << 14,
+        shared_fraction=0.3, seed=11)
+    res = Simulator(sc, batch).run()
+    gold = run_golden(sc, batch)
+    rel = np.abs(res.clock_ps.astype(float) - gold.clock_ps.astype(float))
+    rel = rel / np.maximum(gold.clock_ps.astype(float), 1.0)
+    assert rel.max() <= 0.02, (
+        f"clock divergence {rel.max():.4f} exceeds 2% envelope: "
+        f"engine={res.clock_ps.tolist()} golden={gold.clock_ps.tolist()}")
+    # functional + conservation invariants stay exact
+    for k in ("l2_misses", "dram_reads", "dram_writes"):
+        e = int(np.asarray(res.mem_counters[k]).sum())
+        g = int(gold.mem_counters[k].sum())
+        assert abs(e - g) <= max(2, 0.02 * max(e, g)), (
+            f"{k}: engine {e} vs golden {g}")
