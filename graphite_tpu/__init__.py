@@ -9,15 +9,18 @@ every tile advances one lax-barrier quantum per compiled XLA step.
 
 Layer map (mirrors SURVEY.md §1, reference layers L0–L7):
 
-    frontend/   trace producers (the Pin-frontend analog: synthetic + capture)
+    frontend/   the user-API surface (carbon_api live recording — the
+                routine-replacement analog); trace/ holds the producers
+    trace/      record schema, synthetic generators, benchmark skeletons
     config/     carbon_sim.cfg-compatible config + target-topology math
-    models/     core timing, cache/coherence, NoC, DRAM, branch predictors
-    ops/        vectorized primitives those models share (caches, queues, mailboxes)
+    models/     core timing (simple/iocoom), NoC models, DVFS, queue models
+    memory/     cache arrays + coherence protocol engines (MSI/MOSI/shL2)
     engine/     the quantum-step state machine + Simulator orchestration
-    parallel/   device-mesh sharding (pjit/shard_map/ppermute over ICI)
-    power/      McPAT/DSENT-equivalent energy-area models fed by event counters
-    stats/      sim.out-style summary + statistics traces
-    utils/      logging, misc helpers
+    golden/     sequential differential oracles (core + memory hierarchy)
+    parallel/   device-mesh sharding (pjit/shard_map over ICI)
+    power/      McPAT/DSENT-equivalent energy models fed by event counters
+    system/     host-side MCP analogs: threads, syscalls, stats, checkpoint
+    tools/      drivers (graduated runner, regress sweep, output parsing)
 
 Simulated time is exact integer picoseconds throughout
 (reference: `common/misc/time_types.h:31-78`), so the package enables

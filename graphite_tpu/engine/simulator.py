@@ -126,6 +126,20 @@ class SimResults:
         return "\n".join(out)
 
 
+def _mem_state_bytes(mp) -> int:
+    """Rough HBM footprint of the protocol state: directory (dominant),
+    cache meta words, and the [T, T] mailbox matrices."""
+    T = mp.n_tiles
+    dir_entry = mp.sharer_words * 4 + 13
+    dir_bytes = T * mp.dir_sets * mp.dir_ways * dir_entry
+    cache_bytes = 8 * T * (
+        mp.l1i.num_sets * mp.l1i.num_ways
+        + mp.l1d.num_sets * mp.l1d.num_ways
+        + 2 * mp.l2.num_sets * mp.l2.num_ways)
+    mail_bytes = 4 * T * T * 13
+    return dir_bytes + cache_bytes + mail_bytes
+
+
 class Simulator:
     """Builds engine parameters from a SimConfig and runs a trace batch."""
 
@@ -234,21 +248,32 @@ class Simulator:
             mem=mem_params,
             user_hbh=user_hbh,
             user_atac=user_atac,
+            # the engine gate's lax.cond double-buffers the memory state in
+            # HBM; keep it only while the duplicate comfortably fits (the
+            # directory sharer maps grow as tiles^2 x dir entries)
+            mem_gate=(mem_params is None
+                      or _mem_state_bytes(mem_params) < 1 << 30),
         )
         # Clock-skew scheme (`carbon_sim.cfg:85-108`): lax_barrier uses the
-        # config quantum; lax runs one unbounded quantum; lax_p2p is
-        # approximated by a quantum equal to its slack.
+        # config quantum; lax runs one unbounded quantum; lax_p2p runs
+        # unbounded quanta with per-iteration random pairwise clamping
+        # (`lax_p2p_sync_client.h:13-83`) applied inside the step.
         scheme = cfg.get_string("clock_skew_management/scheme", "lax_barrier")
+        self.p2p_slack_ps = None
         if scheme == "lax_barrier":
             self.quantum_ps = ns_to_ps(
                 cfg.get_int("clock_skew_management/lax_barrier/quantum", 1000)
             )
         elif scheme == "lax_p2p":
-            self.quantum_ps = ns_to_ps(
+            self.quantum_ps = None
+            self.p2p_slack_ps = ns_to_ps(
                 cfg.get_int("clock_skew_management/lax_p2p/slack", 1000)
             )
         else:
             self.quantum_ps = None  # lax: unbounded
+        if self.p2p_slack_ps is not None:
+            self.params = dataclasses.replace(
+                self.params, p2p_slack_ps=self.p2p_slack_ps)
 
         models_on = not cfg.get_bool(
             "general/trigger_models_within_application", False

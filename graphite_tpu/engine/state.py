@@ -126,6 +126,9 @@ class SimState:
     # per-domain DVFS state (always populated by Simulator; the None path
     # exists only for direct engine-level construction in tests)
     dvfs: "object" = None
+    # lax_p2p pairing round counter (drives the pseudorandom partner draw;
+    # carried unconditionally — one int32 scalar)
+    p2p_round: "jax.Array" = None
 
 
 @struct.dataclass
@@ -223,4 +226,5 @@ def init_state(
         sync=sync,
         models_enabled=jnp.asarray(models_enabled, jnp.bool_),
         done=jnp.zeros(T, jnp.bool_),
+        p2p_round=jnp.zeros((), jnp.int32),
     )

@@ -87,6 +87,19 @@ class EngineParams:
     user_hbh: "object" = None  # HopByHopParams | None
     # USER network ATAC optical model (clusters + hubs + waveguide)
     user_atac: "object" = None  # AtacParams | None
+    # Gate the memory engine behind a "any memory work this iteration"
+    # lax.cond (big win on mixed compute/memory traces).  XLA double-
+    # buffers the cond's carried outputs, so the Simulator disables the
+    # gate when the memory state (directory sharer maps dominate at large
+    # tile counts) is too big to duplicate in HBM.
+    mem_gate: bool = True
+    # lax_p2p clock-skew scheme (`lax_p2p_sync_client.h:13-83`): when set,
+    # each iteration every tile draws a pseudorandom partner and advances
+    # only if its clock is within `slack` of the partner's — the
+    # random-pairwise clamping of the reference, minus the raciness (our
+    # sync decisions are simulated-time-ordered, so unlike the reference
+    # the scheme changes scheduling, not results)
+    p2p_slack_ps: "int | None" = None
 
 
 def _gather_field(field: jax.Array, idx: jax.Array) -> jax.Array:
@@ -154,12 +167,35 @@ def subquantum_iteration(
     done = state.done | (op == Op.NOP) | (op == Op.THREAD_EXIT)
     active = (~done) & (core.clock_ps < quantum_end_ps)
 
+    # lax_p2p random pairwise clamping (`lax_p2p_sync_client.h:13-83`):
+    # each tile draws a pseudorandom partner this round and holds if it is
+    # more than `slack` ahead of a still-running partner.  The globally
+    # minimum-clock lane can never hold (its partner's clock is >= its
+    # own), so some lane always advances — no scheme-induced deadlock.
+    if params.p2p_slack_ps is not None:
+        rnd = (state.p2p_round.astype(jnp.uint32) * jnp.uint32(747796405)
+               + tiles.astype(jnp.uint32) * jnp.uint32(2891336453))
+        rnd = (rnd ^ (rnd >> 13)) * jnp.uint32(1103515245)
+        # a random partner OTHER than self (self-pairing would be a no-op
+        # check and weakens the bound badly at small tile counts)
+        partner = ((tiles.astype(jnp.uint32) + 1
+                    + rnd % jnp.uint32(max(T - 1, 1)))
+                   % jnp.uint32(T)).astype(jnp.int32)
+        ahead = core.clock_ps > (
+            core.clock_ps[partner] + jnp.asarray(params.p2p_slack_ps, I64))
+        active = active & ~(ahead & ~done[partner])
+        p2p_round = state.p2p_round + 1
+    else:
+        p2p_round = state.p2p_round
+
     # --- memory subsystem (caches + coherence protocol) ------------------
     # Runs every iteration: requester lanes start/advance their record's
     # memory slots; home/sharer machinery serves protocol messages even for
     # tiles past the quantum boundary (like the reference's sim threads).
     if params.mem is not None:
-        from graphite_tpu.memory.engine import RecView, memory_engine_step
+        from graphite_tpu.memory.engine import (
+            RecView, mem_idle_out, memory_engine_step, slots_present,
+        )
 
         if params.mem.protocol.startswith("pr_l1_sh_l2"):
             from graphite_tpu.memory.engine_shl2 import shl2_engine_step
@@ -169,9 +205,24 @@ def subquantum_iteration(
         addr0, addr1 = fetched[6], fetched[7]
         rec = RecView(op=op, flags=flags, pc=pc, addr0=addr0, addr1=addr1,
                       aux0=aux0, aux1=aux1)
-        mem_out = engine_step(
-            params.mem, state.mem, rec, core.clock_ps, core.freq_mhz,
-            active, enabled)
+        # Skip the whole engine (hundreds of small kernels) on iterations
+        # with provably no memory work: no live protocol state and no
+        # active lane whose record carries memory slots.  Compute-heavy
+        # stretches (bblock runs) then pay ~nothing for the memory model.
+        if params.mem_gate:
+            need_mem = state.mem.live | jnp.any(
+                active & slots_present(params.mem, rec, enabled).any(axis=1))
+            mem_out = lax.cond(
+                need_mem,
+                lambda _: engine_step(params.mem, state.mem, rec,
+                                      core.clock_ps, core.freq_mhz,
+                                      active, enabled),
+                lambda _: mem_idle_out(params.mem, state.mem, rec, enabled),
+                None)
+        else:
+            mem_out = engine_step(
+                params.mem, state.mem, rec, core.clock_ps, core.freq_mhz,
+                active, enabled)
         mem_state = mem_out.ms
         mem_ok = mem_out.mem_complete
         mem_acc_ps = mem_out.acc_ps
@@ -820,6 +871,7 @@ def subquantum_iteration(
         noc_user=noc_user,
         ioc=new_ioc,
         dvfs=new_dvfs,
+        p2p_round=p2p_round,
     )
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 

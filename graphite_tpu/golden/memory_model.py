@@ -77,11 +77,12 @@ class _Cache:
     (`cache.h:26-135`): modulo set hash, LRU with invalid-way-first
     victims, invalidate keeps the tag (state-only)."""
 
-    __slots__ = ("sets", "ways", "tags", "state", "lru")
+    __slots__ = ("sets", "ways", "tags", "state", "lru", "policy")
 
-    def __init__(self, num_sets: int, num_ways: int):
+    def __init__(self, num_sets: int, num_ways: int, policy: str = "lru"):
         self.sets = num_sets
         self.ways = num_ways
+        self.policy = policy
         self.tags = [[-1] * num_ways for _ in range(num_sets)]
         self.state = [[INVALID] * num_ways for _ in range(num_sets)]
         self.lru = [list(range(num_ways)) for _ in range(num_sets)]
@@ -98,6 +99,13 @@ class _Cache:
         return False, 0, INVALID
 
     def touch(self, line: int, way: int) -> None:
+        """Hit recency update — a no-op under round_robin
+        (`RoundRobinReplacementPolicy::update`)."""
+        if self.policy == "round_robin":
+            return
+        self._rotate(line, way)
+
+    def _rotate(self, line: int, way: int) -> None:
         s = self._set(line)
         rank = self.lru[s][way]
         for w in range(self.ways):
@@ -106,20 +114,25 @@ class _Cache:
         self.lru[s][way] = 0
 
     def pick_victim(self, line: int):
-        """(way, victim_valid, victim_line, victim_state): first invalid
-        way, else the max-LRU-rank way."""
+        """(way, victim_valid, victim_line, victim_state).  lru: first
+        invalid way, else max rank.  round_robin: the rotating index
+        regardless of validity (`round_robin_replacement_policy.cc`)."""
         s = self._set(line)
-        for w in range(self.ways):
-            if self.state[s][w] == INVALID:
-                return w, False, self.tags[s][w], INVALID
+        if self.policy != "round_robin":
+            for w in range(self.ways):
+                if self.state[s][w] == INVALID:
+                    return w, False, self.tags[s][w], INVALID
         w = max(range(self.ways), key=lambda x: self.lru[s][x])
-        return w, True, self.tags[s][w], self.state[s][w]
+        valid = self.state[s][w] != INVALID
+        return w, valid, self.tags[s][w], self.state[s][w]
 
     def insert_at(self, line: int, way: int, st: int) -> None:
         s = self._set(line)
         self.tags[s][way] = line
         self.state[s][way] = st
-        self.touch(line, way)
+        # insertion always rotates: under round_robin the rank rotation IS
+        # the decrementing replacement index; under lru it makes way MRU
+        self._rotate(line, way)
 
     def set_state(self, line: int, way: int, st: int) -> None:
         self.state[self._set(line)][way] = st
@@ -170,11 +183,12 @@ class GoldenMemory:
         T = mp.n_tiles
         self.freq = [int(f) for f in freq_mhz] if hasattr(
             freq_mhz, "__len__") else [int(freq_mhz)] * T
-        self.l1i = [_Cache(mp.l1i.num_sets, mp.l1i.num_ways)
-                    for _ in range(T)]
-        self.l1d = [_Cache(mp.l1d.num_sets, mp.l1d.num_ways)
-                    for _ in range(T)]
-        self.l2 = [_Cache(mp.l2.num_sets, mp.l2.num_ways) for _ in range(T)]
+        self.l1i = [_Cache(mp.l1i.num_sets, mp.l1i.num_ways,
+                           mp.l1i.replacement) for _ in range(T)]
+        self.l1d = [_Cache(mp.l1d.num_sets, mp.l1d.num_ways,
+                           mp.l1d.replacement) for _ in range(T)]
+        self.l2 = [_Cache(mp.l2.num_sets, mp.l2.num_ways,
+                          mp.l2.replacement) for _ in range(T)]
         # which L1 caches each L2 entry ((set, way) -> MOD_L1I/MOD_L1D/0)
         self.l2_cloc = [dict() for _ in range(T)]
         self.homes = {h: _Home(mp.dir_sets, mp.dir_ways)

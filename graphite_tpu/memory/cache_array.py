@@ -1,19 +1,26 @@
-"""Vectorized set-associative cache arrays.
+"""Vectorized set-associative cache arrays (packed representation).
 
 The reference `Cache` (`common/tile/memory_subsystem/cache/cache.h:26-135`)
 is a per-tile C++ object: tag store + state + replacement policy, accessed
 one address at a time under a lock.  Here a cache *level* across all tiles
-is three dense tensors
+is ONE dense tensor
 
-    tags  int32[T, S, W]   cache-line address (full line number, no split
-                           tag/index — avoids reconstruction)
-    state uint8[T, S, W]   CacheState (INVALID/SHARED/MODIFIED/... below)
-    lru   uint8[T, S, W]   LRU rank, 0 = most recently used
+    meta int64[T, S, W] = line(32 bits, signed; -1 = free) << 16
+                        | state(8) << 8 | lru(8)
 
-and every operation is a masked gather/scatter over the tile axis: one XLA
-op looks up (or updates) one line in *every* tile's cache simultaneously.
-Each lane touches only its own tile's row, so scatters never collide;
-masked-off lanes write back unchanged values.
+and every operation is a masked gather/scatter over the tile axis.  The
+three logical fields live in one word so a lookup is a single gather and
+an insert a single scatter — the memory engine is op-count-bound on TPU
+(hundreds of small kernels per subquantum iteration), so each saved
+gather/scatter kernel is wall-clock (see PERF.md "Engine cost model").
+
+Two API levels:
+ - element ops (`lookup`/`touch_lru`/`insert_at`/...) — one gather or
+   scatter each, used by the shared-L2 engine and tests;
+ - row ops (`gather_row`/`scatter_row` + `row_*`) — fetch each lane's set
+   row ONCE per engine phase, do every lookup/victim/insert decision as
+   [T, W] elementwise math, write the row back once.  The private-L2
+   engine phases use these.
 
 Set index = line % num_sets, matching the reference `CacheHashFn` modulo
 mapping (`cache/cache_hash_fn.cc`).  Replacement is LRU with
@@ -37,6 +44,8 @@ OWNED = 4       # MOSI protocols
 _READABLE = (1 << SHARED) | (1 << MODIFIED) | (1 << EXCLUSIVE) | (1 << OWNED)
 _WRITABLE = (1 << MODIFIED) | (1 << EXCLUSIVE)
 
+I64 = jnp.int64
+
 
 def state_readable(state: jax.Array) -> jax.Array:
     return ((_READABLE >> state.astype(jnp.int32)) & 1).astype(jnp.bool_)
@@ -46,46 +55,169 @@ def state_writable(state: jax.Array) -> jax.Array:
     return ((_WRITABLE >> state.astype(jnp.int32)) & 1).astype(jnp.bool_)
 
 
+def _pack(line, state, lru):
+    return ((jnp.asarray(line).astype(I64) << 16)
+            | (jnp.asarray(state).astype(I64) << 8)
+            | jnp.asarray(lru).astype(I64))
+
+
+def _unpack(meta):
+    # arithmetic >> keeps line == -1 working (sign-extends through int32)
+    return (
+        (meta >> 16).astype(jnp.int32),
+        ((meta >> 8) & 0xFF).astype(jnp.uint8),
+        (meta & 0xFF).astype(jnp.int32),
+    )
+
+
 @struct.dataclass
 class CacheArrays:
-    tags: jax.Array   # int32[T, S, W]
-    state: jax.Array  # uint8[T, S, W]
-    lru: jax.Array    # uint8[T, S, W]
+    meta: jax.Array   # int64[T, S, W]
 
     @property
     def num_sets(self) -> int:
-        return self.tags.shape[1]
+        return self.meta.shape[1]
 
     @property
     def num_ways(self) -> int:
-        return self.tags.shape[2]
+        return self.meta.shape[2]
+
+    # host-side convenience views (statistics sampling, tests)
+    @property
+    def tags(self) -> jax.Array:
+        return (self.meta >> 16).astype(jnp.int32)
+
+    @property
+    def state(self) -> jax.Array:
+        return ((self.meta >> 8) & 0xFF).astype(jnp.uint8)
+
+    @property
+    def lru(self) -> jax.Array:
+        return (self.meta & 0xFF).astype(jnp.uint8)
 
 
 def make_cache(n_tiles: int, num_sets: int, num_ways: int) -> CacheArrays:
     shape = (n_tiles, num_sets, num_ways)
-    return CacheArrays(
-        tags=jnp.full(shape, -1, jnp.int32),
-        state=jnp.zeros(shape, jnp.uint8),
-        # ranks start as a strict permutation 0..W-1 per set; touch_lru
-        # preserves the permutation (bump-below-rank + zero the way)
-        lru=jnp.broadcast_to(
-            jnp.arange(num_ways, dtype=jnp.uint8), shape
-        ).copy(),
-    )
+    # lru ranks start as a strict permutation 0..W-1 per set; touches
+    # preserve the permutation (bump-below-rank + zero the way)
+    lru0 = jnp.broadcast_to(jnp.arange(num_ways, dtype=I64), shape)
+    return CacheArrays(meta=(jnp.asarray(-1, I64) << 16) | lru0)
 
 
-def _rows(cache: CacheArrays, line: jax.Array):
-    """Gather each lane's set row: ([T,W] tags, [T,W] state, [T,W] lru, set)."""
-    T = cache.tags.shape[0]
+# ---------------------------------------------------------------------------
+# row-level API: one gather per phase, [T, W] elementwise math, one scatter
+
+
+@struct.dataclass
+class CacheRow:
+    """One set row per lane: each lane's (line % S) row of a cache level."""
+
+    tag: jax.Array   # int32[T, W]
+    st: jax.Array    # int32[T, W]  (int32 for arithmetic convenience)
+    lru: jax.Array   # int32[T, W]
+    sets: jax.Array  # int32[T]
+
+
+def gather_row(cache: CacheArrays, line: jax.Array) -> CacheRow:
+    T = cache.meta.shape[0]
     tiles = jnp.arange(T, dtype=jnp.int32)
     sets = (line % cache.num_sets).astype(jnp.int32)
-    return (
-        cache.tags[tiles, sets],
-        cache.state[tiles, sets],
-        cache.lru[tiles, sets],
-        tiles,
-        sets,
+    meta = cache.meta[tiles, sets]                 # [T, W] — ONE gather
+    tag, st, lru = _unpack(meta)
+    return CacheRow(tag=tag, st=st.astype(jnp.int32), lru=lru, sets=sets)
+
+
+def scatter_row(cache: CacheArrays, row: CacheRow) -> CacheArrays:
+    """Write each lane's row back — ONE scatter, no masking: the row_*
+    ops are themselves masked per lane, so an untouched lane's row packs
+    back to exactly the live value (a redundant same-value write beats a
+    second gather to blend)."""
+    T = cache.meta.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    new_meta = _pack(row.tag, row.st, row.lru)
+    return cache.replace(meta=cache.meta.at[tiles, row.sets].set(new_meta))
+
+
+def row_lookup(row: CacheRow, line: jax.Array):
+    """(hit bool[T], way int32[T], state uint8[T]) within the row."""
+    way_hits = (row.tag == line[:, None]) & (row.st != INVALID)
+    hit = way_hits.any(axis=1)
+    way = jnp.argmax(way_hits, axis=1).astype(jnp.int32)
+    st = jnp.where(
+        hit, jnp.take_along_axis(row.st, way[:, None], axis=1)[:, 0], INVALID
+    ).astype(jnp.uint8)
+    return hit, way, st
+
+
+def row_touch(row: CacheRow, way: jax.Array, mask: jax.Array) -> CacheRow:
+    """Make `way` the MRU of its row where mask (ranks below it shift up)."""
+    rank = jnp.take_along_axis(row.lru, way[:, None], axis=1)
+    bumped = row.lru + (row.lru < rank).astype(jnp.int32)
+    onehot = jnp.arange(row.lru.shape[1])[None, :] == way[:, None]
+    new_lru = jnp.where(onehot, 0, bumped)
+    return row.replace(lru=jnp.where(mask[:, None], new_lru, row.lru))
+
+
+def row_set_state(row: CacheRow, way: jax.Array, new_state,
+                  mask: jax.Array) -> CacheRow:
+    onehot = jnp.arange(row.st.shape[1])[None, :] == way[:, None]
+    sel = onehot & mask[:, None]
+    return row.replace(st=jnp.where(
+        sel, jnp.broadcast_to(jnp.asarray(new_state, jnp.int32)[..., None],
+                              row.st.shape), row.st))
+
+
+def row_invalidate(row: CacheRow, line: jax.Array,
+                   mask: jax.Array) -> CacheRow:
+    hit, way, _ = row_lookup(row, line)
+    return row_set_state(row, way, INVALID, mask & hit)
+
+
+def row_pick_victim(row: CacheRow, policy: str = "lru"):
+    """(way, victim_valid, victim_line, victim_state).
+
+    lru (`lru_replacement_policy.cc`): first invalid way, else the
+    max-rank way.  round_robin (`round_robin_replacement_policy.cc`): the
+    set's rotating index regardless of validity — the rank permutation
+    doubles as the rotation state (ranks only move on insertion, so the
+    max-rank way IS the current index and inserting rotates it), and
+    victim_valid reflects whether the chosen way held a live line."""
+    lru_way = jnp.argmax(row.lru, axis=1)
+    if policy == "round_robin":
+        way = lru_way.astype(jnp.int32)
+        victim_state = jnp.take_along_axis(
+            row.st, way[:, None], axis=1)[:, 0].astype(jnp.uint8)
+        victim_valid = victim_state != INVALID
+    else:
+        inv = row.st == INVALID
+        any_inv = inv.any(axis=1)
+        inv_way = jnp.argmax(inv, axis=1)
+        way = jnp.where(any_inv, inv_way, lru_way).astype(jnp.int32)
+        victim_state = jnp.take_along_axis(
+            row.st, way[:, None], axis=1)[:, 0].astype(jnp.uint8)
+        victim_valid = ~any_inv
+    victim_line = jnp.take_along_axis(row.tag, way[:, None], axis=1)[:, 0]
+    return way, victim_valid, victim_line, victim_state
+
+
+def row_insert(row: CacheRow, line: jax.Array, way: jax.Array, new_state,
+               mask: jax.Array) -> CacheRow:
+    """Install `line` at `way` with `new_state` where mask, making it MRU."""
+    onehot = jnp.arange(row.tag.shape[1])[None, :] == way[:, None]
+    sel = onehot & mask[:, None]
+    out = row.replace(
+        tag=jnp.where(sel, line[:, None], row.tag),
+        st=jnp.where(
+            sel,
+            jnp.broadcast_to(jnp.asarray(new_state, jnp.int32)[..., None],
+                             row.st.shape),
+            row.st),
     )
+    return row_touch(out, way, mask)
+
+
+# ---------------------------------------------------------------------------
+# element-level API (one gather/scatter per call) — shared-L2 engine, tests
 
 
 def lookup(cache: CacheArrays, line: jax.Array):
@@ -94,61 +226,41 @@ def lookup(cache: CacheArrays, line: jax.Array):
     `Cache::getCacheLineInfo` (`cache.h:92`) vectorized: way is valid only
     where hit; state is INVALID where miss.
     """
-    tag_row, st_row, _, _, _ = _rows(cache, line)
-    way_hits = (tag_row == line[:, None]) & (st_row != INVALID)
-    hit = way_hits.any(axis=1)
-    way = jnp.argmax(way_hits, axis=1).astype(jnp.int32)
-    st = jnp.where(
-        hit, jnp.take_along_axis(st_row, way[:, None], axis=1)[:, 0], INVALID
-    ).astype(jnp.uint8)
-    return hit, way, st
+    row = gather_row(cache, line)
+    return row_lookup(row, line)
 
 
 def touch_lru(cache: CacheArrays, line: jax.Array, way: jax.Array,
               mask: jax.Array) -> CacheArrays:
     """Make `way` the MRU of its set where mask (LRU ranks shift up)."""
-    _, _, lru_row, tiles, sets = _rows(cache, line)
-    rank = jnp.take_along_axis(lru_row, way[:, None], axis=1)  # [T,1]
-    bumped = lru_row + (lru_row < rank).astype(jnp.uint8)
-    onehot = jnp.arange(cache.num_ways)[None, :] == way[:, None]
-    new_row = jnp.where(onehot, 0, bumped).astype(jnp.uint8)
-    new_row = jnp.where(mask[:, None], new_row, lru_row)
-    return cache.replace(lru=cache.lru.at[tiles, sets].set(new_row))
+    row = gather_row(cache, line)
+    return scatter_row(cache, row_touch(row, way, mask))
 
 
 def set_state(cache: CacheArrays, line: jax.Array, way: jax.Array,
               new_state: jax.Array, mask: jax.Array) -> CacheArrays:
     """Set the state of (line, way) where mask (`Cache::setCacheLineInfo`)."""
-    tiles = jnp.arange(cache.tags.shape[0], dtype=jnp.int32)
-    sets = (line % cache.num_sets).astype(jnp.int32)
-    cur = cache.state[tiles, sets, way]
-    val = jnp.where(mask, jnp.asarray(new_state, jnp.uint8), cur)
-    return cache.replace(state=cache.state.at[tiles, sets, way].set(val))
+    row = gather_row(cache, line)
+    return scatter_row(cache, row_set_state(row, way, new_state, mask))
 
 
 def invalidate(cache: CacheArrays, line: jax.Array,
                mask: jax.Array) -> CacheArrays:
     """Invalidate `line` where mask & present (`Cache::invalidateCacheLine`)."""
-    hit, way, _ = lookup(cache, line)
-    return set_state(cache, line, way, INVALID, mask & hit)
+    row = gather_row(cache, line)
+    hit, way, _ = row_lookup(row, line)
+    m = mask & hit
+    return scatter_row(cache, row_set_state(row, way, INVALID, m))
 
 
-def pick_victim(cache: CacheArrays, line: jax.Array):
-    """Victim way per lane: first invalid way, else the LRU (max-rank) way.
+def pick_victim(cache: CacheArrays, line: jax.Array, policy: str = "lru"):
+    """Victim way per lane (see row_pick_victim for policy semantics).
 
     Returns (way int32[T], victim_valid bool[T], victim_line int32[T],
     victim_state uint8[T]).
     """
-    tag_row, st_row, lru_row, _, _ = _rows(cache, line)
-    inv = st_row == INVALID
-    any_inv = inv.any(axis=1)
-    inv_way = jnp.argmax(inv, axis=1)
-    lru_way = jnp.argmax(lru_row, axis=1)
-    way = jnp.where(any_inv, inv_way, lru_way).astype(jnp.int32)
-    victim_valid = ~any_inv
-    victim_line = jnp.take_along_axis(tag_row, way[:, None], axis=1)[:, 0]
-    victim_state = jnp.take_along_axis(st_row, way[:, None], axis=1)[:, 0]
-    return way, victim_valid, victim_line, victim_state
+    row = gather_row(cache, line)
+    return row_pick_victim(row, policy)
 
 
 def insert_at(cache: CacheArrays, line: jax.Array, way: jax.Array,
@@ -158,14 +270,5 @@ def insert_at(cache: CacheArrays, line: jax.Array, way: jax.Array,
     `Cache::insertCacheLine` (`cache.h:90`) minus the eviction message
     (the caller handles the victim it got from pick_victim).
     """
-    tiles = jnp.arange(cache.tags.shape[0], dtype=jnp.int32)
-    sets = (line % cache.num_sets).astype(jnp.int32)
-    tags = cache.tags.at[tiles, sets, way].set(
-        jnp.where(mask, line, cache.tags[tiles, sets, way])
-    )
-    state = cache.state.at[tiles, sets, way].set(
-        jnp.where(mask, jnp.asarray(new_state, jnp.uint8),
-                  cache.state[tiles, sets, way])
-    )
-    out = cache.replace(tags=tags, state=state)
-    return touch_lru(out, line, way, mask)
+    row = gather_row(cache, line)
+    return scatter_row(cache, row_insert(row, line, way, new_state, mask))

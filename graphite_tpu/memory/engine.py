@@ -198,6 +198,65 @@ class MemStepOut:
     progress: jax.Array      # int32[] events this iteration
 
 
+def slots_present(mp: MemParams, rec: "RecView", enabled) -> jax.Array:
+    """bool[T, 3]: which of [icache, mem0, mem1] this record carries.
+
+    icache fetches for static/branch records (op < DYNAMIC_MISC) and
+    compressed BBLOCK runs (one fetch for the block's first line — a
+    documented approximation); dynamic ops (15-19) commit without waiting
+    on mem_ok, so they get no fetch slot."""
+    is_instr = (rec.op < 15) | (rec.op == int(Op.BBLOCK))
+    icache_present = (
+        jnp.asarray(mp.icache_modeling) & jnp.asarray(enabled) & is_instr
+    )
+    mem0 = (rec.flags & FLAG_MEM0_VALID) != 0
+    mem1 = (rec.flags & FLAG_MEM1_VALID) != 0
+    return jnp.stack([icache_present, mem0, mem1], axis=1)
+
+
+def next_present_slot(present: jax.Array, slot: jax.Array) -> jax.Array:
+    """First present slot index >= slot, else 3."""
+    k = jnp.arange(3)[None, :]
+    cand = jnp.where(present & (k >= slot[:, None]), k, 3)
+    return cand.min(axis=1).astype(jnp.int32)
+
+
+def protocol_live(ms, *extra) -> jax.Array:
+    """Any protocol state outstanding (messages, transactions, waiting
+    requesters)?  Shared by both engines so the mem_gate's wake-up
+    condition cannot drift between them; engine-specific terms (e.g. the
+    shared-L2 engine's in-flight DRAM fetches) come in via *extra."""
+    mail = ms.mail
+    live = (
+        (mail.req_type != MSG_NONE).any()
+        | (mail.evict_type != MSG_NONE).any()
+        | (mail.fwd_type != MSG_NONE).any()
+        | (mail.ack_type != MSG_NONE).any()
+        | (mail.rep_type != MSG_NONE).any()
+        | ms.txn.active.any()
+        | ms.txn.saved_valid.any()
+        | (ms.req.phase != PHASE_IDLE).any()
+    )
+    for term in extra:
+        live = live | term
+    return live
+
+
+def mem_idle_out(mp: MemParams, ms, rec: "RecView", enabled) -> MemStepOut:
+    """The engine step's result when there is provably nothing to do —
+    no lane's record carries memory slots and no protocol state is live
+    (`ms.live`).  Lets the caller skip the whole engine under a lax.cond
+    on compute-only iterations (the engine costs ~600 us/iteration in
+    small kernels; see PERF.md)."""
+    present = slots_present(mp, rec, enabled)
+    final_slot = next_present_slot(present, ms.req.slot)
+    mem_complete = (ms.req.phase == PHASE_IDLE) & (final_slot >= 3)
+    return MemStepOut(
+        ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
+        slot_lat_ps=ms.req.slot_lat_ps,
+        progress=jnp.zeros((), jnp.int32))
+
+
 # --------------------------------------------------------------------------
 # directory-entry helpers (operate on the [T, DS, DW] arrays per home lane)
 
@@ -308,26 +367,10 @@ def memory_engine_step(
 
     # ---- slot decomposition of the current record -------------------------
     flags = rec.flags
-    # icache fetches for static/branch records (op < DYNAMIC_MISC) and
-    # compressed BBLOCK runs (op 50, one fetch for the block's first line —
-    # documented approximation of per-line fetches).  step.py commits
-    # dynamic ops (15-19) without waiting on mem_ok, so giving them a fetch
-    # slot would leave an in-flight transaction behind.
-    is_instr = (rec.op < 15) | (rec.op == int(Op.BBLOCK))
-    icache_present = (
-        jnp.asarray(mp.icache_modeling)
-        & jnp.asarray(enabled)
-        & is_instr
-    )
-    mem0_present = (flags & FLAG_MEM0_VALID) != 0
-    mem1_present = (flags & FLAG_MEM1_VALID) != 0
-    present = jnp.stack([icache_present, mem0_present, mem1_present], axis=1)
+    present = slots_present(mp, rec, enabled)
 
     def next_present(slot):
-        """First present slot index >= slot, else 3."""
-        k = jnp.arange(3)[None, :]
-        cand = jnp.where(present & (k >= slot[:, None]), k, 3)
-        return cand.min(axis=1).astype(jnp.int32)
+        return next_present_slot(present, slot)
 
     # ======================================================================
     # (1) requester slot starts (app-thread L1/L2 path)
@@ -354,9 +397,14 @@ def memory_engine_step(
     ibuf_hit = starting & s_is_icache & (s_line == ms.req.instr_buf)
     new_instr_buf = jnp.where(starting & s_is_icache, s_line, ms.req.instr_buf)
 
-    # L1 lookups (both caches, masked by component)
-    l1i_hit, l1i_way, l1i_state = ca.lookup(ms.l1i, s_line)
-    l1d_hit, l1d_way, l1d_state = ca.lookup(ms.l1d, s_line)
+    # L1 lookups (both caches, masked by component) — each lane's set rows
+    # are gathered ONCE per cache level here and scattered back once below
+    # (the engine is op-count-bound; see cache_array.py)
+    l1i_row = ca.gather_row(ms.l1i, s_line)
+    l1d_row = ca.gather_row(ms.l1d, s_line)
+    l2_row = ca.gather_row(ms.l2, s_line)
+    l1i_hit, l1i_way, l1i_state = ca.row_lookup(l1i_row, s_line)
+    l1d_hit, l1d_way, l1d_state = ca.row_lookup(l1d_row, s_line)
     l1_state = jnp.where(s_comp_l1i, l1i_state, l1d_state)
     l1_permit = jnp.where(s_write, state_writable(l1_state),
                           state_readable(l1_state))
@@ -374,7 +422,7 @@ def memory_engine_step(
     l1_miss = do_l1 & ~l1_permit
 
     # L2 lookup for L1 misses
-    l2_hit, l2_way, l2_state = ca.lookup(ms.l2, s_line)
+    l2_hit, l2_way, l2_state = ca.row_lookup(l2_row, s_line)
     l2_permit = jnp.where(s_write, state_writable(l2_state),
                           state_readable(l2_state))
     l2_hit_now = l1_miss & l2_permit
@@ -398,14 +446,17 @@ def memory_engine_step(
     sclock = clock_ps + sync_core           # processMemOpFromCore entry
     l1_hit_done_ps = sclock + l1_dat
 
-    l1i_upd = ca.touch_lru(ms.l1i, s_line, l1i_way, l1_hit_now & s_comp_l1i)
-    l1d_upd = ca.touch_lru(ms.l1d, s_line, l1d_way, l1_hit_now & ~s_comp_l1i)
+    # hits refresh recency under LRU; round_robin's update is a no-op
+    if mp.l1i.replacement != "round_robin":
+        l1i_row = ca.row_touch(l1i_row, l1i_way, l1_hit_now & s_comp_l1i)
+    if mp.l1d.replacement != "round_robin":
+        l1d_row = ca.row_touch(l1d_row, l1d_way, l1_hit_now & ~s_comp_l1i)
 
     # L1 line invalidated on miss before L2 is consulted
     # (`l1_cache_cntlr.cc:137`) — must precede the L2-hit fill below, so
     # the fill lands in the just-freed way and survives
-    l1i_upd = ca.invalidate(l1i_upd, s_line, l1_miss & s_comp_l1i)
-    l1d_upd = ca.invalidate(l1d_upd, s_line, l1_miss & ~s_comp_l1i)
+    l1i_row = ca.row_invalidate(l1i_row, s_line, l1_miss & s_comp_l1i)
+    l1d_row = ca.row_invalidate(l1d_row, s_line, l1_miss & ~s_comp_l1i)
 
     # --- apply the L2-hit path (fill L1 from L2) -------------------------
     # timing: L1 tags (miss) + L2 sync + L2 data+tags + L1 data+tags
@@ -415,13 +466,15 @@ def memory_engine_step(
     fill_l1i = l2_hit_now & s_comp_l1i
     fill_l1d = l2_hit_now & ~s_comp_l1i
 
-    def l1_fill(cache, mask, st):
-        way, v_valid, v_line, _ = ca.pick_victim(cache, s_line)
-        out = ca.insert_at(cache, s_line, way, st, mask)
+    def l1_fill(row, mask, st, policy):
+        way, v_valid, v_line, _ = ca.row_pick_victim(row, policy)
+        out = ca.row_insert(row, s_line, way, st, mask)
         return out, way, v_valid & mask, v_line
 
-    l1i_upd, _, l1i_ev, l1i_ev_line = l1_fill(l1i_upd, fill_l1i, l2_state)
-    l1d_upd, _, l1d_ev, l1d_ev_line = l1_fill(l1d_upd, fill_l1d, l2_state)
+    l1i_row, _, l1i_ev, l1i_ev_line = l1_fill(
+        l1i_row, fill_l1i, l2_state, mp.l1i.replacement)
+    l1d_row, _, l1d_ev, l1d_ev_line = l1_fill(
+        l1d_row, fill_l1d, l2_state, mp.l1d.replacement)
     # L1 victims: clear their cached-loc in L2 (line stays valid in L2)
     l1_ev = l1i_ev | l1d_ev
     l1_ev_line = jnp.where(l1i_ev, l1i_ev_line, l1d_ev_line)
@@ -434,7 +487,8 @@ def memory_engine_step(
     new_cloc = jnp.where(s_comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8)
     l2_cloc = l2_cloc.at[tiles, f_sets, l2_way].set(
         jnp.where(l2_hit_now, new_cloc, l2_cloc[tiles, f_sets, l2_way]))
-    l2_upd = ca.touch_lru(ms.l2, s_line, l2_way, l2_hit_now)
+    if mp.l2.replacement != "round_robin":
+        l2_row = ca.row_touch(l2_row, l2_way, l2_hit_now)
 
     # --- apply the L2-miss path (send request) ---------------------------
     # `processExReqFromL1Cache`/`processShReqFromL1Cache`: request time =
@@ -442,9 +496,13 @@ def memory_engine_step(
     req_send_ps = sclock + l1_tag + ccycles(mp.l2.tags_cycles)
     # upgrade: invalidate L2 + eviction message (INV_REP clean, FLUSH_REP
     # for a dirty OWNED line)
-    l2_upd = ca.invalidate(l2_upd, s_line, upgrade & ~stall_start)
-    mail = ms.mail
     up_go = upgrade & ~stall_start
+    l2_row = ca.row_invalidate(l2_row, s_line, up_go)
+    # scatter the three set rows back — ONE scatter per cache level
+    l1i_upd = ca.scatter_row(ms.l1i, l1i_row)
+    l1d_upd = ca.scatter_row(ms.l1d, l1d_row)
+    l2_upd = ca.scatter_row(ms.l2, l2_row)
+    mail = ms.mail
     up_msg = jnp.where(upgrade_dirty, MSG_FLUSH_REP,
                        MSG_INV_REP).astype(jnp.uint8)
     w_home = jnp.where(up_go, s_home, 0)
@@ -564,6 +622,9 @@ def memory_engine_step(
     # ---- completion signal ----------------------------------------------
     final_slot = next_present(ms.req.slot)
     mem_complete = (ms.req.phase == PHASE_IDLE) & (final_slot >= 3)
+    # protocol-liveness flag: lets the caller skip the whole engine on
+    # iterations with no memory work (see mem_idle_out)
+    ms = ms.replace(live=protocol_live(ms))
     return MemStepOut(
         ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
         slot_lat_ps=ms.req.slot_lat_ps,
@@ -613,7 +674,8 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     fline = mail.fwd_line[tiles, h]
     ftime = mail.fwd_time[tiles, h]
 
-    l2_hit, l2_way, l2_state = ca.lookup(ms.l2, fline)
+    l2_r = ca.gather_row(ms.l2, fline)
+    l2_hit, l2_way, l2_state = ca.row_lookup(l2_r, fline)
     serve = found & l2_hit & (l2_state != INVALID)
     silent = found & ~serve  # already evicted; eviction msg satisfies home
 
@@ -630,10 +692,12 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     cloc = ms.l2_cloc[tiles, sets, l2_way]
     inv_l1 = serve & (ftype != MSG_WB_REQ)
     wb_l1 = serve & (ftype == MSG_WB_REQ)
-    l1i = ca.invalidate(ms.l1i, fline, inv_l1 & (cloc == MOD_L1I))
-    l1d = ca.invalidate(ms.l1d, fline, inv_l1 & (cloc == MOD_L1D))
-    l1i_hit, l1i_way, _ = ca.lookup(l1i, fline)
-    l1d_hit, l1d_way, _ = ca.lookup(l1d, fline)
+    l1i_r = ca.gather_row(ms.l1i, fline)
+    l1d_r = ca.gather_row(ms.l1d, fline)
+    l1i_r = ca.row_invalidate(l1i_r, fline, inv_l1 & (cloc == MOD_L1I))
+    l1d_r = ca.row_invalidate(l1d_r, fline, inv_l1 & (cloc == MOD_L1D))
+    l1i_hit, l1i_way, _ = ca.row_lookup(l1i_r, fline)
+    l1d_hit, l1d_way, _ = ca.row_lookup(l1d_r, fline)
     # WB downgrade: MSI M→SHARED; MOSI M→OWNED, O→O, S→S (the owner keeps
     # the dirty line — mosi `l2_cache_cntlr.cc:538-566`)
     if mp.is_mosi:
@@ -641,14 +705,17 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
                              l2_state).astype(jnp.uint8)
     else:
         wb_state = jnp.full_like(l2_state, SHARED)
-    l1i = ca.set_state(l1i, fline, l1i_way, wb_state,
-                       wb_l1 & (cloc == MOD_L1I) & l1i_hit)
-    l1d = ca.set_state(l1d, fline, l1d_way, wb_state,
-                       wb_l1 & (cloc == MOD_L1D) & l1d_hit)
+    l1i_r = ca.row_set_state(l1i_r, l1i_way, wb_state,
+                             wb_l1 & (cloc == MOD_L1I) & l1i_hit)
+    l1d_r = ca.row_set_state(l1d_r, l1d_way, wb_state,
+                             wb_l1 & (cloc == MOD_L1D) & l1d_hit)
+    l1i = ca.scatter_row(ms.l1i, l1i_r)
+    l1d = ca.scatter_row(ms.l1d, l1d_r)
 
     # L2: invalidate (INV/FLUSH) or downgrade (WB)
-    l2 = ca.invalidate(ms.l2, fline, inv_l1)
-    l2 = ca.set_state(l2, fline, l2_way, wb_state, wb_l1)
+    l2_r = ca.row_invalidate(l2_r, fline, inv_l1)
+    l2_r = ca.row_set_state(l2_r, l2_way, wb_state, wb_l1)
+    l2 = ca.scatter_row(ms.l2, l2_r)
     l2_cloc = ms.l2_cloc.at[tiles, sets, l2_way].set(
         jnp.where(inv_l1, 0, ms.l2_cloc[tiles, sets, l2_way]))
 
@@ -1221,7 +1288,9 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
 
     # L2 victim for the fill; a valid victim emits an eviction message that
     # needs its (home, us) EVICT cell free — else stall this iteration
-    way, v_valid, v_line, v_state = ca.pick_victim(ms.l2, line)
+    l2_r = ca.gather_row(ms.l2, line)
+    way, v_valid, v_line, v_state = ca.row_pick_victim(
+        l2_r, mp.l2.replacement)
     v_home_all = jnp.asarray(mp.mc_tiles, jnp.int32)[
         (v_line % len(mp.mc_tiles)).astype(jnp.int32)]
     need_evict = have_rep & v_valid
@@ -1230,7 +1299,8 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
     evict_go = need_evict & fill
 
     new_state = jnp.where(mail.rep_type == MSG_EX_REP, MODIFIED, SHARED)
-    l2 = ca.insert_at(ms.l2, line, way, new_state, fill)
+    l2 = ca.scatter_row(ms.l2, ca.row_insert(l2_r, line, way, new_state,
+                                             fill))
     sets = (line % mp.l2.num_sets).astype(jnp.int32)
     l2_cloc = ms.l2_cloc.at[tiles, sets, way].set(
         jnp.where(fill,
@@ -1270,10 +1340,18 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
 
     # L1 fill
     l1_state = new_state  # L1 gets the L2 state (`insertCacheLineInL1`)
-    l1i_way, l1i_vv, l1i_vline, _ = ca.pick_victim(ms.l1i, line)
-    l1d_way, l1d_vv, l1d_vline, _ = ca.pick_victim(ms.l1d, line)
-    l1i = ca.insert_at(ms.l1i, line, l1i_way, l1_state, fill & comp_l1i)
-    l1d = ca.insert_at(ms.l1d, line, l1d_way, l1_state, fill & ~comp_l1i)
+    l1i_r = ca.gather_row(ms.l1i, line)
+    l1d_r = ca.gather_row(ms.l1d, line)
+    l1i_way, l1i_vv, l1i_vline, _ = ca.row_pick_victim(
+        l1i_r, mp.l1i.replacement)
+    l1d_way, l1d_vv, l1d_vline, _ = ca.row_pick_victim(
+        l1d_r, mp.l1d.replacement)
+    l1i = ca.scatter_row(
+        ms.l1i, ca.row_insert(l1i_r, line, l1i_way, l1_state,
+                              fill & comp_l1i))
+    l1d = ca.scatter_row(
+        ms.l1d, ca.row_insert(l1d_r, line, l1d_way, l1_state,
+                              fill & ~comp_l1i))
     # clear cached-loc of L1 victims in L2
     l1_ev = (fill & comp_l1i & l1i_vv) | (fill & ~comp_l1i & l1d_vv)
     l1_ev_line = jnp.where(comp_l1i, l1i_vline, l1d_vline)

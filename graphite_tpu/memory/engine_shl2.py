@@ -108,6 +108,9 @@ class ShL2State:
     counters: MemCounters
     func_mem: jax.Array
     func_errors: jax.Array
+    # bool[] — any protocol state outstanding; False lets the step skip
+    # the engine entirely (see engine.mem_idle_out)
+    live: jax.Array
 
 
 def init_shl2_state(mp: MemParams) -> ShL2State:
@@ -142,7 +145,8 @@ def init_shl2_state(mp: MemParams) -> ShL2State:
         last_line=jnp.full(T, -1, jnp.int32),
         last_done_ps=jnp.zeros(T, I64),
     )
-    return ShL2State(dir=zdir, txn=txn, **base)
+    return ShL2State(dir=zdir, txn=txn, live=jnp.zeros((), jnp.bool_),
+                     **base)
 
 
 def _l2_home(mp: MemParams, line):
@@ -217,17 +221,14 @@ def shl2_engine_step(
     # (1) requester slot starts: L1-only lookup; misses go to the L2 home
     # ======================================================================
     flags = rec.flags
-    is_instr = (rec.op < 15) | (rec.op == 50)
-    icache_present = (jnp.asarray(mp.icache_modeling)
-                      & jnp.asarray(enabled) & is_instr)
-    mem0_present = (flags & FLAG_MEM0_VALID) != 0
-    mem1_present = (flags & FLAG_MEM1_VALID) != 0
-    present = jnp.stack([icache_present, mem0_present, mem1_present], axis=1)
+    # shared with engine.py + the mem_gate's skip decision — MUST stay the
+    # same definition or the gate could idle-skip live slots
+    from graphite_tpu.memory.engine import next_present_slot, slots_present
+
+    present = slots_present(mp, rec, enabled)
 
     def next_present(slot):
-        k = jnp.arange(3)[None, :]
-        cand = jnp.where(present & (k >= slot[:, None]), k, 3)
-        return cand.min(axis=1).astype(jnp.int32)
+        return next_present_slot(present, slot)
 
     slot = next_present(ms.req.slot)
     has_slot = slot < 3
@@ -271,9 +272,13 @@ def shl2_engine_step(
     l1d_upd = ca.set_state(ms.l1d, s_line, l1d_way, MODIFIED,
                            promote & ~s_is_icache)
     l1i_upd = ms.l1i
-    l1i_upd = ca.touch_lru(l1i_upd, s_line, l1i_way, l1_hit_now & s_is_icache)
-    l1d_upd = ca.touch_lru(l1d_upd, s_line, l1d_way,
-                           l1_hit_now & ~s_is_icache)
+    # hits refresh recency under LRU; round_robin's update is a no-op
+    if mp.l1i.replacement != "round_robin":
+        l1i_upd = ca.touch_lru(l1i_upd, s_line, l1i_way,
+                               l1_hit_now & s_is_icache)
+    if mp.l1d.replacement != "round_robin":
+        l1d_upd = ca.touch_lru(l1d_upd, s_line, l1d_way,
+                               l1_hit_now & ~s_is_icache)
 
     # L1 miss: an upgrade (write to readable-but-unwritable line) keeps the
     # line until the reply; a plain miss sends the request right away.  In
@@ -363,6 +368,12 @@ def shl2_engine_step(
 
     final_slot = next_present(ms.req.slot)
     mem_complete = (ms.req.phase == PHASE_IDLE) & (final_slot >= 3)
+    # protocol-liveness flag (see engine.mem_idle_out): includes in-flight
+    # home-side DRAM fetches, which this engine tracks outside txn.active
+    from graphite_tpu.memory.engine import protocol_live
+
+    ms = ms.replace(live=protocol_live(
+        ms, (ms.txn.dram_ready_ps < FAR).any()))
     return MemStepOut(
         ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
         slot_lat_ps=ms.req.slot_lat_ps, progress=progress,
@@ -662,7 +673,8 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     l2_hit, way, l2_state = ca.lookup(l2, rline)
     sets = (rline % mp.l2.num_sets).astype(jnp.int32)
     # allocate on miss; a valid victim with L1 copies runs NULLIFY first
-    v_way, v_valid, v_line, v_state = ca.pick_victim(l2, rline)
+    v_way, v_valid, v_line, v_state = ca.pick_victim(
+        l2, rline, mp.l2.replacement)
     v_sets = (v_line % mp.l2.num_sets).astype(jnp.int32)
     v_dstate, v_owner, v_sharers, v_nsh, v_cloc = _dir_at(
         ms.dir, tiles, v_sets, v_way)
@@ -854,8 +866,10 @@ def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
     # put during an EX upgrade); only true misses pick a victim.
     l1i_hit, l1i_hway, _ = ca.lookup(ms.l1i, line)
     l1d_hit, l1d_hway, _ = ca.lookup(ms.l1d, line)
-    l1i_vway, l1i_vv, l1i_vline, l1i_vstate = ca.pick_victim(ms.l1i, line)
-    l1d_vway, l1d_vv, l1d_vline, l1d_vstate = ca.pick_victim(ms.l1d, line)
+    l1i_vway, l1i_vv, l1i_vline, l1i_vstate = ca.pick_victim(
+        ms.l1i, line, mp.l1i.replacement)
+    l1d_vway, l1d_vv, l1d_vline, l1d_vstate = ca.pick_victim(
+        ms.l1d, line, mp.l1d.replacement)
     l1i_way = jnp.where(l1i_hit, l1i_hway, l1i_vway)
     l1d_way = jnp.where(l1d_hit, l1d_hway, l1d_vway)
     already = jnp.where(comp_l1i, l1i_hit, l1d_hit)

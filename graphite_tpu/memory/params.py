@@ -38,6 +38,9 @@ class CacheLevelParams:
     tags_access_cycles: int
     sequential: bool          # perf_model_type (parallel|sequential)
     track_miss_types: bool = False
+    # `replacement_policy` (`carbon_sim.cfg:213`): lru | round_robin
+    # (factory `CacheReplacementPolicy::create`)
+    replacement: str = "lru"
 
     # CachePerfModel::getLatency (`cache_perf_model_{parallel,sequential}.h`)
     @property
@@ -80,6 +83,8 @@ class CacheLevelParams:
             sequential=cfg.get_string(f"{section}/perf_model_type", "parallel")
             == "sequential",
             track_miss_types=cfg.get_bool(f"{section}/track_miss_types", False),
+            replacement=cfg.get_string(f"{section}/replacement_policy",
+                                       "lru").strip(),
         )
 
 

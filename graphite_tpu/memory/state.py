@@ -174,6 +174,9 @@ class MemState:
     counters: MemCounters
     func_mem: jax.Array      # uint32[mem_words] functional word store
     func_errors: jax.Array   # int64[] failed FLAG_CHECK loads
+    # bool[] — any protocol state outstanding (messages, transactions,
+    # waiting requesters); False lets the step skip the engine entirely
+    live: jax.Array
 
 
 def init_mem_common(mp: MemParams) -> dict:
@@ -272,5 +275,6 @@ def init_mem_state(mp: MemParams) -> MemState:
         l2_cloc=jnp.zeros((T, mp.l2.num_sets, mp.l2.num_ways), jnp.uint8),
         directory=directory,
         txn=txn,
+        live=jnp.zeros((), jnp.bool_),
         **init_mem_common(mp),
     )

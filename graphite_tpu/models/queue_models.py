@@ -85,45 +85,82 @@ class QueueParams:
         return self.max_list_size * max(self.min_processing_time, 1)
 
 
+# column layout of QueueArrays.data — one packed [N, 10] tensor so the
+# scatter path (NoC router hops) costs 2 gathers + 4 scatters instead of
+# ~19 per-field kernels (the engine is launch-count-bound; see PERF.md)
+COL_QT = 0        # queue_time: end of the busy tail
+COL_WS = 1        # window_start: oldest tracked time (history_*)
+COL_NEWEST = 2    # newest_arrival (M/G/1 moments)
+COL_SUM_ST = 3
+COL_SUM_ST2 = 4
+COL_N_ARR = 5
+COL_REQS = 6      # total_requests (`updateQueueUtilizationCounters`)
+COL_UTIL = 7      # total_utilized
+COL_DELAY = 8     # total_delay
+COL_ANA = 9       # analytical_used
+N_COLS = 10
+
+
 @struct.dataclass
 class QueueArrays:
-    """State for N independent queues."""
+    """State for N independent queues (packed; see column layout above)."""
 
-    queue_time: jax.Array       # int64[N] end of the busy tail
-    window_start: jax.Array     # int64[N] oldest tracked time (history_*)
+    data: jax.Array             # int64[N, 10]
     # moving average of packet times (basic, arithmetic mean over W)
     mavg_buf: jax.Array         # int64[N, W]
     mavg_pos: jax.Array         # int32[N]
     mavg_cnt: jax.Array         # int32[N]
-    # M/G/1 running moments (`queue_model_m_g_1.cc`)
-    sum_st: jax.Array           # int64[N]
-    sum_st2: jax.Array          # int64[N]
-    n_arrivals: jax.Array       # int64[N]
-    newest_arrival: jax.Array   # int64[N]
-    # counters (`QueueModel::updateQueueUtilizationCounters`)
-    total_requests: jax.Array   # int64[N]
-    total_utilized: jax.Array   # int64[N]
-    total_delay: jax.Array      # int64[N]
-    analytical_used: jax.Array  # int64[N]
+
+    # read-only views (summaries, tests)
+    @property
+    def queue_time(self) -> jax.Array:
+        return self.data[:, COL_QT]
+
+    @property
+    def window_start(self) -> jax.Array:
+        return self.data[:, COL_WS]
+
+    @property
+    def newest_arrival(self) -> jax.Array:
+        return self.data[:, COL_NEWEST]
+
+    @property
+    def sum_st(self) -> jax.Array:
+        return self.data[:, COL_SUM_ST]
+
+    @property
+    def sum_st2(self) -> jax.Array:
+        return self.data[:, COL_SUM_ST2]
+
+    @property
+    def n_arrivals(self) -> jax.Array:
+        return self.data[:, COL_N_ARR]
+
+    @property
+    def total_requests(self) -> jax.Array:
+        return self.data[:, COL_REQS]
+
+    @property
+    def total_utilized(self) -> jax.Array:
+        return self.data[:, COL_UTIL]
+
+    @property
+    def total_delay(self) -> jax.Array:
+        return self.data[:, COL_DELAY]
+
+    @property
+    def analytical_used(self) -> jax.Array:
+        return self.data[:, COL_ANA]
 
 
 def make_queues(n: int, params: QueueParams) -> QueueArrays:
     W = params.moving_avg_window if (
         params.kind == "basic" and params.moving_avg_enabled) else 1
     return QueueArrays(
-        queue_time=jnp.zeros(n, I64),
-        window_start=jnp.zeros(n, I64),
+        data=jnp.zeros((n, N_COLS), I64),
         mavg_buf=jnp.zeros((n, W), I64),
         mavg_pos=jnp.zeros(n, jnp.int32),
         mavg_cnt=jnp.zeros(n, jnp.int32),
-        sum_st=jnp.zeros(n, I64),
-        sum_st2=jnp.zeros(n, I64),
-        n_arrivals=jnp.zeros(n, I64),
-        newest_arrival=jnp.zeros(n, I64),
-        total_requests=jnp.zeros(n, I64),
-        total_utilized=jnp.zeros(n, I64),
-        total_delay=jnp.zeros(n, I64),
-        analytical_used=jnp.zeros(n, I64),
     )
 
 
@@ -148,17 +185,6 @@ def _mg1_delay(q: QueueArrays) -> jax.Array:
     return _mg1_wait(q.n_arrivals, q.sum_st, q.sum_st2, q.newest_arrival)
 
 
-def _mg1_update(q: QueueArrays, pkt_time, service_time, wait, mask):
-    end = pkt_time + wait + service_time
-    return q.replace(
-        sum_st=q.sum_st + jnp.where(mask, service_time, 0),
-        sum_st2=q.sum_st2 + jnp.where(mask, service_time * service_time, 0),
-        n_arrivals=q.n_arrivals + mask.astype(I64),
-        newest_arrival=jnp.where(
-            mask, jnp.maximum(q.newest_arrival, end), q.newest_arrival),
-    )
-
-
 def compute_queue_delay(
     params: QueueParams,
     q: QueueArrays,
@@ -168,10 +194,14 @@ def compute_queue_delay(
 ):
     """Vectorized `QueueModel::computeQueueDelay` (`queue_model.h:20`).
 
-    Returns (new_state, delay int64[N]).  Each lane services its own queue.
+    Returns (new_state, delay int64[N]).  Each lane services its own queue
+    (pure elementwise column math on the packed state — one fused kernel).
     """
     pkt_time = jnp.asarray(pkt_time, I64)
     proc = jnp.maximum(jnp.asarray(processing_time, I64), 1)
+    qt = q.queue_time
+    ws = q.window_start
+    newest = q.newest_arrival
 
     if params.kind == "basic":
         if params.moving_avg_enabled:
@@ -191,42 +221,48 @@ def compute_queue_delay(
             )
         else:
             ref = pkt_time
-        delay = jnp.maximum(q.queue_time - ref, 0)
-        new_qt = jnp.maximum(q.queue_time, ref) + proc
-        q = q.replace(
-            queue_time=jnp.where(mask, new_qt, q.queue_time))
+        delay = jnp.maximum(qt - ref, 0)
+        new_qt = jnp.where(mask, jnp.maximum(qt, ref) + proc, qt)
+        new_ws = ws
+        mg1_mask = jnp.zeros_like(mask)
         analytical = jnp.zeros_like(mask)
 
     elif params.kind == "m_g_1":
         delay = _mg1_delay(q)
-        q = _mg1_update(q, pkt_time, proc, delay, mask)
+        new_qt = qt
+        new_ws = ws
+        mg1_mask = mask
         analytical = mask
 
     else:  # history_list / history_tree (windowed tail + M/G/1 fallback)
         too_old = params.analytical_enabled & (
-            (pkt_time + proc) < q.window_start)
+            (pkt_time + proc) < ws)
         mg1 = _mg1_delay(q)
-        tail = jnp.maximum(q.queue_time - pkt_time, 0)
+        tail = jnp.maximum(qt - pkt_time, 0)
         delay = jnp.where(too_old, mg1, tail)
         in_window = mask & ~too_old
-        new_qt = jnp.maximum(q.queue_time, pkt_time) + proc
-        q = q.replace(
-            queue_time=jnp.where(in_window, new_qt, q.queue_time),
-            window_start=jnp.where(
-                in_window,
-                jnp.maximum(q.window_start, new_qt - params.history_span),
-                q.window_start),
-        )
-        q = _mg1_update(q, pkt_time, proc, delay, mask)
+        cand_qt = jnp.maximum(qt, pkt_time) + proc
+        new_qt = jnp.where(in_window, cand_qt, qt)
+        new_ws = jnp.where(
+            in_window,
+            jnp.maximum(ws, cand_qt - params.history_span), ws)
+        mg1_mask = mask
         analytical = mask & too_old
 
-    q = q.replace(
-        total_requests=q.total_requests + mask.astype(I64),
-        total_utilized=q.total_utilized + jnp.where(mask, proc, 0),
-        total_delay=q.total_delay + jnp.where(mask, delay, 0),
-        analytical_used=q.analytical_used + analytical.astype(I64),
-    )
-    return q, jnp.where(mask, delay, 0)
+    end = pkt_time + delay + proc
+    new_data = jnp.stack([
+        new_qt,
+        new_ws,
+        jnp.where(mg1_mask, jnp.maximum(newest, end), newest),
+        q.sum_st + jnp.where(mg1_mask, proc, 0),
+        q.sum_st2 + jnp.where(mg1_mask, proc * proc, 0),
+        q.n_arrivals + mg1_mask.astype(I64),
+        q.total_requests + mask.astype(I64),
+        q.total_utilized + jnp.where(mask, proc, 0),
+        q.total_delay + jnp.where(mask, delay, 0),
+        q.analytical_used + analytical.astype(I64),
+    ], axis=1)
+    return q.replace(data=new_data), jnp.where(mask, delay, 0)
 
 
 def scatter_queue_delay(
@@ -253,16 +289,17 @@ def scatter_queue_delay(
     """
     pkt_time = jnp.asarray(pkt_time, I64)
     proc = jnp.maximum(jnp.asarray(processing_time, I64), 1)
-    N = q.queue_time.shape[0]
+    N = q.data.shape[0]
     qid = jnp.where(mask, qid, N - 1).astype(jnp.int32)
 
-    qt = q.queue_time[qid]
+    row = q.data[qid]                               # [L, 10] — ONE gather
+    qt = row[:, COL_QT]
     if params.kind in ("history_list", "history_tree"):
         too_old = params.analytical_enabled & (
-            (pkt_time + proc) < q.window_start[qid])
+            (pkt_time + proc) < row[:, COL_WS])
         # M/G/1 fallback from the queue's running moments (gathered view)
-        mg1 = _mg1_wait(q.n_arrivals[qid], q.sum_st[qid], q.sum_st2[qid],
-                        q.newest_arrival[qid])
+        mg1 = _mg1_wait(row[:, COL_N_ARR], row[:, COL_SUM_ST],
+                        row[:, COL_SUM_ST2], row[:, COL_NEWEST])
         tail = jnp.maximum(qt - pkt_time, 0)
         delay = jnp.where(too_old, mg1, tail)
         in_window = mask & ~too_old
@@ -272,24 +309,29 @@ def scatter_queue_delay(
         too_old = jnp.zeros_like(mask)
 
     # occupancy: scatter-max the arrival then scatter-add every processing
-    end_contrib = jnp.where(in_window, pkt_time, 0)
-    queue_time = q.queue_time.at[qid].max(end_contrib)
-    queue_time = queue_time.at[qid].add(jnp.where(in_window, proc, 0))
-    window_start = q.window_start.at[qid].max(
-        jnp.where(in_window, queue_time[qid] - params.history_span, -(2**62)))
+    data = q.data.at[qid, COL_QT].max(jnp.where(in_window, pkt_time, 0))
+    data = data.at[qid, COL_QT].add(jnp.where(in_window, proc, 0))
+    qt_new = data[qid, COL_QT]
     end = pkt_time + delay + proc
-    q = q.replace(
-        queue_time=queue_time,
-        window_start=window_start,
-        sum_st=q.sum_st.at[qid].add(jnp.where(mask, proc, 0)),
-        sum_st2=q.sum_st2.at[qid].add(jnp.where(mask, proc * proc, 0)),
-        n_arrivals=q.n_arrivals.at[qid].add(mask.astype(I64)),
-        newest_arrival=q.newest_arrival.at[qid].max(
-            jnp.where(mask, end, 0)),
-        total_requests=q.total_requests.at[qid].add(mask.astype(I64)),
-        total_utilized=q.total_utilized.at[qid].add(jnp.where(mask, proc, 0)),
-        total_delay=q.total_delay.at[qid].add(jnp.where(mask, delay, 0)),
-        analytical_used=q.analytical_used.at[qid].add(
-            (mask & too_old).astype(I64)),
-    )
-    return q, jnp.where(mask, delay, 0)
+    # one combined max-scatter for (window_start, newest_arrival) ...
+    max_vals = jnp.stack([
+        jnp.where(in_window, qt_new - params.history_span, -(2**62)),
+        jnp.where(mask, end, 0),
+    ], axis=1)
+    data = data.at[qid[:, None],
+                   jnp.asarray([COL_WS, COL_NEWEST])[None, :]].max(max_vals)
+    # ... and one combined add-scatter for the moments + counters
+    add_vals = jnp.stack([
+        jnp.where(mask, proc, 0),
+        jnp.where(mask, proc * proc, 0),
+        mask.astype(I64),
+        mask.astype(I64),
+        jnp.where(mask, proc, 0),
+        jnp.where(mask, delay, 0),
+        (mask & too_old).astype(I64),
+    ], axis=1)
+    data = data.at[
+        qid[:, None],
+        jnp.asarray([COL_SUM_ST, COL_SUM_ST2, COL_N_ARR, COL_REQS,
+                     COL_UTIL, COL_DELAY, COL_ANA])[None, :]].add(add_vals)
+    return q.replace(data=data), jnp.where(mask, delay, 0)

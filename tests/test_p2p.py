@@ -1,0 +1,116 @@
+"""lax_p2p clock-skew scheme: random pairwise clamping.
+
+Reference `lax_p2p_sync_client.h:13-83` + `carbon_sim.cfg:99-108`: each
+thread periodically picks a random partner and sleeps while it is more
+than `slack` ahead.  In this engine the scheme is a per-iteration advance
+mask (scheduling), not a timing model — sync decisions are
+simulated-time-ordered, so results must be IDENTICAL across schemes; what
+the scheme changes is how far tiles' clocks may drift apart while the
+simulation runs (the reference's motivation: bounding memory growth and
+timing raciness of far-ahead threads).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine.simulator import Simulator
+from graphite_tpu.engine.step import subquantum_iteration
+from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+
+def make_config(n_tiles, scheme, slack_ns=100):
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = false
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+ialu = 1
+imul = 100
+[clock_skew_management]
+scheme = {scheme}
+[clock_skew_management/lax_barrier]
+quantum = 1000
+[clock_skew_management/lax_p2p]
+slack = {slack_ns}
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+def skewed_trace(n_records=400):
+    """Tile 0 runs 1-cycle records, tile 1 100-cycle records: under lax,
+    tile 0 races ~100x ahead."""
+    b0, b1 = TraceBuilder(), TraceBuilder()
+    for _ in range(n_records):
+        b0.instr(Op.IALU)
+        b1.instr(Op.IMUL)
+    return TraceBatch.from_builders([b0, b1])
+
+
+def run_skew_trajectory(sc, batch, iters=300):
+    """Step manually, recording the clock spread between running tiles."""
+    sim = Simulator(sc, batch)
+    step = jax.jit(lambda st: subquantum_iteration(
+        sim.params, sim.device_trace, st, jnp.asarray(2**61, jnp.int64))[0])
+    st = sim.state
+    max_skew = 0
+    for _ in range(iters):
+        st = step(st)
+        done = np.asarray(st.done)
+        if done.all():
+            break
+        clocks = np.asarray(st.core.clock_ps)[~done]
+        if len(clocks) >= 2:
+            max_skew = max(max_skew, int(clocks.max() - clocks.min()))
+    return max_skew
+
+
+def test_p2p_bounds_skew():
+    batch = skewed_trace()
+    slack_ps = 100_000  # 100 ns
+    skew_p2p = run_skew_trajectory(
+        make_config(2, "lax_p2p", slack_ns=100), batch)
+    skew_lax = run_skew_trajectory(make_config(2, "lax"), batch)
+    # p2p: held within slack + one record's cost (100 cycles = 100000 ps)
+    assert skew_p2p <= slack_ps + 100_000, skew_p2p
+    # lax: runs away far beyond the slack
+    assert skew_lax > 4 * (slack_ps + 100_000), skew_lax
+
+
+def test_p2p_results_match_lax():
+    """Deterministic engine: the scheme must not change simulated results
+    (unlike the reference, where scheme-dependent raciness is expected)."""
+    from graphite_tpu.trace import synthetic
+
+    batch = synthetic.message_ring_batch(4, n_rounds=6, compute_per_round=9)
+    res_lax = Simulator(make_config(4, "lax"), batch).run()
+    res_p2p = Simulator(make_config(4, "lax_p2p"), batch).run()
+    res_bar = Simulator(make_config(4, "lax_barrier"), batch).run()
+    np.testing.assert_array_equal(res_lax.clock_ps, res_p2p.clock_ps)
+    np.testing.assert_array_equal(res_lax.clock_ps, res_bar.clock_ps)
+    np.testing.assert_array_equal(res_lax.instruction_count,
+                                  res_p2p.instruction_count)
+
+
+def test_p2p_completes_under_contention():
+    """A mutex workload completes and matches lax under p2p scheduling."""
+    bs = [TraceBuilder() for _ in range(4)]
+    bs[0].mutex_init(0)
+    bs[0].barrier_init(1, 4)
+    for b in bs:
+        b.barrier_wait(1)
+    for r in range(12):
+        t = r % 4
+        bs[t].mutex_lock(0)
+        bs[t].instr(Op.IMUL)
+        bs[t].mutex_unlock(0)
+    batch = TraceBatch.from_builders(bs)
+    res_p2p = Simulator(make_config(4, "lax_p2p"), batch).run()
+    res_lax = Simulator(make_config(4, "lax"), batch).run()
+    np.testing.assert_array_equal(res_lax.clock_ps, res_p2p.clock_ps)
