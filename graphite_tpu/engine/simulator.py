@@ -155,6 +155,7 @@ class Simulator:
         n_mutexes: int = 64,
         n_conds: int = 64,
         mesh=None,
+        stream: bool = False,
     ):
         if isinstance(config, str):
             config = ConfigFile.from_file(config)
@@ -329,7 +330,14 @@ class Simulator:
                 init_volts[None, :], (n_tiles, nd)).copy(),
             errors=jnp.zeros(n_tiles, jnp.int64),
         ))
-        self.device_trace = DeviceTrace.from_batch(trace)
+        # streaming mode keeps the trace host-side; run_streamed() uploads
+        # [T, W] windows on demand (bounded HBM regardless of trace size)
+        self.stream = bool(stream)
+        self.device_trace = None if stream else DeviceTrace.from_batch(trace)
+        if stream and mesh is not None:
+            raise NotImplementedError(
+                "streamed traces are single-chip for now (window uploads "
+                "are not mesh-sharded)")
         if mesh is not None:
             # Shard the tile axis over the device mesh (SURVEY §2.10): the
             # TPU-native form of Graphite's process striping.
@@ -422,6 +430,87 @@ class Simulator:
             for key, value in sorted(self.config.cfg.as_dict().items()):
                 f.write(f"{key} = {value}\n")
         return out_path
+
+    def run_streamed(self, window_records: int = 4096,
+                     max_quanta: int = 1_000_000,
+                     max_windows: int = 1_000_000) -> SimResults:
+        """Like run(), but the trace streams host->HBM in [T, W] windows
+        (the schema's promised streaming mode — `trace/schema.py`; the
+        reference analog is Pin's continuous instruction pipe,
+        `pin/instruction_modeling.cc:13-21`).  Device memory for trace
+        data is bounded by one window regardless of trace length.
+
+        Windows have PER-TILE base records (each lane's window follows
+        its own stream position), so lanes may skew arbitrarily — a
+        leader pausing at its window edge never starves a laggard.  The
+        device loop runs until every lane is done, deadlocked, or paused
+        at its window's end; the host then re-bases every lane's window
+        at its current record and re-enters.  A guessed next window
+        (every lane one full window ahead — the lockstep case) is staged
+        with an async upload while the device crunches, overlapping
+        transfer with compute.
+        """
+        from graphite_tpu.engine.step import run_simulation
+
+        W = int(window_records)
+        batch = self.trace_batch
+        runner = jax.jit(
+            lambda st, tr, base: run_simulation(
+                self.params, tr, st, self.quantum_ps, max_quanta,
+                trace_base=base))
+
+        bases = np.zeros(batch.n_tiles, np.int32)
+        state = self.state
+        window = DeviceTrace.window(batch, bases, W)
+        prefetch_bases = None
+        prefetch = None
+        prefetch_on = True  # lockstep so far; first miss turns it off
+        n_quanta = 0
+        for _ in range(max_windows):
+            out = runner(state, window, jnp.asarray(bases))
+            # overlap: stage the lockstep-guess window during the run —
+            # only while every slide so far matched the guess (a skewed
+            # run would rebuild + re-upload a discarded window each slide)
+            guess = bases + W
+            if prefetch_on and (guess < batch.length).any():
+                prefetch_bases = guess
+                prefetch = DeviceTrace.window(batch, guess, W)
+            else:
+                prefetch_bases = None
+            state, nq_dev, deadlock_dev = out
+            done, idx, deadlock, overflow = jax.device_get(
+                (state.done, state.core.idx, deadlock_dev,
+                 state.net.overflow))
+            n_quanta += int(nq_dev)
+            if bool(overflow):
+                raise MailboxOverflowError(
+                    "a (dst,src) mailbox ring overflowed; re-run with a "
+                    "larger mailbox_depth")
+            if done.all():
+                break
+            if bool(deadlock):
+                blocked = np.flatnonzero(~done).tolist()
+                raise DeadlockError(
+                    f"no progress across a quantum; blocked tiles: "
+                    f"{blocked[:16]}{'...' if len(blocked) > 16 else ''}")
+            new_bases = np.where(done, bases, idx.astype(np.int32))
+            if (new_bases == bases).all():
+                # every lane held position across a full window run —
+                # cannot happen unless the device loop bailed for a
+                # reason the flags above should have caught
+                raise DeadlockError(
+                    "streaming made no progress across a window slide")
+            bases = new_bases
+            hit = (prefetch_bases is not None
+                   and np.array_equal(prefetch_bases, bases))
+            if not hit:
+                prefetch_on = False
+            window = (prefetch if hit
+                      else DeviceTrace.window(batch, bases, W))
+        else:
+            raise RuntimeError(f"exceeded max_windows={max_windows}")
+        self.state = state
+        return self._results_from_state(n_quanta)
 
     def warmup(self, max_quanta: int = 1_000_000) -> None:
         """Compile (and execute once, discarding results) the full runner —

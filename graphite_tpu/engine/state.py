@@ -158,6 +158,31 @@ class DeviceTrace:
             }
         )
 
+    @classmethod
+    def window(cls, batch: TraceBatch, bases: "np.ndarray",
+               length: int) -> "DeviceTrace":
+        """A [T, length] window with PER-TILE start records `bases[t]`,
+        NOP-padded past each stream's end — the unit of host->HBM
+        streaming.  Per-tile bases let lanes skew arbitrarily (a leader
+        pausing at its window edge never forces the window away from a
+        laggard).  Rows are cut host-side so only `length` records per
+        tile ever travel to the device."""
+        import numpy as np
+
+        from graphite_tpu.trace.schema import Op
+
+        L = batch.length
+        cols = bases[:, None] + np.arange(length)[None, :]   # [T, W]
+        valid = cols < L
+        cols = np.minimum(cols, L - 1)
+        fields = {}
+        for f in dataclasses.fields(batch):
+            arr = np.take_along_axis(getattr(batch, f.name), cols, axis=1)
+            if f.name == "op":
+                arr = np.where(valid, arr, np.uint8(Op.NOP))
+            fields[f.name] = jnp.asarray(arr)
+        return cls(**fields)
+
     @property
     def length(self) -> int:
         return self.op.shape[1]

@@ -126,13 +126,28 @@ def subquantum_iteration(
     trace: DeviceTrace,
     state: SimState,
     quantum_end_ps: jax.Array,
+    trace_base: jax.Array | None = None,
 ) -> tuple[SimState, jax.Array]:
-    """Process one trace record per tile; returns (state, tiles_advanced)."""
+    """Process one trace record per tile; returns (state, tiles_advanced).
+
+    With `trace_base` (int32[T]) set, `trace` is a [T, W] WINDOW of the
+    full record stream, row t starting at global record index
+    `trace_base[t]` (host->HBM streaming, the Pin-pipe analog —
+    `pin/instruction_modeling.cc` streams continuously).  Lanes whose
+    global idx has run past their window's end simply pause (wall-time
+    only; clocks and all protocol state carry over) until the host
+    slides their window.
+    """
     T = params.n_tiles
     D = params.mailbox_depth
     core, net, sync = state.core, state.net, state.sync
     tiles = jnp.arange(T, dtype=jnp.int32)
-    idx = jnp.minimum(core.idx, trace.length - 1)
+    if trace_base is None:
+        idx = jnp.minimum(core.idx, trace.length - 1)
+        in_window = None
+    else:
+        idx = jnp.clip(core.idx - trace_base, 0, trace.length - 1)
+        in_window = core.idx < trace_base + trace.length
 
     # Record fetch: per-row gathers on the [T, L] trace cost ~0.25 ms each
     # on TPU (gather lowers poorly), so when every tile is at the SAME
@@ -164,8 +179,15 @@ def subquantum_iteration(
     dyn_ps = fetched[5]
 
     enabled = state.models_enabled
-    done = state.done | (op == Op.NOP) | (op == Op.THREAD_EXIT)
+    stream_end = (op == Op.NOP) | (op == Op.THREAD_EXIT)
+    if in_window is not None:
+        # a paused lane's fetched record is the clipped window edge —
+        # it must neither latch done nor execute
+        stream_end = stream_end & in_window
+    done = state.done | stream_end
     active = (~done) & (core.clock_ps < quantum_end_ps)
+    if in_window is not None:
+        active = active & in_window
 
     # lax_p2p random pairwise clamping (`lax_p2p_sync_client.h:13-83`):
     # each tile draws a pseudorandom partner this round and holds if it is
@@ -635,9 +657,18 @@ def subquantum_iteration(
     # --- JOIN ------------------------------------------------------------
     def _join_block(_):
         join_target = jnp.clip(aux0, 0, T - 1)
-        target_idx = jnp.minimum(core.idx[join_target], trace.length - 1)
+        if trace_base is None:
+            target_idx = jnp.minimum(core.idx[join_target], trace.length - 1)
+            target_in_win = True
+        else:
+            tb = trace_base[join_target]
+            target_idx = jnp.clip(core.idx[join_target] - tb,
+                                  0, trace.length - 1)
+            # a paused target's edge record must not read as THREAD_EXIT
+            target_in_win = core.idx[join_target] < (tb + trace.length)
         target_done = state.done[join_target] | (
-            trace.op[join_target, target_idx] == Op.THREAD_EXIT
+            target_in_win
+            & (trace.op[join_target, target_idx] == Op.THREAD_EXIT)
         )
         join_now = active & is_join & target_done
         join_time = jnp.maximum(core.clock_ps, core.clock_ps[join_target])
@@ -876,14 +907,15 @@ def subquantum_iteration(
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 
 
-def _quantum_loop(params, trace, state, qend):
+def _quantum_loop(params, trace, state, qend, trace_base=None):
     """Blocks of `inner_block` iterations until no tile makes progress.
     Returns (state, total_progress)."""
 
     def block(state, progress):
         def body(carry, _):
             st, prog = carry
-            st, adv = subquantum_iteration(params, trace, st, qend)
+            st, adv = subquantum_iteration(params, trace, st, qend,
+                                           trace_base)
             return (st, prog + adv), None
 
         (state, progress), _ = lax.scan(
@@ -930,6 +962,7 @@ def run_simulation(
     state: SimState,
     quantum_ps: int | None,
     max_quanta: int = 1_000_000,
+    trace_base: jax.Array | None = None,
 ):
     """The whole simulation as ONE compiled region: an outer while_loop over
     lax-barrier quanta (the MCP barrier loop, `lax_barrier_sync_server.h`)
@@ -952,16 +985,17 @@ def run_simulation(
         return (clock // qps + 1) * qps
 
     def cond(carry):
-        st, qend, n, deadlock = carry
+        st, qend, n, deadlock, stalled = carry
         return (
             ~jnp.all(st.done)
             & ~st.net.overflow
             & ~deadlock
+            & ~stalled
             & (n < max_quanta)
         )
 
     def body(carry):
-        st, prev_qend, n, deadlock = carry
+        st, prev_qend, n, deadlock, stalled = carry
         clocks = st.core.clock_ps
         not_done = ~st.done
         min_pending = jnp.min(jnp.where(not_done, clocks, jnp.asarray(2**62, I64)))
@@ -969,12 +1003,21 @@ def run_simulation(
             qend = INF_QEND
         else:
             qend = jnp.maximum(prev_qend + qps, next_boundary(min_pending))
-        st2, progress = _quantum_loop(params, trace, st, qend)
+        st2, progress = _quantum_loop(params, trace, st, qend, trace_base)
         # Zero progress: if some non-done tile sits beyond qend (it crossed
         # the boundary executing one long record), jump the window up to it
         # — blocked peers may wait on its future sends.  Only when every
         # non-done tile was already eligible is this a genuine deadlock.
         zero = (progress == 0) & jnp.any(~st2.done)
+        if trace_base is not None:
+            # streaming: lanes past the window end are merely paused;
+            # zero progress with a paused lane returns to the host for a
+            # window slide instead of flagging deadlock
+            paused = jnp.any(
+                ~st2.done
+                & (st2.core.idx >= trace_base + trace.length))
+        else:
+            paused = jnp.asarray(False)
         if qps is not None:
             ahead_clock = jnp.min(jnp.where(
                 ~st2.done & (st2.core.clock_ps >= qend),
@@ -982,16 +1025,18 @@ def run_simulation(
             have_ahead = ahead_clock < 2**62
             qend_next = jnp.where(
                 zero & have_ahead, next_boundary(ahead_clock) - qps, qend)
-            deadlock = zero & ~have_ahead
+            deadlock = zero & ~have_ahead & ~paused
+            stalled = zero & ~have_ahead & paused
         else:
             qend_next = qend
-            deadlock = zero
-        return st2, qend_next, n + 1, deadlock
+            deadlock = zero & ~paused
+            stalled = zero & paused
+        return st2, qend_next, n + 1, deadlock, stalled
 
-    state, _, n_quanta, deadlock = lax.while_loop(
+    state, _, n_quanta, deadlock, _ = lax.while_loop(
         cond, body,
         (state, jnp.asarray(0, I64), jnp.asarray(0, jnp.int32),
-         jnp.asarray(False)))
+         jnp.asarray(False), jnp.asarray(False)))
     return state, n_quanta, deadlock
 
 

@@ -56,6 +56,7 @@ class SyscallServer:
         # so an unlinked file stays readable/writable until close (POSIX)
         self._fds: dict[int, list] = {}
         self._next_fd = 3  # 0/1/2 reserved (stdio pass-through)
+        self._cwd = "/"
         self.counts: dict[str, int] = {}
 
     def _count(self, name: str) -> None:
@@ -143,6 +144,88 @@ class SyscallServer:
             self._count("stat")
             f = self._files.get(path)
             return len(f.data) if f is not None else -2
+
+    # ---- the remaining marshalled surface (`syscall_model.cc:132-244`):
+    # fstat/lstat, pipe, writev/readahead, getcwd/rmdir, ioctl,
+    # clock_gettime.  futex/affinity land in the sync/thread machinery
+    # (engine sync tables + ThreadScheduler), getpid is tile-local.
+
+    def fstat_size(self, fd: int) -> int:
+        with self._lock:
+            self._count("fstat")
+            ent = self._fds.get(fd)
+            return len(ent[0].data) if ent is not None else -9
+
+    def lstat_size(self, path: str) -> int:
+        # the in-memory FS has no symlinks: lstat == stat
+        with self._lock:
+            self._count("lstat")
+            f = self._files.get(path)
+            return len(f.data) if f is not None else -2
+
+    def pipe(self) -> tuple[int, int]:
+        """fd pair over one shared byte store (read end consumes)."""
+        with self._lock:
+            self._count("pipe")
+            f = SimFile()
+            rd, wr = self._next_fd, self._next_fd + 1
+            self._next_fd += 2
+            self._fds[rd] = [f, 0, O_RDONLY]
+            self._fds[wr] = [f, 0, O_WRONLY | O_APPEND]
+            return rd, wr
+
+    def writev(self, fd: int, chunks: list[bytes]) -> int:
+        """Vectored write — ATOMIC like POSIX writev (one lock hold, so
+        concurrent writev chunks can never interleave)."""
+        with self._lock:
+            self._count("writev")
+            ent = self._fds.get(fd)
+            if ent is None:
+                return -9
+            f, pos, flags = ent
+            if (flags & 0x3) == O_RDONLY:
+                return -9
+            data = b"".join(bytes(c) for c in chunks)
+            buf = f.data
+            if len(buf) < pos + len(data):
+                buf.extend(b"\x00" * (pos + len(data) - len(buf)))
+            buf[pos:pos + len(data)] = data
+            ent[1] = pos + len(data)
+            return len(data)
+
+    def readahead(self, fd: int, nbytes: int) -> int:
+        with self._lock:
+            self._count("readahead")
+            return 0 if fd in self._fds else -9  # hint only: no data moves
+
+    def getcwd(self) -> str:
+        with self._lock:
+            self._count("getcwd")
+            return self._cwd
+
+    def rmdir(self, path: str) -> int:
+        """The flat FS models directories as path prefixes: rmdir fails
+        -ENOTEMPTY while any file lives under the prefix, else succeeds."""
+        with self._lock:
+            self._count("rmdir")
+            prefix = path.rstrip("/") + "/"
+            if any(p.startswith(prefix) for p in self._files):
+                return -39  # -ENOTEMPTY
+            return 0
+
+    def ioctl(self, fd: int, request: int) -> int:
+        with self._lock:
+            self._count("ioctl")
+            if fd not in self._fds and fd > 2:
+                return -9
+            return -25  # -ENOTTY: no terminal devices in the sim FS
+
+    def clock_gettime(self, sim_time_ns: int) -> tuple[int, int]:
+        """CLOCK_* read returns SIMULATED time (the MCP answers with the
+        simulation clock, keeping target time deterministic)."""
+        with self._lock:
+            self._count("clock_gettime")
+            return sim_time_ns // 1_000_000_000, sim_time_ns % 1_000_000_000
 
 
 class VMManager:

@@ -205,3 +205,173 @@ BENCHMARKS = {
     "blackscholes": blackscholes_trace,
     "canneal": canneal_trace,
 }
+
+
+def lu_trace(n_tiles: int, blocks_per_side: int | None = None,
+             block: int = 16, use_memory: bool = False) -> TraceBatch:
+    """Blocked dense LU factorization (SPLASH-2 `kernels/lu/lu.C`):
+    block-cyclic ownership; step k factorizes the diagonal block
+    (~B^3/3 fp), updates the k-th row/column perimeter blocks (~B^3),
+    then the interior trailing submatrix (~2B^3 per block), with a
+    barrier between the three sub-phases (lu.C OneSolve loop).  With
+    use_memory, perimeter/interior owners load the diagonal block's
+    lines — the read-sharing the shared-memory original exhibits."""
+    if blocks_per_side is None:
+        blocks_per_side = max(2, int(np.sqrt(n_tiles)))
+    N = blocks_per_side
+    fp3 = block * block * block
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    builders[0].barrier_init(_BAR, n_tiles)
+
+    def owner(i, j):
+        return (i * N + j) % n_tiles
+
+    for k in range(N):
+        diag = owner(k, k)
+        builders[diag].bblock(fp3 // 3, fp3 // 3)
+        _barrier(builders)
+        diag_base = (k * N + k) * block * block * 8
+        for j in range(k + 1, N):
+            for (bi, bj) in ((k, j), (j, k)):
+                t = owner(bi, bj)
+                if use_memory:
+                    for ln in range(min(block, 8)):
+                        builders[t].load(diag_base + ln * 64)
+                builders[t].bblock(fp3, fp3)
+        _barrier(builders)
+        for i in range(k + 1, N):
+            for j in range(k + 1, N):
+                builders[owner(i, j)].bblock(2 * fp3, 2 * fp3)
+        _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+def ocean_trace(n_tiles: int, rows_per_tile: int = 64, cols: int = 64,
+                iterations: int = 4) -> TraceBatch:
+    """Ocean current simulation (SPLASH-2 `apps/ocean`): red-black
+    Gauss-Seidel relaxation over a partitioned grid — each iteration a
+    ~7-fp-op 5-point stencil sweep over the tile's rows, boundary-row
+    exchange with the up/down neighbors, and a barrier (ocean's
+    relax/jacobcalc loops)."""
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    builders[0].barrier_init(_BAR, n_tiles)
+    sweep = rows_per_tile * cols * 7
+    row_bytes = cols * 8
+    for it in range(iterations):
+        for t, b in enumerate(builders):
+            b.bblock(sweep, sweep)
+        # boundary exchange: down then up (edge tiles skip the absent side)
+        for t, b in enumerate(builders):
+            if t + 1 < n_tiles:
+                b.send(t + 1, row_bytes)
+            if t > 0:
+                b.send(t - 1, row_bytes)
+        for t, b in enumerate(builders):
+            if t > 0:
+                b.recv(t - 1, row_bytes)
+            if t + 1 < n_tiles:
+                b.recv(t + 1, row_bytes)
+        _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+def barnes_trace(n_tiles: int, bodies_per_tile: int = 64,
+                 steps: int = 2, seed: int = 7,
+                 use_memory: bool = False) -> TraceBatch:
+    """Barnes-Hut N-body (SPLASH-2 `apps/barnes`): per timestep a
+    tree-build phase (integer-heavy, irregular — maketree) behind a
+    barrier, then force computation per body (~log N cell visits x ~20 fp
+    ops — hackgrav) with irregular loads over the shared tree, then a
+    position update sweep (grav.C/code.C stepsystem structure)."""
+    rng = np.random.default_rng(seed)
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    builders[0].barrier_init(_BAR, n_tiles)
+    logn = max(1, int(np.log2(max(2, n_tiles * bodies_per_tile))))
+    for s in range(steps):
+        for b in builders:
+            b.bblock(bodies_per_tile * 8, bodies_per_tile * 8)  # maketree
+        _barrier(builders)
+        for t, b in enumerate(builders):
+            for body in range(min(bodies_per_tile, 16)):
+                if use_memory:
+                    # ~logn tree-cell touches over a shared footprint
+                    for v in range(min(logn, 4)):
+                        b.load(int(rng.integers(1 << 14)) * 64)
+                b.bblock(logn * 20, logn * 20)
+            rem = bodies_per_tile - min(bodies_per_tile, 16)
+            if rem > 0:
+                b.bblock(rem * logn * 20, rem * logn * 20)
+        _barrier(builders)
+        for b in builders:
+            b.bblock(bodies_per_tile * 6, bodies_per_tile * 6)  # advance
+        _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+def water_nsquared_trace(n_tiles: int, molecules_per_tile: int = 32,
+                         steps: int = 2) -> TraceBatch:
+    """Water-NSquared molecular dynamics (SPLASH-2
+    `apps/water-nsquared`): per timestep intra-molecule force updates,
+    the O(n^2/2) inter-molecule pair sweep (~250 fp ops per pair —
+    interf), and a mutex-protected global virial/energy accumulation
+    (water.C mdmain loop; the global sum uses a lock in the original)."""
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    builders[0].barrier_init(_BAR, n_tiles)
+    builders[0].mutex_init(0)
+    _barrier(builders)
+    n_total = molecules_per_tile * n_tiles
+    pairs = molecules_per_tile * max(1, n_total // 2) // 64
+    for s in range(steps):
+        for b in builders:
+            b.bblock(molecules_per_tile * 40, molecules_per_tile * 40)
+        _barrier(builders)
+        for b in builders:
+            b.bblock(pairs * 250, pairs * 250)
+        for b in builders:
+            b.mutex_lock(0)
+            b.bblock(20, 20)
+            b.mutex_unlock(0)
+        _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+def cholesky_trace(n_tiles: int, supernodes: int | None = None,
+                   block: int = 16) -> TraceBatch:
+    """Sparse Cholesky factorization (SPLASH-2 `kernels/cholesky`):
+    supernode task queue — each supernode's owner factorizes it
+    (~B^3/3 fp) and sends updates to the owners of affected later
+    supernodes (task-queue puts), which fold them in (~B^2 fp per
+    update).  The skeleton serializes dependency chains with
+    point-to-point messages instead of the original's task-queue locks."""
+    if supernodes is None:
+        supernodes = max(4, n_tiles // 2)
+    fp3 = block * block * block
+    fp2 = block * block
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    builders[0].barrier_init(_BAR, n_tiles)
+    for sn in range(supernodes):
+        t = sn % n_tiles
+        builders[t].bblock(fp3 // 3, fp3 // 3)
+        # updates fan out to the next up-to-3 supernodes' owners
+        targets = [(sn + d) % supernodes for d in (1, 2, 3)
+                   if sn + d < supernodes]
+        for d in targets:
+            to = d % n_tiles
+            if to != t:
+                builders[t].send(to, fp2 * 8)
+        for d in targets:
+            to = d % n_tiles
+            if to != t:
+                builders[to].recv(t, fp2 * 8)
+                builders[to].bblock(fp2 * 4, fp2 * 4)
+    _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+BENCHMARKS.update({
+    "lu": lu_trace,
+    "ocean": ocean_trace,
+    "barnes": barnes_trace,
+    "water-nsquared": water_nsquared_trace,
+    "cholesky": cholesky_trace,
+})

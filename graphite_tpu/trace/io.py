@@ -1,0 +1,95 @@
+"""External trace exchange: TraceBatch <-> .npz files.
+
+The reference's frontend is Pin capturing a live binary
+(`pin/instruction_modeling.cc`); on TPU hosts the frontend is a trace
+producer, and this module is the ingestion point for traces captured by
+ANY external tool (a Pin tool, QEMU plugin, DynamoRIO client, ...): dump
+the record columns as numpy arrays in an .npz and replay them through
+the full timing stack.
+
+Format: one array per `TraceBatch` field (schema in `trace/schema.py`),
+each shaped [n_tiles, length], plus a `schema_version` scalar.  Missing
+optional fields default to zeros (e.g. a capture without register
+dependencies still replays on the simple core model).  `op` is required.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from graphite_tpu.trace.schema import Op, TraceBatch
+
+SCHEMA_VERSION = 1
+
+
+def save_trace_npz(path: str, batch: TraceBatch) -> None:
+    """Write a TraceBatch as a compressed .npz."""
+    arrays = {
+        f.name: getattr(batch, f.name) for f in dataclasses.fields(batch)
+    }
+    np.savez_compressed(path, schema_version=SCHEMA_VERSION, **arrays)
+
+
+def load_trace_npz(path: str) -> TraceBatch:
+    """Read an externally captured trace into a TraceBatch.
+
+    Validates shape agreement and the op range; pads absent optional
+    columns with zeros so minimal captures (op + flags + addresses)
+    replay directly.
+    """
+    with np.load(path) as data:
+        version = int(data["schema_version"]) if "schema_version" in data \
+            else 1
+        if version > SCHEMA_VERSION:
+            raise ValueError(
+                f"trace {path!r} has schema_version {version}; this build "
+                f"reads <= {SCHEMA_VERSION}")
+        if "op" not in data:
+            raise ValueError(f"trace {path!r} has no 'op' array")
+        op = np.asarray(data["op"], np.uint8)
+        if op.ndim != 2:
+            raise ValueError(f"'op' must be [n_tiles, length], got "
+                             f"{op.shape}")
+        known = {int(v) for v in Op}
+        bad = set(np.unique(op).tolist()) - known
+        if bad:
+            raise ValueError(f"trace {path!r} contains unknown opcodes "
+                             f"{sorted(bad)[:8]}")
+        # schema dtypes (TraceBuilder's layout) — present fields are
+        # coerced so mistyped external captures (float64 dyn_ps, int64
+        # addresses...) cannot flow into the jitted engine
+        dtypes = {
+            "flags": np.uint8, "pc": np.uint32,
+            "addr0": np.uint32, "addr1": np.uint32,
+            "size0": np.uint8, "size1": np.uint8,
+            "aux0": np.int32, "aux1": np.int32,
+            "dyn_ps": np.int64,
+            "rreg0": np.uint16, "rreg1": np.uint16,
+            "wreg": np.uint16,
+        }
+        fields = {}
+        for f in dataclasses.fields(TraceBatch):
+            if f.name == "op":
+                fields["op"] = op
+                continue
+            dtype = dtypes[f.name]
+            if f.name in data:
+                arr = np.asarray(data[f.name])
+                if arr.shape != op.shape:
+                    raise ValueError(
+                        f"trace {path!r}: '{f.name}' shape {arr.shape} != "
+                        f"op shape {op.shape}")
+                if arr.dtype != dtype:
+                    cast = arr.astype(dtype)
+                    if not np.array_equal(
+                            cast.astype(arr.dtype, copy=False), arr):
+                        raise ValueError(
+                            f"trace {path!r}: '{f.name}' values do not fit "
+                            f"{np.dtype(dtype).name}")
+                    arr = cast
+            else:
+                arr = np.zeros(op.shape, dtype)
+            fields[f.name] = arr
+        return TraceBatch(**fields)

@@ -201,12 +201,15 @@ class TraceBatch:
         return self.op.shape[1]
 
     def save(self, path: str) -> None:
-        np.savez_compressed(path, **dataclasses.asdict(self))
+        from graphite_tpu.trace.io import save_trace_npz
+
+        save_trace_npz(path, self)
 
     @classmethod
     def load(cls, path: str) -> "TraceBatch":
-        with np.load(path) as data:
-            return cls(**{name: data[name] for name, _ in _FIELDS})
+        from graphite_tpu.trace.io import load_trace_npz
+
+        return load_trace_npz(path)
 
     @classmethod
     def from_builders(cls, builders: "list[TraceBuilder]") -> "TraceBatch":

@@ -87,13 +87,17 @@ void sram_energy(int node_nm, double voltage, long size_bytes,
   double s = tech_scale(node_nm);
   double kb = size_bytes / 1024.0;
   double p = ports > 0 ? ports : 1;
-  // 45nm anchors: ~0.45 mm^2 and ~55pJ read for a 64KB 4-way cache,
-  // sublinear capacity scaling for energy (bitline segmentation ~ sqrt)
+  // 45nm anchor calibrated against the published CACTI-derived figures
+  // collected in Horowitz, "Computing's Energy Problem" (ISSCC 2014):
+  // ~10 pJ for an 8KB cache read, ~20 pJ for 32KB, ~100 pJ for 1MB at
+  // 45nm.  With the sqrt capacity scaling below (bitline segmentation),
+  // a 21e-12 coefficient at the 64KB/4-way anchor lands 8KB..1MB reads
+  // within ~15% of those anchors (calibration table in PERF.md).
   out->area_mm2 = 0.0070 * kb * p * s * s;
   double cap_factor = std::sqrt(kb / 64.0);
   double assoc_factor = 1.0 + 0.08 * (associativity > 0 ? associativity : 1);
   out->read_energy_j =
-      55e-12 * cap_factor * assoc_factor * dyn_scale(node_nm, voltage);
+      21e-12 * cap_factor * assoc_factor * dyn_scale(node_nm, voltage);
   out->write_energy_j = 1.15 * out->read_energy_j;
   out->tag_energy_j = 0.18 * out->read_energy_j;
   out->leakage_power_w = out->area_mm2 * leak_density_w_per_mm2(node_nm) *

@@ -200,3 +200,47 @@ class TestDvfsGetTiming:
 
         res = Simulator(sc, TraceBatch.from_builders([b])).run()
         assert res.clock_ps[0] == 4_000
+
+
+class TestWideSurface:
+    """The rest of the reference-marshalled surface
+    (`syscall_model.cc:132-244`)."""
+
+    def test_pipe_roundtrip(self):
+        s = SyscallServer()
+        rd, wr = s.pipe()
+        assert s.write(wr, b"hello") == 5
+        assert s.read(rd, 5) == b"hello"
+        assert s.read(rd, 5) == b""      # drained
+        assert s.close(rd) == 0 and s.close(wr) == 0
+
+    def test_fstat_lstat(self):
+        s = SyscallServer()
+        fd = s.open("/a", O_CREAT | 0x1)
+        s.write(fd, b"abc")
+        assert s.fstat_size(fd) == 3
+        assert s.lstat_size("/a") == 3
+        assert s.fstat_size(99) == -9
+        assert s.lstat_size("/nope") == -2
+
+    def test_writev_readahead(self):
+        s = SyscallServer()
+        fd = s.open("/v", O_CREAT | 0x1)
+        assert s.writev(fd, [b"ab", b"cd", b"e"]) == 5
+        assert s.fstat_size(fd) == 5
+        assert s.readahead(fd, 1024) == 0
+        assert s.readahead(1234, 1) == -9
+
+    def test_getcwd_rmdir_ioctl_clock(self):
+        s = SyscallServer()
+        assert s.getcwd() == "/"
+        s.open("/dir/x", O_CREAT)
+        assert s.rmdir("/dir") == -39    # not empty
+        s.unlink("/dir/x")
+        assert s.rmdir("/dir") == 0
+        assert s.ioctl(0, 0x5401) == -25  # TCGETS on no-tty
+        sec, ns = s.clock_gettime(2_500_000_123)
+        assert (sec, ns) == (2, 500_000_123)
+        # every call is counted like the reference's per-syscall stats
+        for name in ("pipe", "getcwd", "rmdir", "ioctl", "clock_gettime"):
+            assert s.counts.get(name, 0) >= 0

@@ -164,3 +164,28 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+class TestPowerTrace:
+    def test_periodic_power_sampling(self, tmp_path):
+        """[runtime_energy_modeling/power_trace] writes per-interval
+        per-tile energy:power rows (`tile_energy_monitor.h:29`)."""
+        extra = """
+[statistics_trace]
+enabled = false
+sampling_interval = 2000
+[runtime_energy_modeling/power_trace]
+enabled = true
+"""
+        sc = make_config(extra=extra)
+        sim = Simulator(sc, mem_workload())
+        stats = StatisticsManager(sim, output_dir=str(tmp_path))
+        stats.run()
+        rows = (tmp_path / "power.trace").read_text().strip().splitlines()
+        assert len(rows) >= 1
+        t, first = rows[-1].split(" ", 1)
+        cells = first.split()
+        assert len(cells) == sim.params.n_tiles
+        e, p = cells[0].split(":")
+        assert float(e) > 0.0   # cumulative energy
+        assert float(p) >= 0.0  # interval power
