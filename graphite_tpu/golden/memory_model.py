@@ -183,12 +183,17 @@ class GoldenMemory:
         T = mp.n_tiles
         self.freq = [int(f) for f in freq_mhz] if hasattr(
             freq_mhz, "__len__") else [int(freq_mhz)] * T
-        self.l1i = [_Cache(mp.l1i.num_sets, mp.l1i.num_ways,
-                           mp.l1i.replacement) for _ in range(T)]
-        self.l1d = [_Cache(mp.l1d.num_sets, mp.l1d.num_ways,
-                           mp.l1d.replacement) for _ in range(T)]
-        self.l2 = [_Cache(mp.l2.num_sets, mp.l2.num_ways,
-                          mp.l2.replacement) for _ in range(T)]
+        def geom(lp, t):
+            s = lp.tile_sets[t] if lp.tile_sets is not None else lp.num_sets
+            w = lp.tile_ways[t] if lp.tile_ways is not None else lp.num_ways
+            return s, w
+
+        self.l1i = [_Cache(*geom(mp.l1i, t), mp.l1i.replacement)
+                    for t in range(T)]
+        self.l1d = [_Cache(*geom(mp.l1d, t), mp.l1d.replacement)
+                    for t in range(T)]
+        self.l2 = [_Cache(*geom(mp.l2, t), mp.l2.replacement)
+                   for t in range(T)]
         # which L1 caches each L2 entry ((set, way) -> MOD_L1I/MOD_L1D/0)
         self.l2_cloc = [dict() for _ in range(T)]
         self.homes = {h: _Home(mp.dir_sets, mp.dir_ways)
@@ -206,8 +211,11 @@ class GoldenMemory:
 
     # -- timing helpers ----------------------------------------------------
 
-    def _cc(self, t: int, n: int, enabled: bool) -> int:
-        return _cycles_to_ps(n, self.freq[t]) if enabled else 0
+    def _cc(self, t: int, n, enabled: bool) -> int:
+        # n may be per-tile (np array) under heterogeneous geometries
+        if hasattr(n, "__len__"):
+            n = int(n[t])
+        return _cycles_to_ps(int(n), self.freq[t]) if enabled else 0
 
     def _dir_ps(self, n: int, enabled: bool) -> int:
         return _cycles_to_ps(n, self.mp.dir_freq_mhz) if enabled else 0
@@ -288,14 +296,14 @@ class GoldenMemory:
         done = (ftime + self._sync(s, MOD_L2, MOD_NET_MEM, enabled) + l2_cost
                 + self._cc(s, mp.l1d.tags_cycles, enabled)
                 + 2 * self._sync(s, MOD_L1D, MOD_L2, enabled))
-        cloc = self.l2_cloc[s].get((line % mp.l2.num_sets, way), 0)
+        cloc = self.l2_cloc[s].get((line % self.l2[s].sets, way), 0)
         if kind in ("inv", "flush"):
             if cloc == MOD_L1I:
                 self.l1i[s].invalidate(line)
             elif cloc == MOD_L1D:
                 self.l1d[s].invalidate(line)
             self.l2[s].set_state(line, way, INVALID)
-            self.l2_cloc[s].pop((line % mp.l2.num_sets, way), None)
+            self.l2_cloc[s].pop((line % self.l2[s].sets, way), None)
             if enabled and kind == "inv":
                 self.counters["invalidations"][s] += 1
         else:  # wb: downgrade, keep the line
@@ -591,7 +599,7 @@ class GoldenMemory:
         if l2_hit and write and l2_st in (SHARED, OWNED):
             dirty = l2_st == OWNED
             l2.set_state(line, l2_way, INVALID)
-            self.l2_cloc[t].pop((line % mp.l2.num_sets, l2_way), None)
+            self.l2_cloc[t].pop((line % self.l2[t].sets, l2_way), None)
             self._apply_eviction(
                 t, line, dirty,
                 req_send + self._net_ps(t, home, mp.req_bits, enabled),
@@ -612,7 +620,7 @@ class GoldenMemory:
             v_home = self._home_of(v_line)
             e_lat = self._net_ps(
                 t, v_home, mp.rep_bits if v_dirty else mp.req_bits, enabled)
-            self.l2_cloc[t].pop((v_line % mp.l2.num_sets, v_way), None)
+            self.l2_cloc[t].pop((v_line % self.l2[t].sets, v_way), None)
             self._apply_eviction(t, v_line, v_dirty, fill_l2 + e_lat,
                                  enabled)
         l2.insert_at(line, v_way, new_state)
@@ -630,9 +638,9 @@ class GoldenMemory:
         if v_valid:
             vh, vw, _ = self.l2[t].lookup(v_line)
             if vh:
-                self.l2_cloc[t].pop((v_line % mp.l2.num_sets, vw), None)
+                self.l2_cloc[t].pop((v_line % self.l2[t].sets, vw), None)
         l1.insert_at(line, way, st)
-        self.l2_cloc[t][(line % mp.l2.num_sets, l2_way)] = (
+        self.l2_cloc[t][(line % self.l2[t].sets, l2_way)] = (
             MOD_L1I if is_icache else MOD_L1D)
 
     # -- public entry ------------------------------------------------------

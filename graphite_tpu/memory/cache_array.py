@@ -118,10 +118,14 @@ class CacheRow:
     sets: jax.Array  # int32[T]
 
 
-def gather_row(cache: CacheArrays, line: jax.Array) -> CacheRow:
+def gather_row(cache: CacheArrays, line: jax.Array,
+               sets_mod=None) -> CacheRow:
+    """`sets_mod`: per-tile set count (int or int32[T]) for heterogeneous
+    geometries; defaults to the array's (max) set dimension."""
     T = cache.meta.shape[0]
     tiles = jnp.arange(T, dtype=jnp.int32)
-    sets = (line % cache.num_sets).astype(jnp.int32)
+    mod = cache.num_sets if sets_mod is None else jnp.asarray(sets_mod)
+    sets = (line % mod).astype(jnp.int32)
     meta = cache.meta[tiles, sets]                 # [T, W] — ONE gather
     tag, st, lru = _unpack(meta)
     return CacheRow(tag=tag, st=st.astype(jnp.int32), lru=lru, sets=sets)
@@ -173,7 +177,7 @@ def row_invalidate(row: CacheRow, line: jax.Array,
     return row_set_state(row, way, INVALID, mask & hit)
 
 
-def row_pick_victim(row: CacheRow, policy: str = "lru"):
+def row_pick_victim(row: CacheRow, policy: str = "lru", ways=None):
     """(way, victim_valid, victim_line, victim_state).
 
     lru (`lru_replacement_policy.cc`): first invalid way, else the
@@ -181,8 +185,18 @@ def row_pick_victim(row: CacheRow, policy: str = "lru"):
     set's rotating index regardless of validity — the rank permutation
     doubles as the rotation state (ranks only move on insertion, so the
     max-rank way IS the current index and inserting rotates it), and
-    victim_valid reflects whether the chosen way held a live line."""
-    lru_way = jnp.argmax(row.lru, axis=1)
+    victim_valid reflects whether the chosen way held a live line.
+
+    `ways` (int32[T] or None): per-tile way count for heterogeneous
+    geometries — padded ways beyond it are never picked (their initial
+    ranks sit above every usable rank and are masked here; touches never
+    move them)."""
+    usable = None
+    if ways is not None:
+        usable = (jnp.arange(row.lru.shape[1], dtype=jnp.int32)[None, :]
+                  < jnp.asarray(ways)[:, None])
+    lru_eff = row.lru if usable is None else jnp.where(usable, row.lru, -1)
+    lru_way = jnp.argmax(lru_eff, axis=1)
     if policy == "round_robin":
         way = lru_way.astype(jnp.int32)
         victim_state = jnp.take_along_axis(
@@ -190,6 +204,8 @@ def row_pick_victim(row: CacheRow, policy: str = "lru"):
         victim_valid = victim_state != INVALID
     else:
         inv = row.st == INVALID
+        if usable is not None:
+            inv = inv & usable
         any_inv = inv.any(axis=1)
         inv_way = jnp.argmax(inv, axis=1)
         way = jnp.where(any_inv, inv_way, lru_way).astype(jnp.int32)
@@ -220,55 +236,58 @@ def row_insert(row: CacheRow, line: jax.Array, way: jax.Array, new_state,
 # element-level API (one gather/scatter per call) — shared-L2 engine, tests
 
 
-def lookup(cache: CacheArrays, line: jax.Array):
+def lookup(cache: CacheArrays, line: jax.Array, sets_mod=None):
     """Per-lane lookup: (hit bool[T], way int32[T], state uint8[T]).
 
     `Cache::getCacheLineInfo` (`cache.h:92`) vectorized: way is valid only
     where hit; state is INVALID where miss.
     """
-    row = gather_row(cache, line)
+    row = gather_row(cache, line, sets_mod)
     return row_lookup(row, line)
 
 
 def touch_lru(cache: CacheArrays, line: jax.Array, way: jax.Array,
-              mask: jax.Array) -> CacheArrays:
+              mask: jax.Array, sets_mod=None) -> CacheArrays:
     """Make `way` the MRU of its set where mask (LRU ranks shift up)."""
-    row = gather_row(cache, line)
+    row = gather_row(cache, line, sets_mod)
     return scatter_row(cache, row_touch(row, way, mask))
 
 
 def set_state(cache: CacheArrays, line: jax.Array, way: jax.Array,
-              new_state: jax.Array, mask: jax.Array) -> CacheArrays:
+              new_state: jax.Array, mask: jax.Array,
+              sets_mod=None) -> CacheArrays:
     """Set the state of (line, way) where mask (`Cache::setCacheLineInfo`)."""
-    row = gather_row(cache, line)
+    row = gather_row(cache, line, sets_mod)
     return scatter_row(cache, row_set_state(row, way, new_state, mask))
 
 
 def invalidate(cache: CacheArrays, line: jax.Array,
-               mask: jax.Array) -> CacheArrays:
+               mask: jax.Array, sets_mod=None) -> CacheArrays:
     """Invalidate `line` where mask & present (`Cache::invalidateCacheLine`)."""
-    row = gather_row(cache, line)
+    row = gather_row(cache, line, sets_mod)
     hit, way, _ = row_lookup(row, line)
     m = mask & hit
     return scatter_row(cache, row_set_state(row, way, INVALID, m))
 
 
-def pick_victim(cache: CacheArrays, line: jax.Array, policy: str = "lru"):
+def pick_victim(cache: CacheArrays, line: jax.Array, policy: str = "lru",
+                sets_mod=None, ways=None):
     """Victim way per lane (see row_pick_victim for policy semantics).
 
     Returns (way int32[T], victim_valid bool[T], victim_line int32[T],
     victim_state uint8[T]).
     """
-    row = gather_row(cache, line)
-    return row_pick_victim(row, policy)
+    row = gather_row(cache, line, sets_mod)
+    return row_pick_victim(row, policy, ways)
 
 
 def insert_at(cache: CacheArrays, line: jax.Array, way: jax.Array,
-              new_state: jax.Array, mask: jax.Array) -> CacheArrays:
+              new_state: jax.Array, mask: jax.Array,
+              sets_mod=None) -> CacheArrays:
     """Install `line` in `way` with `new_state` where mask, making it MRU.
 
     `Cache::insertCacheLine` (`cache.h:90`) minus the eviction message
     (the caller handles the victim it got from pick_victim).
     """
-    row = gather_row(cache, line)
+    row = gather_row(cache, line, sets_mod)
     return scatter_row(cache, row_insert(row, line, way, new_state, mask))

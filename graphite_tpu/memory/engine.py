@@ -400,9 +400,9 @@ def memory_engine_step(
     # L1 lookups (both caches, masked by component) — each lane's set rows
     # are gathered ONCE per cache level here and scattered back once below
     # (the engine is op-count-bound; see cache_array.py)
-    l1i_row = ca.gather_row(ms.l1i, s_line)
-    l1d_row = ca.gather_row(ms.l1d, s_line)
-    l2_row = ca.gather_row(ms.l2, s_line)
+    l1i_row = ca.gather_row(ms.l1i, s_line, mp.l1i.sets_mod)
+    l1d_row = ca.gather_row(ms.l1d, s_line, mp.l1d.sets_mod)
+    l2_row = ca.gather_row(ms.l2, s_line, mp.l2.sets_mod)
     l1i_hit, l1i_way, l1i_state = ca.row_lookup(l1i_row, s_line)
     l1d_hit, l1d_way, l1d_state = ca.row_lookup(l1d_row, s_line)
     l1_state = jnp.where(s_comp_l1i, l1i_state, l1d_state)
@@ -466,24 +466,26 @@ def memory_engine_step(
     fill_l1i = l2_hit_now & s_comp_l1i
     fill_l1d = l2_hit_now & ~s_comp_l1i
 
-    def l1_fill(row, mask, st, policy):
-        way, v_valid, v_line, _ = ca.row_pick_victim(row, policy)
+    def l1_fill(row, mask, st, policy, ways):
+        way, v_valid, v_line, _ = ca.row_pick_victim(row, policy, ways)
         out = ca.row_insert(row, s_line, way, st, mask)
         return out, way, v_valid & mask, v_line
 
     l1i_row, _, l1i_ev, l1i_ev_line = l1_fill(
-        l1i_row, fill_l1i, l2_state, mp.l1i.replacement)
+        l1i_row, fill_l1i, l2_state, mp.l1i.replacement,
+        mp.l1i.ways_limit)
     l1d_row, _, l1d_ev, l1d_ev_line = l1_fill(
-        l1d_row, fill_l1d, l2_state, mp.l1d.replacement)
+        l1d_row, fill_l1d, l2_state, mp.l1d.replacement,
+        mp.l1d.ways_limit)
     # L1 victims: clear their cached-loc in L2 (line stays valid in L2)
     l1_ev = l1i_ev | l1d_ev
     l1_ev_line = jnp.where(l1i_ev, l1i_ev_line, l1d_ev_line)
-    ev_hit, ev_way, _ = ca.lookup(ms.l2, l1_ev_line)
-    ev_sets = (l1_ev_line % mp.l2.num_sets).astype(jnp.int32)
+    ev_hit, ev_way, _ = ca.lookup(ms.l2, l1_ev_line, mp.l2.sets_mod)
+    ev_sets = (l1_ev_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     l2_cloc = ms.l2_cloc.at[tiles, ev_sets, ev_way].set(
         jnp.where(l1_ev & ev_hit, 0, ms.l2_cloc[tiles, ev_sets, ev_way]))
     # record new cached-loc for the filled line
-    f_sets = (s_line % mp.l2.num_sets).astype(jnp.int32)
+    f_sets = (s_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     new_cloc = jnp.where(s_comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8)
     l2_cloc = l2_cloc.at[tiles, f_sets, l2_way].set(
         jnp.where(l2_hit_now, new_cloc, l2_cloc[tiles, f_sets, l2_way]))
@@ -674,7 +676,7 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     fline = mail.fwd_line[tiles, h]
     ftime = mail.fwd_time[tiles, h]
 
-    l2_r = ca.gather_row(ms.l2, fline)
+    l2_r = ca.gather_row(ms.l2, fline, mp.l2.sets_mod)
     l2_hit, l2_way, l2_state = ca.row_lookup(l2_r, fline)
     serve = found & l2_hit & (l2_state != INVALID)
     silent = found & ~serve  # already evicted; eviction msg satisfies home
@@ -688,12 +690,12 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     done_ps = ftime + sync_l2_net + l2_cost + l1_cost + 2 * sync_l1d_l2
 
     # invalidate / downgrade L1 (whichever L1 holds it, by cached-loc)
-    sets = (fline % mp.l2.num_sets).astype(jnp.int32)
+    sets = (fline % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     cloc = ms.l2_cloc[tiles, sets, l2_way]
     inv_l1 = serve & (ftype != MSG_WB_REQ)
     wb_l1 = serve & (ftype == MSG_WB_REQ)
-    l1i_r = ca.gather_row(ms.l1i, fline)
-    l1d_r = ca.gather_row(ms.l1d, fline)
+    l1i_r = ca.gather_row(ms.l1i, fline, mp.l1i.sets_mod)
+    l1d_r = ca.gather_row(ms.l1d, fline, mp.l1d.sets_mod)
     l1i_r = ca.row_invalidate(l1i_r, fline, inv_l1 & (cloc == MOD_L1I))
     l1d_r = ca.row_invalidate(l1d_r, fline, inv_l1 & (cloc == MOD_L1D))
     l1i_hit, l1i_way, _ = ca.row_lookup(l1i_r, fline)
@@ -1288,9 +1290,9 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
 
     # L2 victim for the fill; a valid victim emits an eviction message that
     # needs its (home, us) EVICT cell free — else stall this iteration
-    l2_r = ca.gather_row(ms.l2, line)
+    l2_r = ca.gather_row(ms.l2, line, mp.l2.sets_mod)
     way, v_valid, v_line, v_state = ca.row_pick_victim(
-        l2_r, mp.l2.replacement)
+        l2_r, mp.l2.replacement, mp.l2.ways_limit)
     v_home_all = jnp.asarray(mp.mc_tiles, jnp.int32)[
         (v_line % len(mp.mc_tiles)).astype(jnp.int32)]
     need_evict = have_rep & v_valid
@@ -1301,7 +1303,7 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
     new_state = jnp.where(mail.rep_type == MSG_EX_REP, MODIFIED, SHARED)
     l2 = ca.scatter_row(ms.l2, ca.row_insert(l2_r, line, way, new_state,
                                              fill))
-    sets = (line % mp.l2.num_sets).astype(jnp.int32)
+    sets = (line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     l2_cloc = ms.l2_cloc.at[tiles, sets, way].set(
         jnp.where(fill,
                   jnp.where(comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8),
@@ -1340,12 +1342,12 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
 
     # L1 fill
     l1_state = new_state  # L1 gets the L2 state (`insertCacheLineInL1`)
-    l1i_r = ca.gather_row(ms.l1i, line)
-    l1d_r = ca.gather_row(ms.l1d, line)
+    l1i_r = ca.gather_row(ms.l1i, line, mp.l1i.sets_mod)
+    l1d_r = ca.gather_row(ms.l1d, line, mp.l1d.sets_mod)
     l1i_way, l1i_vv, l1i_vline, _ = ca.row_pick_victim(
-        l1i_r, mp.l1i.replacement)
+        l1i_r, mp.l1i.replacement, mp.l1i.ways_limit)
     l1d_way, l1d_vv, l1d_vline, _ = ca.row_pick_victim(
-        l1d_r, mp.l1d.replacement)
+        l1d_r, mp.l1d.replacement, mp.l1d.ways_limit)
     l1i = ca.scatter_row(
         ms.l1i, ca.row_insert(l1i_r, line, l1i_way, l1_state,
                               fill & comp_l1i))
@@ -1355,8 +1357,8 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
     # clear cached-loc of L1 victims in L2
     l1_ev = (fill & comp_l1i & l1i_vv) | (fill & ~comp_l1i & l1d_vv)
     l1_ev_line = jnp.where(comp_l1i, l1i_vline, l1d_vline)
-    ev_hit, ev_way, _ = ca.lookup(l2, l1_ev_line)
-    ev_sets = (l1_ev_line % mp.l2.num_sets).astype(jnp.int32)
+    ev_hit, ev_way, _ = ca.lookup(l2, l1_ev_line, mp.l2.sets_mod)
+    ev_sets = (l1_ev_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     l2_cloc = l2_cloc.at[tiles, ev_sets, ev_way].set(
         jnp.where(l1_ev & ev_hit, 0, l2_cloc[tiles, ev_sets, ev_way]))
 

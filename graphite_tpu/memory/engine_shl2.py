@@ -250,8 +250,8 @@ def shl2_engine_step(
     new_instr_buf = jnp.where(starting & s_is_icache, s_line,
                               ms.req.instr_buf)
 
-    l1i_hit, l1i_way, l1i_state = ca.lookup(ms.l1i, s_line)
-    l1d_hit, l1d_way, l1d_state = ca.lookup(ms.l1d, s_line)
+    l1i_hit, l1i_way, l1i_state = ca.lookup(ms.l1i, s_line, mp.l1i.sets_mod)
+    l1d_hit, l1d_way, l1d_state = ca.lookup(ms.l1d, s_line, mp.l1d.sets_mod)
     l1_state = jnp.where(s_is_icache, l1i_state, l1d_state)
     l1_permit = jnp.where(s_write, state_writable(l1_state),
                           state_readable(l1_state))
@@ -270,15 +270,15 @@ def shl2_engine_step(
     # with no messages (the write-hit path: E is writable)
     promote = l1_hit_now & s_write & (l1_state == EXCLUSIVE)
     l1d_upd = ca.set_state(ms.l1d, s_line, l1d_way, MODIFIED,
-                           promote & ~s_is_icache)
+                           promote & ~s_is_icache, mp.l1d.sets_mod)
     l1i_upd = ms.l1i
     # hits refresh recency under LRU; round_robin's update is a no-op
     if mp.l1i.replacement != "round_robin":
         l1i_upd = ca.touch_lru(l1i_upd, s_line, l1i_way,
-                               l1_hit_now & s_is_icache)
+                               l1_hit_now & s_is_icache, mp.l1i.sets_mod)
     if mp.l1d.replacement != "round_robin":
         l1d_upd = ca.touch_lru(l1d_upd, s_line, l1d_way,
-                               l1_hit_now & ~s_is_icache)
+                               l1_hit_now & ~s_is_icache, mp.l1d.sets_mod)
 
     # L1 miss: an upgrade (write to readable-but-unwritable line) keeps the
     # line until the reply; a plain miss sends the request right away.  In
@@ -412,8 +412,8 @@ def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net):
     fline = mail.fwd_line[tiles, h]
     ftime = mail.fwd_time[tiles, h]
 
-    l1i_hit, l1i_way, l1i_state = ca.lookup(ms.l1i, fline)
-    l1d_hit, l1d_way, l1d_state = ca.lookup(ms.l1d, fline)
+    l1i_hit, l1i_way, l1i_state = ca.lookup(ms.l1i, fline, mp.l1i.sets_mod)
+    l1d_hit, l1d_way, l1d_state = ca.lookup(ms.l1d, fline, mp.l1d.sets_mod)
     have = l1i_hit | l1d_hit
     serve = found & have
     was_dirty = ((l1d_hit & ((l1d_state == MODIFIED)))
@@ -424,11 +424,11 @@ def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net):
     done_ps = ftime + sync_l1_net + ccyc(mp.l1d.data_and_tags_cycles)
 
     inv_do = serve & ~is_wb
-    l1i = ca.invalidate(ms.l1i, fline, inv_do & l1i_hit)
-    l1d = ca.invalidate(ms.l1d, fline, inv_do & l1d_hit)
+    l1i = ca.invalidate(ms.l1i, fline, inv_do & l1i_hit, mp.l1i.sets_mod)
+    l1d = ca.invalidate(ms.l1d, fline, inv_do & l1d_hit, mp.l1d.sets_mod)
     # WB downgrades M/E -> SHARED, data written back
-    l1i = ca.set_state(l1i, fline, l1i_way, SHARED, serve & is_wb & l1i_hit)
-    l1d = ca.set_state(l1d, fline, l1d_way, SHARED, serve & is_wb & l1d_hit)
+    l1i = ca.set_state(l1i, fline, l1i_way, SHARED, serve & is_wb & l1i_hit, mp.l1i.sets_mod)
+    l1d = ca.set_state(l1d, fline, l1d_way, SHARED, serve & is_wb & l1d_hit, mp.l1d.sets_mod)
 
     # ack: FLUSH_REP when dirty data travels (flush of M, or WB of M),
     # else INV_REP / WB_REP
@@ -477,8 +477,8 @@ def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress):
     eline = mail.evict_line[tiles, src]
     etime = mail.evict_time[tiles, src]
 
-    l2_hit, l2_way, l2_state = ca.lookup(ms.l2, eline)
-    sets = (eline % mp.l2.num_sets).astype(jnp.int32)
+    l2_hit, l2_way, l2_state = ca.lookup(ms.l2, eline, mp.l2.sets_mod)
+    sets = (eline % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     apply = found & l2_hit
     dstate, owner, sharers, nsh, cloc = _dir_at(ms.dir, tiles, sets, l2_way)
 
@@ -496,7 +496,7 @@ def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress):
                  dstate=new_dstate, owner=new_owner,
                  sharers=new_sharers, nsharers=new_nsh)
     # dirty flush data lands in the slice
-    l2 = ca.set_state(ms.l2, eline, l2_way, MODIFIED, apply & is_flush)
+    l2 = ca.set_state(ms.l2, eline, l2_way, MODIFIED, apply & is_flush, mp.l2.sets_mod)
 
     txn = ms.txn
     txn_match = txn.active & found & (txn.line == eline)
@@ -554,8 +554,8 @@ def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     dram_in = txn.active & (txn.dram_ready_ps < FAR) & (
         txn.pending == 0).all(axis=1)
     l2 = ms.l2
-    l2_hit, l2_way, _ = ca.lookup(l2, txn.line)
-    l2 = ca.set_state(l2, txn.line, l2_way, SHARED, dram_in & l2_hit)
+    l2_hit, l2_way, _ = ca.lookup(l2, txn.line, mp.l2.sets_mod)
+    l2 = ca.set_state(l2, txn.line, l2_way, SHARED, dram_in & l2_hit, mp.l2.sets_mod)
     txn = txn.replace(
         time_ps=jnp.where(dram_in,
                           jnp.maximum(txn.time_ps, txn.dram_ready_ps),
@@ -570,8 +570,8 @@ def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     is_sh = txn.mtype == MSG_SH_REQ
     is_nullify = txn.mtype == MSG_NULLIFY
 
-    sets = (txn.line % mp.l2.num_sets).astype(jnp.int32)
-    _, l2_way, l2_state = ca.lookup(l2, txn.line)
+    sets = (txn.line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
+    _, l2_way, l2_state = ca.lookup(l2, txn.line, mp.l2.sets_mod)
     r = txn.requester
     rbit = set_bit(jnp.zeros((T, mp.sharer_words), U32), r, finish)
     d = ms.dir
@@ -579,7 +579,7 @@ def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
 
     # dirty acks flushed data into the slice
     l2 = ca.set_state(l2, txn.line, l2_way, MODIFIED,
-                      finish & txn.got_flush & ~is_nullify)
+                      finish & txn.got_flush & ~is_nullify, mp.l2.sets_mod)
 
     # EX finish: directory MODIFIED owner=r
     exf = finish & is_ex
@@ -602,7 +602,7 @@ def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     # NULLIFY finish: entry dies; dirty data (slice M or flushed) → DRAM
     nlf = finish & is_nullify
     wb_dram = nlf & ((l2_state == MODIFIED) | txn.got_flush)
-    l2 = ca.invalidate(l2, txn.line, nlf)
+    l2 = ca.invalidate(l2, txn.line, nlf, mp.l2.sets_mod)
     d = _dir_set(d, tiles=tiles, sets=sets, way=l2_way, mask=nlf,
                  dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
                  owner=jnp.full(T, -1, jnp.int32),
@@ -670,19 +670,19 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
 
     # ---- L2 slice lookup / allocation -----------------------------------
     l2 = ms.l2
-    l2_hit, way, l2_state = ca.lookup(l2, rline)
-    sets = (rline % mp.l2.num_sets).astype(jnp.int32)
+    l2_hit, way, l2_state = ca.lookup(l2, rline, mp.l2.sets_mod)
+    sets = (rline % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     # allocate on miss; a valid victim with L1 copies runs NULLIFY first
     v_way, v_valid, v_line, v_state = ca.pick_victim(
-        l2, rline, mp.l2.replacement)
-    v_sets = (v_line % mp.l2.num_sets).astype(jnp.int32)
+        l2, rline, mp.l2.replacement, mp.l2.sets_mod, mp.l2.ways_limit)
+    v_sets = (v_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     v_dstate, v_owner, v_sharers, v_nsh, v_cloc = _dir_at(
         ms.dir, tiles, v_sets, v_way)
     need_alloc = starting & ~l2_hit
     nullify_live = need_alloc & v_valid & (v_dstate != DIR_UNCACHED)
     # clean victim with no L1 copies: drop now (dirty → DRAM write)
     silent_kill = need_alloc & v_valid & (v_dstate == DIR_UNCACHED)
-    l2 = ca.invalidate(l2, v_line, silent_kill)
+    l2 = ca.invalidate(l2, v_line, silent_kill, mp.l2.sets_mod)
     dram_wb = silent_kill & (v_state == MODIFIED)
 
     txn = txn.replace(
@@ -696,7 +696,7 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     # install the new line (DATA_INVALID until DRAM returns)
     do_install = need_alloc & ~nullify_live
     alloc_way = v_way  # pick_victim returns invalid-way-first
-    l2 = ca.insert_at(l2, rline, alloc_way, DATA_INVALID, do_install)
+    l2 = ca.insert_at(l2, rline, alloc_way, DATA_INVALID, do_install, mp.l2.sets_mod)
     d = _dir_set(ms.dir, tiles, sets, alloc_way, do_install,
                  dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
                  owner=jnp.full(T, -1, jnp.int32),
@@ -709,8 +709,8 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     run_req = starting & ~nullify_live
 
     # re-gather directory for the effective line
-    eff_sets = (eff_line % mp.l2.num_sets).astype(jnp.int32)
-    _, eff_way, eff_l2_state = ca.lookup(l2, eff_line)
+    eff_sets = (eff_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
+    _, eff_way, eff_l2_state = ca.lookup(l2, eff_line, mp.l2.sets_mod)
     dstate, owner, sharers, nsh, cloc = _dir_at(d, tiles, eff_sets, eff_way)
 
     is_ex = eff_type == MSG_EX_REQ
@@ -864,12 +864,12 @@ def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
 
     # Upgrade replies land in the line's EXISTING way (the S copy stays
     # put during an EX upgrade); only true misses pick a victim.
-    l1i_hit, l1i_hway, _ = ca.lookup(ms.l1i, line)
-    l1d_hit, l1d_hway, _ = ca.lookup(ms.l1d, line)
+    l1i_hit, l1i_hway, _ = ca.lookup(ms.l1i, line, mp.l1i.sets_mod)
+    l1d_hit, l1d_hway, _ = ca.lookup(ms.l1d, line, mp.l1d.sets_mod)
     l1i_vway, l1i_vv, l1i_vline, l1i_vstate = ca.pick_victim(
-        ms.l1i, line, mp.l1i.replacement)
+        ms.l1i, line, mp.l1i.replacement, mp.l1i.sets_mod, mp.l1i.ways_limit)
     l1d_vway, l1d_vv, l1d_vline, l1d_vstate = ca.pick_victim(
-        ms.l1d, line, mp.l1d.replacement)
+        ms.l1d, line, mp.l1d.replacement, mp.l1d.sets_mod, mp.l1d.ways_limit)
     l1i_way = jnp.where(l1i_hit, l1i_hway, l1i_vway)
     l1d_way = jnp.where(l1d_hit, l1d_hway, l1d_vway)
     already = jnp.where(comp_l1i, l1i_hit, l1d_hit)
@@ -882,8 +882,8 @@ def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
     fill = have_rep & ~(need_evict & evict_busy)
     evict_go = need_evict & fill
 
-    l1i = ca.insert_at(ms.l1i, line, l1i_way, new_state, fill & comp_l1i)
-    l1d = ca.insert_at(ms.l1d, line, l1d_way, new_state, fill & ~comp_l1i)
+    l1i = ca.insert_at(ms.l1i, line, l1i_way, new_state, fill & comp_l1i, mp.l1i.sets_mod)
+    l1d = ca.insert_at(ms.l1d, line, l1d_way, new_state, fill & ~comp_l1i, mp.l1d.sets_mod)
 
     e_msg = jnp.where(v_state == MODIFIED, MSG_FLUSH_REP,
                       MSG_INV_REP).astype(jnp.uint8)

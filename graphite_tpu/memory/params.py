@@ -32,8 +32,8 @@ DRAM_FREQ_MHZ = 1000
 class CacheLevelParams:
     """One cache level (`carbon_sim.cfg:207-230` [l1_icache/T1] etc.)."""
 
-    num_sets: int
-    num_ways: int
+    num_sets: int             # MAX across tiles (array allocation size)
+    num_ways: int             # MAX across tiles
     data_access_cycles: int
     tags_access_cycles: int
     sequential: bool          # perf_model_type (parallel|sequential)
@@ -41,17 +41,100 @@ class CacheLevelParams:
     # `replacement_policy` (`carbon_sim.cfg:213`): lru | round_robin
     # (factory `CacheReplacementPolicy::create`)
     replacement: str = "lru"
+    # heterogeneous per-tile geometries (`misc/config.h:92-100` model_list
+    # cache types): None = homogeneous; else int tuples of length T.  The
+    # dense arrays are padded to the MAX geometry; per-tile set moduli and
+    # way counts mask the engine's indexing/victim picks.
+    tile_sets: "tuple | None" = None
+    tile_ways: "tuple | None" = None
+    tile_data_cycles: "tuple | None" = None
+    tile_tags_cycles: "tuple | None" = None
+
+    @property
+    def sets_mod(self):
+        """Per-tile set modulus: int (homogeneous) or np int32[T]."""
+        if self.tile_sets is None:
+            return self.num_sets
+        import numpy as np
+
+        return np.asarray(self.tile_sets, np.int32)
+
+    @property
+    def ways_limit(self):
+        """Per-tile way count for victim masking: None or np int32[T]."""
+        if self.tile_ways is None:
+            return None
+        import numpy as np
+
+        return np.asarray(self.tile_ways, np.int32)
+
+    @classmethod
+    def merge(cls, per_tile: "list[CacheLevelParams]") -> "CacheLevelParams":
+        """One padded level over heterogeneous per-tile configurations."""
+        first = per_tile[0]
+        if all(p == first for p in per_tile):
+            return first
+        if any(p.replacement != first.replacement for p in per_tile):
+            raise NotImplementedError(
+                "mixed replacement policies across tiles of one cache "
+                "level are not supported (policy is compile-time)")
+        if any(p.sequential != first.sequential for p in per_tile):
+            raise NotImplementedError(
+                "mixed perf_model_type across tiles is not supported")
+
+        def per(vals, homog_ok=True):
+            return None if homog_ok and len(set(vals)) == 1 else tuple(vals)
+
+        sets = [p.num_sets for p in per_tile]
+        ways = [p.num_ways for p in per_tile]
+        data = [p.data_access_cycles for p in per_tile]
+        tags = [p.tags_access_cycles for p in per_tile]
+        return cls(
+            num_sets=max(sets), num_ways=max(ways),
+            data_access_cycles=first.data_access_cycles,
+            tags_access_cycles=first.tags_access_cycles,
+            sequential=first.sequential,
+            track_miss_types=any(p.track_miss_types for p in per_tile),
+            replacement=first.replacement,
+            tile_sets=per(sets), tile_ways=per(ways),
+            tile_data_cycles=per(data), tile_tags_cycles=per(tags),
+        )
 
     # CachePerfModel::getLatency (`cache_perf_model_{parallel,sequential}.h`)
+    # — int when homogeneous, np int64[T] when per-tile (either broadcasts
+    # through the engine's jnp cost math)
     @property
-    def tags_cycles(self) -> int:
-        return self.tags_access_cycles
+    def tags_cycles(self):
+        if self.tile_tags_cycles is None:
+            return self.tags_access_cycles
+        import numpy as np
+
+        return np.asarray(self.tile_tags_cycles, np.int64)
 
     @property
-    def data_and_tags_cycles(self) -> int:
-        if self.sequential:
+    def data_and_tags_cycles(self):
+        if not self.sequential:
+            # parallel tag/data: tags don't add — per-tile only when the
+            # data cycles themselves vary (a 0-d array here would crash
+            # the golden model's per-tile indexing)
+            if self.tile_data_cycles is None:
+                return self.data_access_cycles
+            import numpy as np
+
+            return np.asarray(self.tile_data_cycles, np.int64)
+        if self.tile_data_cycles is None and self.tile_tags_cycles is None:
             return self.data_access_cycles + self.tags_access_cycles
-        return self.data_access_cycles
+        import numpy as np
+
+        data = np.asarray(
+            self.tile_data_cycles
+            if self.tile_data_cycles is not None
+            else self.data_access_cycles, np.int64)
+        tags = np.asarray(
+            self.tile_tags_cycles
+            if self.tile_tags_cycles is not None
+            else self.tags_access_cycles, np.int64)
+        return data + tags
 
     # Defaults per level = the T1 configuration (`carbon_sim.cfg:207-230`)
     _DEFAULTS = {
@@ -158,23 +241,33 @@ class MemParams:
         cfg = sc.cfg
         T = sc.application_tiles
         spec = sc.tile_spec(0)
-        for s in sc.tile_specs[:T]:
-            if (s.l1_icache_type, s.l1_dcache_type, s.l2_cache_type) != (
-                spec.l1_icache_type, spec.l1_dcache_type, spec.l2_cache_type
-            ):
-                raise NotImplementedError(
-                    "heterogeneous cache types per tile not supported yet"
-                )
-        l1i_sec = f"l1_icache/{spec.l1_icache_type}"
         l1d_sec = f"l1_dcache/{spec.l1_dcache_type}"
-        l2_sec = f"l2_cache/{spec.l2_cache_type}"
         line = cfg.get_int(f"{l1d_sec}/cache_line_size", 64)
         line_bits = line.bit_length() - 1
         if 1 << line_bits != line:
             raise ValueError(f"cache_line_size {line} is not a power of 2")
-        l1i = CacheLevelParams.from_config(cfg, l1i_sec, line)
-        l1d = CacheLevelParams.from_config(cfg, l1d_sec, line)
-        l2 = CacheLevelParams.from_config(cfg, l2_sec, line)
+        # heterogeneous per-tile cache types (`misc/config.h:92-100`,
+        # `[tile] model_list`): build each tile's level config, then merge
+        # into ONE padded level with per-tile set/way/timing vectors
+        per_level: dict[str, list] = {"l1_icache": [], "l1_dcache": [],
+                                      "l2_cache": []}
+        for t in range(T):
+            s = sc.tile_spec(t)
+            for level, typ in (("l1_icache", s.l1_icache_type),
+                               ("l1_dcache", s.l1_dcache_type),
+                               ("l2_cache", s.l2_cache_type)):
+                other_line = cfg.get_int(f"{level}/{typ}/cache_line_size",
+                                         line)
+                if other_line != line:
+                    raise NotImplementedError(
+                        "mixed cache_line_size across tiles is not "
+                        "supported (the line is the coherence unit)")
+                per_level[level].append(
+                    CacheLevelParams.from_config(cfg, f"{level}/{typ}",
+                                                 line))
+        l1i = CacheLevelParams.merge(per_level["l1_icache"])
+        l1d = CacheLevelParams.merge(per_level["l1_dcache"])
+        l2 = CacheLevelParams.merge(per_level["l2_cache"])
 
         # --- memory controllers (`memory_manager.cc:214-278`) -------------
         num_mc_str = cfg.get_string("dram/num_controllers", "ALL")
@@ -201,7 +294,11 @@ class MemParams:
         dir_ways = cfg.get_int("dram_directory/associativity", 16)
         entries_str = cfg.get_string("dram_directory/total_entries", "auto")
         n_slices = len(mc_tiles)
-        l2_size_kb = cfg.get_int(f"{l2_sec}/cache_size", 512)
+        # auto-size from the largest ACTUAL per-tile L2 (max sets x max
+        # ways could pair maxima from different tiles and oversize it)
+        l2_size_kb = max(
+            p.num_sets * p.num_ways for p in per_level["l2_cache"]
+        ) * line // 1024
         if entries_str == "auto":
             num_sets = math.ceil(
                 2.0 * l2_size_kb * 1024 * T / (line * dir_ways * n_slices)

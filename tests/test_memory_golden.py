@@ -274,3 +274,40 @@ def test_round_robin_replacement(proto):
     res_lru, _ = assert_exact(make_config(1, proto), batch)
     assert not np.array_equal(res.clock_ps, res_lru.clock_ps), (
         "round_robin timing identical to LRU on a thrashing set")
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_heterogeneous_cache_geometries(proto):
+    """Per-tile cache types (`misc/config.h:92-100` model_list): tiles
+    0-1 run small T0 caches, tiles 2-3 big T1 — dense arrays pad to the
+    max geometry with per-tile set moduli / way masks.  Differential vs
+    the oracle (which builds each tile's true geometry independently)."""
+    extra = """
+[tile]
+model_list = "<2, simple, T0, T0, T0><2, simple, T1, T1, T1>"
+[l1_icache/T0]
+cache_size = 4
+associativity = 2
+[l1_dcache/T0]
+cache_size = 4
+associativity = 2
+data_access_time = 2
+[l2_cache/T0]
+cache_size = 32
+associativity = 4
+data_access_time = 5
+tags_access_time = 2
+"""
+    sc = make_config(4, proto, extra=extra)
+    from graphite_tpu.memory.params import MemParams
+    mp = MemParams.from_config(sc)
+    assert mp.l1d.tile_sets is not None and mp.l1d.tile_ways is not None
+    assert mp.l1d.tile_sets[0] < mp.l1d.tile_sets[2]
+    # both private working sets (evictions on the small tiles) and
+    # mutex-serialized sharing between small- and big-cache tiles
+    batch = synthetic.memory_stress_trace(
+        4, n_accesses=150, working_set_bytes=1 << 14, seed=13)
+    assert_exact(sc, batch)
+    res, gold = assert_exact(make_config(4, proto, extra=extra),
+                             mutex_rmw(4, 5))
+    assert gold.mem_counters["l2_misses"].sum() > 0
