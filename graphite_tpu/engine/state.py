@@ -18,6 +18,12 @@ from flax import struct
 
 from graphite_tpu.trace.schema import TraceBatch
 
+# ring depth for per-generation barrier-release / cond-signal times: the
+# split rendezvous ops are generation-exact while a joiner lags at most
+# GEN_RING releases/signals behind (far beyond the one-generation bound
+# the frontend's usage patterns give)
+GEN_RING = 8
+
 
 @struct.dataclass
 class CoreState:
@@ -74,6 +80,15 @@ class SyncState:
     barrier_arrived: jax.Array   # int32[NB]
     barrier_time_ps: jax.Array   # int64[NB] — max arrival time
     barrier_waiting: jax.Array   # bool[T] — this tile has joined its barrier
+    # co-located split form (BARRIER_ARRIVE/BARRIER_SYNC): release
+    # generation counter + a GEN_RING-deep ring of per-generation release
+    # times (generation-exact for rendezvous lag <= GEN_RING releases)
+    barrier_gen: jax.Array       # int32[NB]
+    barrier_release_ps: jax.Array  # int64[NB, GEN_RING]
+    # published cond signals (COND_SIGNAL aux1>0 / COND_JOIN): sequence
+    # counter + per-sequence time ring
+    cond_sig_seq: jax.Array      # int32[NC]
+    cond_sig_seq_ps: jax.Array   # int64[NC, GEN_RING]
     mutex_locked: jax.Array      # int32[NM] — 0 free / 1 held
     mutex_owner: jax.Array       # int32[NM]
     mutex_time_ps: jax.Array     # int64[NM] — time of last lock/unlock
@@ -234,6 +249,10 @@ def init_state(
         barrier_arrived=jnp.zeros(n_barriers, jnp.int32),
         barrier_time_ps=jnp.zeros(n_barriers, i64),
         barrier_waiting=jnp.zeros(T, jnp.bool_),
+        barrier_gen=jnp.zeros(n_barriers, jnp.int32),
+        barrier_release_ps=jnp.zeros((n_barriers, GEN_RING), i64),
+        cond_sig_seq=jnp.zeros(n_conds, jnp.int32),
+        cond_sig_seq_ps=jnp.zeros((n_conds, GEN_RING), i64),
         mutex_locked=jnp.zeros(n_mutexes, jnp.int32),
         mutex_owner=jnp.full(n_mutexes, -1, jnp.int32),
         mutex_time_ps=jnp.zeros(n_mutexes, i64),

@@ -264,6 +264,11 @@ def subquantum_iteration(
     is_recv = op == Op.NET_RECV
     is_binit = op == Op.BARRIER_INIT
     is_bwait = op == Op.BARRIER_WAIT
+    # co-located split forms (see schema): non-blocking arrival + blocking
+    # rendezvous on the release generation / published signal sequence
+    is_barrive = op == Op.BARRIER_ARRIVE
+    is_bsync = op == Op.BARRIER_SYNC
+    is_cjoin = op == Op.COND_JOIN
     is_minit = op == Op.MUTEX_INIT
     is_mlock = op == Op.MUTEX_LOCK
     is_munlock = op == Op.MUTEX_UNLOCK
@@ -428,7 +433,11 @@ def subquantum_iteration(
         barrier_count = sync.barrier_count.at[bar].add(
             jnp.where(init_win, aux1 - sync.barrier_count[bar], 0)
         )
-        new_arrival = active & is_bwait & ~sync.barrier_waiting
+        # arrivals: blocking waits joining the rendezvous, plus the
+        # co-located split form's non-blocking BARRIER_ARRIVE records
+        arrive_only = active & is_barrive
+        new_arrival = (active & is_bwait & ~sync.barrier_waiting
+                       ) | arrive_only
         arr_tgt = jnp.where(new_arrival, bar, 0)
         barrier_arrived = sync.barrier_arrived.at[arr_tgt].add(
             jnp.where(new_arrival, 1, 0)
@@ -440,24 +449,47 @@ def subquantum_iteration(
         participant = is_bwait & (sync.barrier_waiting | new_arrival) & ~done
         released = participant & release_bar[bar]
         release_time = barrier_time[bar]
-        barrier_waiting = (sync.barrier_waiting | new_arrival) & ~released
+        barrier_waiting = ((sync.barrier_waiting
+                            | (new_arrival & ~arrive_only)) & ~released)
+        # the split form's rendezvous: wait for the given release
+        # generation, then take THAT generation's release time (per-gen
+        # ring; see state.GEN_RING)
+        from graphite_tpu.engine.state import GEN_RING
+
+        barrier_gen = sync.barrier_gen + release_bar.astype(jnp.int32)
+        slot = (barrier_gen % GEN_RING).astype(jnp.int32)
+        n_bars_r = jnp.arange(n_bars, dtype=jnp.int32)
+        cur_slot = sync.barrier_release_ps[n_bars_r, slot]
+        barrier_release = sync.barrier_release_ps.at[n_bars_r, slot].set(
+            jnp.where(release_bar, barrier_time, cur_slot))
+        bsync_now = active & is_bsync & (barrier_gen[bar] >= aux1)
+        bsync_time = barrier_release[
+            bar, (aux1 % GEN_RING).astype(jnp.int32)]
         # reset released barriers
         barrier_arrived = jnp.where(release_bar, 0, barrier_arrived)
         barrier_time = jnp.where(release_bar, 0, barrier_time)
         return (barrier_count, barrier_arrived, barrier_time,
-                barrier_waiting, released, release_time)
+                barrier_waiting, released, release_time,
+                barrier_gen, barrier_release, arrive_only, bsync_now,
+                bsync_time)
 
     def _barrier_skip(_):
         return (sync.barrier_count, sync.barrier_arrived,
                 sync.barrier_time_ps, sync.barrier_waiting,
-                jnp.zeros((T,), jnp.bool_), jnp.zeros((T,), I64))
+                jnp.zeros((T,), jnp.bool_), jnp.zeros((T,), I64),
+                sync.barrier_gen, sync.barrier_release_ps,
+                jnp.zeros((T,), jnp.bool_), jnp.zeros((T,), jnp.bool_),
+                jnp.zeros((T,), I64))
 
     (barrier_count, barrier_arrived, barrier_time, barrier_waiting,
-     released, release_time) = lax.cond(
-        jnp.any(active & (is_binit | is_bwait)),
+     released, release_time, barrier_gen, barrier_release_ps,
+     barrive_now, bsync_now, bsync_time) = lax.cond(
+        jnp.any(active & (is_binit | is_bwait | is_barrive | is_bsync)),
         _barrier_block, _barrier_skip, None)
     barrier_wait_ps = jnp.maximum(release_time - core.clock_ps, 0)
     barrier_wait_ps = jnp.where(released, barrier_wait_ps, 0)
+    bsync_wait_ps = jnp.where(
+        bsync_now, jnp.maximum(bsync_time - core.clock_ps, 0), 0)
 
     # --- MUTEX + COND ----------------------------------------------------
     # One gated block: condition variables interlock with mutexes
@@ -502,8 +534,10 @@ def subquantum_iteration(
         init_cond = jnp.zeros((NC,), jnp.bool_).at[cid].max(cinit_now)
         psig = jnp.where(init_cond[:, None], BIG, psig)
         pbc = jnp.where(init_cond, BIG, pbc)
-        sig_now = active & is_csig
-        bcast_now = active & is_cbcast
+        # published (aux1>0) signals use the co-located split machinery
+        # below, not the pending-slot delivery
+        sig_now = active & is_csig & (aux1 <= 0)
+        bcast_now = active & is_cbcast & (aux1 <= 0)
         post_key = core.clock_ps * jnp.asarray(T, I64) + tiles.astype(I64)
         sbest = _elect_min(sig_now, cid, post_key, NC)
         sig_elect = sig_now & (post_key == sbest[cid])
@@ -591,7 +625,11 @@ def subquantum_iteration(
         #  - recv/join/barrier-parked lanes re-emerge at wake times bounded
         #    below by some running lane's clock, so they are covered by
         #    the advancing-lane bound transitively.
-        cur_blocking = (is_recv | is_join | is_bwait | is_mlock | is_cwait)
+        # split-form rendezvous ops block too: their lanes re-emerge at
+        # wake times bounded below by the publisher's clock, so they are
+        # covered by the advancing-lane bound transitively (like recv)
+        cur_blocking = (is_recv | is_join | is_bwait | is_mlock | is_cwait
+                        | is_bsync | is_cjoin)
         advancing = ~done & ~cur_blocking
         min_adv_key = jnp.min(jnp.where(
             advancing, core.clock_ps * jnp.asarray(T, I64), BIG))
@@ -654,6 +692,40 @@ def subquantum_iteration(
                 | (is_cwait & ~done)),
         _mutex_cond_block, _mutex_cond_skip, None)
 
+    # --- published cond signals + COND_JOIN (co-located split form) ------
+    # A publishing signal/broadcast bumps the cond's signal sequence and
+    # stamps its time; COND_JOIN(k) waits for sequence >= k and takes the
+    # stamped time (the waiter's wake).  The mutex dance around it uses
+    # plain MUTEX_UNLOCK / MUTEX_LOCK records (see schema).
+    pub_now = active & (is_csig | is_cbcast) & (aux1 > 0)
+
+    def _pub_block(_):
+        from graphite_tpu.engine.state import GEN_RING
+
+        cid = jnp.clip(aux0, 0, NC - 1)
+        # cond ids are allocated once per app run, so COND_INIT does not
+        # reset the sequence (a publish record on another lane may replay
+        # before a later-positioned init on the creator's lane)
+        seq = sync.cond_sig_seq.at[jnp.where(pub_now, cid, 0)].add(
+            jnp.where(pub_now, 1, 0))
+        slot = (seq[cid] % GEN_RING).astype(jnp.int32)
+        seq_ps = sync.cond_sig_seq_ps.at[
+            jnp.where(pub_now, cid, 0),
+            jnp.where(pub_now, slot, 0)].max(
+            jnp.where(pub_now, core.clock_ps, 0))
+        cjoin_now = active & is_cjoin & (seq[cid] >= aux1)
+        cjoin_t = seq_ps[cid, (aux1 % GEN_RING).astype(jnp.int32)]
+        return seq, seq_ps, cjoin_now, cjoin_t
+
+    (cond_sig_seq, cond_sig_seq_ps, cjoin_now, cjoin_time) = lax.cond(
+        jnp.any(pub_now | (active & is_cjoin)),
+        _pub_block,
+        lambda _: (sync.cond_sig_seq, sync.cond_sig_seq_ps,
+                   jnp.zeros((T,), jnp.bool_), jnp.zeros((T,), I64)),
+        None)
+    cjoin_wait_ps = jnp.where(
+        cjoin_now, jnp.maximum(cjoin_time - core.clock_ps, 0), 0)
+
     # --- JOIN ------------------------------------------------------------
     def _join_block(_):
         join_target = jnp.clip(aux0, 0, T - 1)
@@ -689,6 +761,7 @@ def subquantum_iteration(
     )
     advance = advance | recv_now | released | (active & is_spawn_instr)
     advance = advance | granted | join_now | cond_post_commit
+    advance = advance | barrive_now | bsync_now | cjoin_now | pub_now
 
     clock = core.clock_ps
     if params.iocoom is not None:
@@ -748,6 +821,8 @@ def subquantum_iteration(
     clock = jnp.where(released, jnp.maximum(clock, release_time), clock)
     clock = jnp.where(granted, clock + mutex_wait_ps, clock)
     clock = jnp.where(join_now, join_time, clock)
+    clock = jnp.where(bsync_now, jnp.maximum(clock, bsync_time), clock)
+    clock = jnp.where(cjoin_now, jnp.maximum(clock, cjoin_time), clock)
 
     # DVFS_SET retunes the target domain's frequency, validated against the
     # voltage/frequency tables (`DVFSManager::getVoltage`, technology
@@ -811,7 +886,9 @@ def subquantum_iteration(
                            | (is_dynamic & ~is_spawn_instr))
     recv_charged = recv_now & (recv_wait_ps > 0) & enabled
     sync_charged = (released & (barrier_wait_ps > 0) | granted
-                    & (mutex_wait_ps > 0)) & enabled
+                    & (mutex_wait_ps > 0)
+                    | (bsync_now & (bsync_wait_ps > 0))
+                    | (cjoin_now & (cjoin_wait_ps > 0))) & enabled
 
     new_core = core.replace(
         clock_ps=clock,
@@ -839,7 +916,8 @@ def subquantum_iteration(
         sync_instructions=core.sync_instructions + sync_charged.astype(I64),
         sync_stall_ps=core.sync_stall_ps
         + jnp.where(released & enabled, barrier_wait_ps, 0)
-        + jnp.where(granted & enabled, mutex_wait_ps, 0),
+        + jnp.where(granted & enabled, mutex_wait_ps, 0)
+        + jnp.where(enabled, bsync_wait_ps + cjoin_wait_ps, 0),
         # delta-add (uint8 modular): old + (taken - old) == taken; avoids a
         # second gather of bp_bits inside the scatter so the buffer updates
         # in place ((tiles, bp_index) pairs are unique per lane)
@@ -868,6 +946,10 @@ def subquantum_iteration(
         barrier_arrived=barrier_arrived,
         barrier_time_ps=barrier_time,
         barrier_waiting=barrier_waiting,
+        barrier_gen=barrier_gen,
+        barrier_release_ps=barrier_release_ps,
+        cond_sig_seq=cond_sig_seq,
+        cond_sig_seq_ps=cond_sig_seq_ps,
         mutex_locked=mutex_locked,
         mutex_owner=mutex_owner,
         mutex_time_ps=mutex_time,

@@ -23,12 +23,15 @@ machine instruction asks the app to declare its basic blocks.
 
 Oversubscription (threads > tiles): the scheduler queues threads per tile
 and every blocking call is a scheduling point that releases the core
-(`ThreadManager::stallThread`).  Replay constraint: threads sharing a tile
-share one engine lane, so co-located threads may synchronize with each
-other through mutexes and joins (sequential on one lane) but NOT through
-barriers, condvars, or CAPI messages pairing two co-located threads — one
-lane cannot contribute two arrivals to the same rendezvous.  Cross-tile
-synchronization is unrestricted.
+(`ThreadManager::stallThread`).  Threads sharing a tile serialize onto
+ONE engine lane; so that a blocked thread's record never sits in front of
+the co-located record that would resolve it, every blocking call records
+its rendezvous at COMPLETION time (after rescheduling — hence after any
+co-located segments that ran meanwhile), and barriers/condvars use the
+split ops (BARRIER_ARRIVE/BARRIER_SYNC, MUTEX_UNLOCK+COND_JOIN+MUTEX_LOCK
+— see trace/schema.py).  Co-located threads may therefore synchronize
+freely with each other and across tiles: barriers, condvars, mutexes,
+CAPI pairs, joins.
 """
 
 from __future__ import annotations
@@ -88,6 +91,9 @@ class CarbonApp:
         self._mutexes: dict[int, threading.Lock] = {}
         self._conds: dict[int, threading.Condition] = {}
         self._barriers: dict[int, threading.Barrier] = {}
+        # published-signal sequence per cond (the COND_JOIN rendezvous key)
+        self._cond_signal_seq: dict[int, int] = {}
+        self._cond_meta_lock = threading.Lock()
         self._next_sync_id = [0]
         self._errors: list = []
         # centralized OS view (MCP-side servers)
@@ -303,7 +309,6 @@ def CAPI_message_send_w(sender: int, receiver: int, payload) -> None:
 def CAPI_message_receive_w(sender: int, receiver: int, size: int = 8):
     app = _app()
     assert receiver == _tile(), "CAPI recv must run on the receiving tile"
-    app.builders[receiver].recv(sender, size)
 
     def _wait():
         with app._chan_cv:
@@ -311,7 +316,13 @@ def CAPI_message_receive_w(sender: int, receiver: int, size: int = 8):
                 app._chan_cv.wait()
             return app._channels[(sender, receiver)].pop(0)
 
-    return _blocking_wait(app, _wait)
+    payload = _blocking_wait(app, _wait)
+    # record at COMPLETION: a co-located sender's SEND record (emitted
+    # while this thread was blocked) must precede this NET_RECV on the
+    # shared lane, or the replay would deadlock; the engine's
+    # clock = max(clock, arrival) charges the same simulated wait
+    app.builders[receiver].recv(sender, size)
+    return payload
 
 
 # ---- sync API (`sync_api.h:19-34` → MCP SyncServer) ---------------------
@@ -326,8 +337,12 @@ class CarbonMutex:
 
     def lock(self):
         app = _app()
-        app.builders[_tile()].mutex_lock(self.id)
+        # record at COMPLETION (after the functional acquire): a
+        # co-located holder's MUTEX_UNLOCK then precedes this record on
+        # the shared lane; the engine's grant still charges
+        # max(handoff - clock, 0) of simulated wait
         _blocking_wait(app, app._mutexes[self.id].acquire)
+        app.builders[_tile()].mutex_lock(self.id)
 
     def unlock(self):
         app = _app()
@@ -351,9 +366,25 @@ class CarbonCond:
         app.builders[_tile()].cond_init(self.id)
 
     def wait(self):
+        # split form (schema COND_JOIN): release the mutex at wait start,
+        # rendezvous with the waking signal's published sequence at
+        # completion, then re-acquire — so a co-located signaler's record
+        # can land between the two halves on the shared lane
         app = _app()
-        app.builders[_tile()].cond_wait(self.id, self.mutex.id)
+        app.builders[_tile()].mutex_unlock(self.mutex.id)
         _blocking_wait(app, app._conds[self.id].wait)
+        with app._cond_meta_lock:
+            seq = app._cond_signal_seq.get(self.id, 0)
+        app.builders[_tile()].cond_join(self.id, seq)
+        app.builders[_tile()].mutex_lock(self.mutex.id)
+
+    def _publish(self) -> None:
+        """Bump the cond's published-signal sequence (the COND_JOIN
+        rendezvous key) before the record + functional notify."""
+        app = _app()
+        with app._cond_meta_lock:
+            app._cond_signal_seq[self.id] = (
+                app._cond_signal_seq.get(self.id, 0) + 1)
 
     def _notify(self, notify_all: bool) -> None:
         # POSIX allows signaling without holding the mutex; Python's
@@ -372,11 +403,13 @@ class CarbonCond:
             _blocking_wait(app, _locked)
 
     def signal(self):
-        _app().builders[_tile()].cond_signal(self.id)
+        self._publish()
+        _app().builders[_tile()].cond_signal(self.id, publish=True)
         self._notify(False)
 
     def broadcast(self):
-        _app().builders[_tile()].cond_broadcast(self.id)
+        self._publish()
+        _app().builders[_tile()].cond_broadcast(self.id, publish=True)
         self._notify(True)
 
 
@@ -384,13 +417,31 @@ class CarbonBarrier:
     def __init__(self, count: int):
         app = _app()
         self.id = app._alloc_sync_id()
-        app._barriers[self.id] = threading.Barrier(count)
+        # the action hook runs exactly once per release, BEFORE any waiter
+        # resumes — a race-free GLOBAL release-generation counter (a
+        # thread-local arrival count would drift when participants skip
+        # rounds)
+        self._gen = 0
+        self._gen_lock = threading.Lock()
+
+        def _on_release():
+            with self._gen_lock:
+                self._gen += 1
+
+        app._barriers[self.id] = threading.Barrier(count, action=_on_release)
         app.builders[_tile()].barrier_init(self.id, count)
 
     def wait(self):
+        # split form (schema BARRIER_ARRIVE/BARRIER_SYNC): contribute the
+        # arrival BEFORE blocking (a co-located peer's arrival would
+        # otherwise sit unreachable behind this lane's blocked record),
+        # then rendezvous with the release generation that freed us
         app = _app()
-        app.builders[_tile()].barrier_wait(self.id)
+        app.builders[_tile()].barrier_arrive(self.id)
         _blocking_wait(app, app._barriers[self.id].wait)
+        with self._gen_lock:
+            gen = self._gen
+        app.builders[_tile()].barrier_sync(self.id, gen)
 
 
 def carbon_barrier_init(count: int) -> CarbonBarrier:

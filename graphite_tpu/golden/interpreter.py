@@ -167,6 +167,13 @@ def run_golden(sim_config, batch: TraceBatch,
     mutexes: dict[int, dict] = {}    # id -> {locked, handoff, waiters}
     conds: dict[int, list] = {}      # id -> [(arrival, tile, mutex_id)]
     exit_clock: dict[int, int] = {}
+    # split-form rendezvous state (BARRIER_ARRIVE/SYNC, COND_JOIN),
+    # generation-exact (the engine keeps a GEN_RING-deep ring; identical
+    # while rendezvous lag <= GEN_RING, the documented bound)
+    bar_gen: dict[int, int] = {}      # id -> releases so far
+    bar_release: dict[tuple, int] = {}  # (id, gen) -> release time
+    sig_seq: dict[int, int] = {}      # cond id -> published signals so far
+    sig_time: dict[tuple, int] = {}   # (cond id, seq) -> publish time
 
     def runnable(t: _Tile) -> bool:
         if t.done or t.blocked is not None:
@@ -219,6 +226,24 @@ def run_golden(sim_config, batch: TraceBatch,
             target = t.blocked[1]
             if target in exit_clock:
                 t.clock = max(t.clock, exit_clock[target])
+                t.blocked = None
+                t.idx += 1
+        elif kind == "bsync":
+            b, gen = t.blocked[1], t.blocked[2]
+            if bar_gen.get(b, 0) >= gen:
+                rel = bar_release.get((b, gen), 0)
+                if rel > t.clock and enabled[0]:
+                    t.counts["sync"] += 1
+                t.clock = max(t.clock, rel)
+                t.blocked = None
+                t.idx += 1
+        elif kind == "cjoin":
+            c, k = t.blocked[1], t.blocked[2]
+            if sig_seq.get(c, 0) >= k:
+                st = sig_time.get((c, k), 0)
+                if st > t.clock and enabled[0]:
+                    t.counts["sync"] += 1
+                t.clock = max(t.clock, st)
                 t.blocked = None
                 t.idx += 1
 
@@ -286,20 +311,36 @@ def run_golden(sim_config, batch: TraceBatch,
         elif op == Op.BARRIER_INIT:
             b = barriers.setdefault(aux0, dict(count=0, arrived=[]))
             b["count"] = aux1  # re-arm the count; arrivals stay
-        elif op == Op.BARRIER_WAIT:
+        elif op in (Op.BARRIER_WAIT, Op.BARRIER_ARRIVE):
+            blocking = op == Op.BARRIER_WAIT
             b = barriers[aux0]
-            b["arrived"].append(t.tid)
-            t.blocked = ("barrier", aux0)
+            # arrival time captured NOW (ARRIVE lanes keep running)
+            b["arrived"].append((t.clock, t.tid, blocking))
+            if blocking:
+                t.blocked = ("barrier", aux0)
             t.idx += 1  # the record commits at release time
             if len(b["arrived"]) >= b["count"]:
-                release = max(tiles[x].clock for x in b["arrived"])
-                for x in b["arrived"]:
+                release = max(c for c, _, _ in b["arrived"])
+                for (c, x, was_blocking) in b["arrived"]:
+                    if not was_blocking:
+                        continue
                     tx = tiles[x]
                     if release > tx.clock and enabled[0]:
                         tx.counts["sync"] += 1
                     tx.clock = max(tx.clock, release)
                     tx.blocked = None
                 b["arrived"] = []
+                g = bar_gen.get(aux0, 0) + 1
+                bar_gen[aux0] = g
+                bar_release[(aux0, g)] = release
+            return
+        elif op == Op.BARRIER_SYNC:
+            t.blocked = ("bsync", aux0, aux1)
+            try_unblock(t)
+            return
+        elif op == Op.COND_JOIN:
+            t.blocked = ("cjoin", aux0, aux1)
+            try_unblock(t)
             return
         elif op == Op.MUTEX_INIT:
             mutexes[aux0] = dict(locked=False, handoff=0, waiters=[])
@@ -328,6 +369,11 @@ def run_golden(sim_config, batch: TraceBatch,
             t.idx += 1
             grant_mutex(aux1)
             return
+        elif op in (Op.COND_SIGNAL, Op.COND_BROADCAST) and aux1 > 0:
+            # published form (live frontend): bump the sequence + stamp
+            k = sig_seq.get(aux0, 0) + 1
+            sig_seq[aux0] = k
+            sig_time[(aux0, k)] = t.clock
         elif op in (Op.COND_SIGNAL, Op.COND_BROADCAST):
             S = t.clock
             waiters = conds.setdefault(aux0, [])
@@ -364,6 +410,10 @@ def run_golden(sim_config, batch: TraceBatch,
 
     # main loop: smallest-clock runnable tile first
     while True:
+        # state-conditioned rendezvous kinds wake lazily here
+        for t in tiles:
+            if t.blocked and t.blocked[0] in ("bsync", "cjoin"):
+                try_unblock(t)
         run = [t for t in tiles if runnable(t)]
         if not run:
             # every tile done, or deadlock (mirrors the engine's detector)

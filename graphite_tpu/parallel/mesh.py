@@ -44,8 +44,10 @@ def _tile_spec(leaf: jax.Array) -> P:
 # and global scalars.
 _REPLICATED_STATE_FIELDS = {
     "barrier_count", "barrier_arrived", "barrier_time_ps",
+    "barrier_gen", "barrier_release_ps",
     "mutex_locked", "mutex_owner", "mutex_time_ps",
     "cond_sig_time_ps", "cond_bcast_time_ps",
+    "cond_sig_seq", "cond_sig_seq_ps",
     "models_enabled", "overflow",
     # functional word store: a global address space, replicated (the
     # coherence protocol serializes conflicting writes)

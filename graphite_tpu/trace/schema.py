@@ -121,6 +121,18 @@ class Op(enum.IntEnum):
     # Functional execution happens host-side (system/syscall_server.py);
     # replay charges the SYSTEM-network round trip to the MCP.
     SYSCALL = 51
+    # --- co-located-thread sync forms (the live frontend's split ops) ---
+    # Threads sharing a tile serialize onto ONE engine lane; a blocking
+    # record whose resolution lies LATER on the same lane would deadlock
+    # the replay.  The live frontend therefore splits blocking sync into a
+    # non-blocking contribution at call time and a rendezvous at functional
+    # completion time (recorded after the thread is rescheduled, hence
+    # after any co-located segments that ran meanwhile):
+    BARRIER_ARRIVE = 52  # aux0=barrier id: count the arrival, don't block
+    BARRIER_SYNC = 53    # aux0=id, aux1=generation: wait for release #gen
+    COND_JOIN = 54       # aux0=cond id, aux1=signal seq: wait for it, take
+    #                      its time (pairs with MUTEX_UNLOCK at wait start
+    #                      + MUTEX_LOCK re-acquire after)
     NOP = 255          # padding past THREAD_EXIT
 
 
@@ -341,11 +353,25 @@ class TraceBuilder:
     def cond_wait(self, cond: int, mux: int) -> "TraceBuilder":
         return self._append(Op.COND_WAIT, aux0=cond, aux1=mux)
 
-    def cond_signal(self, cond: int) -> "TraceBuilder":
-        return self._append(Op.COND_SIGNAL, aux0=cond)
+    def cond_signal(self, cond: int, publish: bool = False) -> "TraceBuilder":
+        # publish=True: the live frontend's sequence-published form (bumps
+        # the cond's signal counter for COND_JOIN waiters)
+        return self._append(Op.COND_SIGNAL, aux0=cond,
+                            aux1=1 if publish else 0)
 
-    def cond_broadcast(self, cond: int) -> "TraceBuilder":
-        return self._append(Op.COND_BROADCAST, aux0=cond)
+    def cond_broadcast(self, cond: int,
+                       publish: bool = False) -> "TraceBuilder":
+        return self._append(Op.COND_BROADCAST, aux0=cond,
+                            aux1=1 if publish else 0)
+
+    def cond_join(self, cond: int, seq: int) -> "TraceBuilder":
+        return self._append(Op.COND_JOIN, aux0=cond, aux1=seq)
+
+    def barrier_arrive(self, bar: int) -> "TraceBuilder":
+        return self._append(Op.BARRIER_ARRIVE, aux0=bar)
+
+    def barrier_sync(self, bar: int, generation: int) -> "TraceBuilder":
+        return self._append(Op.BARRIER_SYNC, aux0=bar, aux1=generation)
 
     def barrier_init(self, bar: int, count: int) -> "TraceBuilder":
         return self._append(Op.BARRIER_INIT, aux0=bar, aux1=count)
