@@ -90,6 +90,112 @@ class _Net:
         return cycles_to_ps(cycles, self.freq_mhz) if enabled else 0
 
 
+class _HbhNet:
+    """Serial per-hop emesh_hop_by_hop oracle: the reference's hop loop
+    (`network_model_emesh_hop_by_hop.cc:146-265` + router contention)
+    implemented one packet at a time over per-port queue dicts — the
+    independent counterpart of the engine's dense-grid formulation (which
+    must match it exactly for cross-call queueing; same-call packet
+    batching follows the engine's documented approximation contract, so
+    differential tests use serialized traffic)."""
+
+    def __init__(self, p):
+        self.p = p  # HopByHopParams (config-derived constants)
+        self.q: dict[int, dict] = {}  # qid -> queue scalars
+
+    def _queue(self, qid):
+        return self.q.setdefault(qid, dict(
+            qt=0, ws=0, sum_st=0, sum_st2=0, n=0, newest=0))
+
+    def _delay(self, qid, t, proc):
+        s = self._queue(qid)
+        qp = self.p.queue
+        if qp.kind in ("history_list", "history_tree"):
+            if qp.analytical_enabled and (t + proc) < s["ws"]:
+                # M/G/1 fallback from running moments (mirrors
+                # queue_models._mg1_wait)
+                import math
+
+                if s["n"] == 0:
+                    return 0, True
+                mean = s["sum_st"] / s["n"]
+                var = s["sum_st2"] / s["n"] - mean * mean
+                mu = 1.0 / max(mean, 1e-12)
+                lam = min(s["n"] / max(s["newest"], 1e-12), 0.999 * mu)
+                w = 0.5 * mu * lam * (1.0 / (mu * mu) + var) / (mu - lam)
+                return int(math.ceil(w)), True
+            return max(s["qt"] - t, 0), False
+        return max(s["qt"] - t, 0), False
+
+    def _commit(self, qid, t, delay, proc):
+        s = self._queue(qid)
+        qp = self.p.queue
+        in_window = True
+        if qp.kind in ("history_list", "history_tree"):
+            in_window = not (qp.analytical_enabled
+                             and (t + proc) < s["ws"])
+        if in_window:
+            s["qt"] = max(s["qt"], t) + proc
+            s["ws"] = max(s["ws"], s["qt"] - qp.history_span)
+        s["sum_st"] += proc
+        s["sum_st2"] += proc * proc
+        s["n"] += 1
+        s["newest"] = max(s["newest"], t + delay + proc)
+
+    def route(self, src, dst, payload_bytes, t_send_ps, enabled):
+        """Returns the arrival time in ps (absolute)."""
+        from graphite_tpu.models.network_hop_by_hop import (
+            NUM_PORTS, PORT_DOWN, PORT_INJECT, PORT_LEFT, PORT_RIGHT,
+            PORT_SELF, PORT_UP,
+        )
+
+        p = self.p
+        if not enabled:
+            return t_send_ps
+        bits = (HEADER_BYTES + payload_bytes) * 8
+        flits = max(_ceil_div(bits, p.flit_width_bits), 1)
+        # Time::toCycles is ceil (`time_types.h:104-109`)
+        t = _ceil_div(t_send_ps * p.freq_mhz, 10**6)
+
+        def hop_delay(qid, t):
+            if not p.contention_enabled:
+                return 0
+            d, _ = self._delay(qid, t, flits)
+            self._commit(qid, t, d, flits)
+            return d
+
+        # injection
+        t = t + p.router_delay + hop_delay(
+            src * NUM_PORTS + PORT_INJECT, t)
+        # XY route, scalar arithmetic (independent of the engine's helper)
+        w = p.mesh_width
+        cx, cy = src % w, src // w
+        tx, ty = dst % w, dst // w
+        while True:
+            if cx < tx:
+                port, cx = PORT_RIGHT, cx + 1
+            elif cx > tx:
+                port, cx = PORT_LEFT, cx - 1
+            elif cy < ty:
+                port, cy = PORT_UP, cy + 1
+            elif cy > ty:
+                port, cy = PORT_DOWN, cy - 1
+            else:
+                port = PORT_SELF
+            # the queue consulted is the port at the tile BEFORE moving
+            at = ((cy if port in (PORT_SELF, PORT_RIGHT, PORT_LEFT)
+                   else cy - (1 if port == PORT_UP else -1)) * w
+                  + (cx if port in (PORT_SELF, PORT_UP, PORT_DOWN)
+                     else cx - (1 if port == PORT_RIGHT else -1)))
+            t = t + p.router_delay + p.link_delay + hop_delay(
+                at * NUM_PORTS + port, t)
+            if port == PORT_SELF:
+                break
+        if src != dst:
+            t += flits
+        return cycles_to_ps(int(t), p.freq_mhz)
+
+
 class _Tile:
     __slots__ = ("tid", "clock", "idx", "done", "blocked", "counts")
 
@@ -122,6 +228,10 @@ def run_golden(sim_config, batch: TraceBatch,
     net_kind = cfg.get_string("network/user", "magic")
     if net_kind == "magic":
         net = _Net("magic", 1000, 0, 0, -1)
+    elif net_kind == "emesh_hop_by_hop":
+        from graphite_tpu.models.network_hop_by_hop import HopByHopParams
+
+        net = _HbhNet(HopByHopParams.from_config(sim_config, "user"))
     else:
         from graphite_tpu.models.network_user import mesh_dims
 
@@ -299,8 +409,12 @@ def run_golden(sim_config, batch: TraceBatch,
                 t.clock += cycles_to_ps(aux1, freq_mhz) + acc
                 t.counts["instr"] += aux0
         elif op == Op.SEND:
-            lat = net.latency_ps(t.tid, aux0, aux1, enabled[0])
-            channels.setdefault((t.tid, aux0), []).append(t.clock + lat)
+            if isinstance(net, _HbhNet):
+                arrival = net.route(t.tid, aux0, aux1, t.clock, enabled[0])
+            else:
+                arrival = t.clock + net.latency_ps(
+                    t.tid, aux0, aux1, enabled[0])
+            channels.setdefault((t.tid, aux0), []).append(arrival)
             for other in tiles:
                 if other.blocked and other.blocked[0] == "recv":
                     try_unblock(other)

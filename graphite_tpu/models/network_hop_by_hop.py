@@ -23,10 +23,13 @@ zero-load latencies in `memory/engine.py`).  The knob is therefore not
 parsed rather than parsed-and-dead.
 
 TPU-native form: instead of per-tile router objects called hop-by-hop on
-the receiving process's sim thread, ALL in-flight packets advance one hop
-per `lax.fori_loop` step; port occupancies live in one flat QueueArrays
-[n_tiles*6 + scratch] updated with scatter-max/add (see
-`scatter_queue_delay` for the conflict-approximation contract).
+the receiving process's sim thread, every packet's whole path is resolved
+at once as dense [packets, h, w] grid math (`_dense_contention`): an
+exact max-plus scan of the serial hop recurrence gives per-cell read
+times, and the flat QueueArrays [n_tiles*6 + scratch] occupancies commit
+with dense reductions — no gather/scatter kernels anywhere.  The serial
+semantics are pinned by `tests/test_hop_by_hop.py`, including
+differentials against the golden interpreter's independent per-hop loop.
 
 Ports: 0=RIGHT 1=LEFT 2=UP 3=DOWN 4=SELF 5=INJECT.
 """
@@ -130,15 +133,12 @@ def route_hop_by_hop(
     """Route one packet per lane; returns (nst, arrival_ps, zero_load_ps,
     contention_ps).
 
-    Dense formulation: each packet's XY path (a static unrolled
-    elementwise computation — no per-hop loop) becomes a [L, H+1] matrix
-    of (port queue, step) cells — column 0 the injection port, columns
-    1..dist+1 the mesh hops including the SELF delivery step.  Contention
-    is resolved against the PRE-call port state for every cell at once
-    (one gather), with per-packet compounding of upstream delays applied
-    by a two-pass fixed point (delays only shrink as arrivals grow, so
-    two passes bracket the serial value), and the port occupancies are
-    committed with one scatter-max/add round per call.
+    Dense formulation: each packet's XY path lives on [L, h, w] grids
+    (horizontal run, vertical run, inject + SELF cells); per-cell read
+    times come from an EXACT max-plus scan of the serial hop recurrence
+    (see _dense_contention), all against the PRE-call port state, and
+    occupancies commit with dense reductions — no gather/scatter
+    kernels.
 
     This extends `scatter_queue_delay`'s same-call-conflict contract from
     single cells to whole paths: packets routed in the SAME subquantum
@@ -202,9 +202,9 @@ def _dense_contention(p, q, live, flits, t0, sx, sy, dx, dy, dist):
     contract lifted to paths: every cell's delay is read against the
     PRE-call port state (packets in one subquantum iteration see each
     other only through the next iteration's state), a packet's own
-    upstream delays compound via a two-pass fixed point, and occupancy
-    commits exactly (max of arrivals, then the sum of every processing
-    time).
+    upstream compounding is EXACT (max-plus closed form of the serial
+    hop recurrence), and occupancy commits exactly (max of arrivals,
+    then the sum of every processing time).
     """
     L = live.shape[0]
     w, h = p.mesh_width, p.mesh_height
@@ -263,60 +263,71 @@ def _dense_contention(p, q, live, flits, t0, sx, sy, dx, dy, dist):
         (PORT_INJECT, m_inject, None, None),
     )
 
-    def arr0_of(steps):
-        # arrival BEFORE paying the cell's own router (serial-loop order)
-        return t0_ + p.router_delay + steps * step_cyc
+    # ---- EXACT per-packet arrivals via a max-plus scan ------------------
+    # The serial hop recurrence t_{j+1} = step + max(t_j, qt_j) has the
+    # closed form t_j = s_j*step + max(base, max_{i<j}(qt_i - s_i*step)),
+    # so each cell's read time is a directional EXCLUSIVE cummax of
+    # (qt - steps*step) along the path — bit-identical to the serial loop
+    # for in-window traffic.  The M/G/1 too-old fallback substitutes its
+    # analytical wait at the scanned read time; its (rare, deep-backlog)
+    # downstream compounding is approximate — documented with the
+    # windowed-tail queue model itself.
+    NEG = -(2**61)
 
-    def prefix(dly, order):
-        """Exclusive prefix of a packet's own delays along path order."""
-        if order == "x+":
-            return jnp.cumsum(dly, axis=2) - dly
-        if order == "x-":
-            r = jnp.flip(jnp.cumsum(jnp.flip(dly, 2), axis=2), 2)
-            return r - dly
-        if order == "y+":
-            return jnp.cumsum(dly, axis=1) - dly
-        if order == "y-":
-            r = jnp.flip(jnp.cumsum(jnp.flip(dly, 1), axis=1), 1)
-            return r - dly
-        return jnp.zeros_like(dly)
+    def qt_of(d):
+        return port_state(d)[..., qm.COL_QT]
 
-    def resolve(pass_delays):
-        """One fixed-point pass: per-plane delays given upstream delays
-        from the previous pass (None = zero-load arrivals)."""
-        if pass_delays is None:
-            inj_prev = jnp.zeros((L, 1, 1), I64)
-            h_prev = v_prev = None
+    # injection: read at t0 (one cell per packet)
+    d_inj_cells, too_inj = delay_at(
+        PORT_INJECT, jnp.broadcast_to(t0_, m_inject.shape), m_inject)
+    base = t0_ + p.router_delay + d_inj_cells.sum((1, 2))[:, None, None]
+
+    going_right = (dx > sx)[:, None, None]
+    going_up = (dy > sy)[:, None, None]
+
+    def excl_cummax(v, axis, forward):
+        c = lax.cummax(v, axis=axis, reverse=not forward)
+        # shift one along the direction to make it exclusive
+        pad = [(0, 0)] * v.ndim
+        pad[axis] = (1, 0) if forward else (0, 1)
+        sl = [slice(None)] * v.ndim
+        sl[axis] = slice(0, -1) if forward else slice(1, None)
+        return jnp.pad(c[tuple(sl)], pad, constant_values=NEG)
+
+    # horizontal field (each packet uses RIGHT xor LEFT)
+    qt_h = jnp.where(m_right, qt_of(PORT_RIGHT),
+                     jnp.where(m_left, qt_of(PORT_LEFT), NEG))
+    v_h = jnp.where(m_right | m_left, qt_h - steps_h * step_cyc, NEG)
+    excl_h = jnp.where(going_right, excl_cummax(v_h, 2, True),
+                       excl_cummax(v_h, 2, False))
+    t_read_h = steps_h * step_cyc + jnp.maximum(base, excl_h)
+    h_all = jnp.max(v_h, axis=(1, 2), keepdims=True)
+
+    # vertical field (UP xor DOWN), carrying the whole horizontal segment
+    qt_v = jnp.where(m_up, qt_of(PORT_UP),
+                     jnp.where(m_down, qt_of(PORT_DOWN), NEG))
+    v_v = jnp.where(m_up | m_down, qt_v - steps_v * step_cyc, NEG)
+    carry_v = jnp.maximum(base, h_all)
+    excl_v = jnp.where(going_up, excl_cummax(v_v, 1, True),
+                       excl_cummax(v_v, 1, False))
+    t_read_v = steps_v * step_cyc + jnp.maximum(carry_v, excl_v)
+    v_all = jnp.max(v_v, axis=(1, 2), keepdims=True)
+
+    # SELF delivery cell: everything upstream
+    t_read_s = steps_self * step_cyc + jnp.maximum(carry_v, v_all)
+
+    d1 = {}
+    arrs = {}
+    for d, member, steps, order in planes:
+        if d == PORT_INJECT:
+            arr = jnp.broadcast_to(t0_, member.shape)
+            dly, too_old = d_inj_cells, too_inj
         else:
-            inj_prev = pass_delays[PORT_INJECT].sum((1, 2))[:, None, None]
-            h_prev = pass_delays[PORT_RIGHT] + pass_delays[PORT_LEFT]
-            v_prev = pass_delays[PORT_UP] + pass_delays[PORT_DOWN]
-        h_tot = (0 if h_prev is None
-                 else h_prev.sum((1, 2))[:, None, None])
-        v_tot = (0 if v_prev is None
-                 else v_prev.sum((1, 2))[:, None, None])
-        out = {}
-        arrs = {}
-        for d, member, steps, order in planes:
-            if d == PORT_INJECT:
-                arr = jnp.broadcast_to(t0_, member.shape)
-            else:
-                arr = arr0_of(steps) + inj_prev
-                if order in ("x+", "x-") and h_prev is not None:
-                    arr = arr + prefix(h_prev, order)
-                elif order in ("y+", "y-"):
-                    arr = arr + h_tot
-                    if v_prev is not None:
-                        arr = arr + prefix(v_prev, order)
-                elif order is None and d == PORT_SELF:
-                    arr = arr + h_tot + v_tot
+            arr = (t_read_h if order in ("x+", "x-")
+                   else t_read_v if order in ("y+", "y-") else t_read_s)
             dly, too_old = delay_at(d, arr, member)
-            out[d] = dly
-            arrs[d] = (arr, too_old, member)
-        return out, arrs
-
-    d0, _ = resolve(None)
-    d1, arrs = resolve(d0)
+        d1[d] = dly
+        arrs[d] = (arr, too_old, member)
 
     # ---- commit occupancy per port plane (dense reductions over L) ------
     new_grid = grid
