@@ -144,16 +144,18 @@ scheme = lax_barrier
 quantum = 1000
 """
 
-    def _diff(self, batch):
-        import numpy as np
-
+    def _run_both(self, batch):
         from graphite_tpu.config import ConfigFile, SimConfig
         from graphite_tpu.engine.simulator import Simulator
         from graphite_tpu.golden import run_golden
 
         sc = SimConfig(ConfigFile.from_string(self.CFG))
-        res = Simulator(sc, batch).run()
-        gold = run_golden(sc, batch)
+        return Simulator(sc, batch).run(), run_golden(sc, batch)
+
+    def _diff(self, batch):
+        import numpy as np
+
+        res, gold = self._run_both(batch)
         np.testing.assert_array_equal(res.clock_ps, gold.clock_ps)
         np.testing.assert_array_equal(
             res.recv_instructions, gold.recv_instructions)
@@ -209,16 +211,14 @@ quantum = 1000
         bit-exact (tests above)."""
         import numpy as np
 
-        from graphite_tpu.config import ConfigFile, SimConfig
-        from graphite_tpu.engine.simulator import Simulator
-        from graphite_tpu.golden import run_golden
         from graphite_tpu.trace import synthetic
 
-        sc = SimConfig(ConfigFile.from_string(self.CFG))
         batch = synthetic.message_ring_batch(
             16, n_rounds=30, compute_per_round=7, pattern="uniform_random")
-        res = Simulator(sc, batch).run()
-        gold = run_golden(sc, batch)
+        res, gold = self._run_both(batch)
+        # NOTE: recv_instructions cannot be asserted exactly here — it
+        # counts only receives that WAITED (arrival > clock), which is
+        # itself timing-dependent and shifts with the contention deltas
         rel = np.abs(res.clock_ps.astype(float)
                      - gold.clock_ps.astype(float))
         rel = rel / np.maximum(gold.clock_ps.astype(float), 1.0)
