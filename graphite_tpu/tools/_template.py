@@ -67,3 +67,24 @@ scheme = {clock_scheme}
 quantum = 1000
 {dvfs_section}
 """
+
+
+def coherence_stress_workload(n_tiles: int, *, n_accesses: int = 40,
+                              protocol: str =
+                              "pr_l1_pr_l2_dram_directory_msi"):
+    """The shared cross-shard coherence attestation workload: one config +
+    trace used by BOTH the sharding test matrix (tests/test_sharding.py)
+    and the driver's multichip dryrun (__graft_entry__.py), so the two
+    cannot drift apart.  shared_fraction drives cross-tile (and, sharded,
+    cross-device) protocol traffic: line homes stripe over ALL tiles
+    (`dram/num_controllers` ALL), so requests/replies/invalidations cross
+    every shard cut.  Returns (SimConfig, TraceBatch)."""
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.trace import synthetic
+
+    sc = SimConfig(ConfigFile.from_string(config_text(
+        n_tiles, shared_mem=True, protocol=protocol, clock_scheme="lax")))
+    batch = synthetic.memory_stress_trace(
+        n_tiles, n_accesses=n_accesses, working_set_bytes=1 << 13,
+        write_fraction=0.4, shared_fraction=0.5, seed=7)
+    return sc, batch
