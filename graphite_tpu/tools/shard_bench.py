@@ -1,0 +1,84 @@
+"""Measure multi-device step wall-clock vs single-device (virtual mesh).
+
+VERDICT/PERF follow-up: `parallel/mesh.py` replicates the sync tables and
+`func_mem` and relies on whole-program GSPMD — the concern is that mailbox
+scatters and replicated-buffer updates lower to cross-device collectives
+that make the 8-device step *slower* than one device.  Real ICI speedups
+cannot be measured on one chip; what a virtual CPU mesh CAN measure is
+pathology: if the 8-device program is catastrophically slower than the
+single-device program on identical hardware resources, the sharded lowering
+is broken.  Run:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m graphite_tpu.tools.shard_bench
+
+Prints one line per (workload, devices) with wall-clock and the
+sharded/single ratio.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+
+def _timed(sc, batch, mesh, repeats=3):
+    """Best-of-N wall-clock of the compiled run, compile excluded: warm up
+    and time the SAME Simulator instance (each instance owns its own jitted
+    runner), restoring the initial state between repeats."""
+    from graphite_tpu.engine.simulator import Simulator
+
+    sim = Simulator(sc, batch, mesh=mesh)
+    init_state = sim.state
+    sim.warmup()
+    best = float("inf")
+    res = None
+    for _ in range(repeats):
+        sim.state = init_state
+        t0 = time.perf_counter()
+        res = sim.run()
+        best = min(best, time.perf_counter() - t0)
+    return best, res
+
+
+def main():
+    # the ambient TPU-tunnel sitecustomize can override JAX_PLATFORMS at
+    # interpreter startup; flip it back (same recipe as tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= 2, (
+        "needs a multi-device platform: run with JAX_PLATFORMS=cpu "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+
+    from graphite_tpu.parallel.mesh import make_tile_mesh
+    from graphite_tpu.tools._template import coherence_stress_workload, config_text
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.trace import synthetic
+
+    n_dev = len(jax.devices())
+    results = []
+
+    # workload 1: full-MSI coherence stress (the [T, T] mailbox path)
+    sc, batch = coherence_stress_workload(64, n_accesses=200)
+    t1, r1 = _timed(sc, batch, None)
+    t8, r8 = _timed(sc, batch, make_tile_mesh(n_dev))
+    np.testing.assert_array_equal(r1.clock_ps, r8.clock_ps)
+    results.append(("msi_stress_64t", t1, t8))
+
+    # workload 2: memoryless message ring (the USER-net mailbox path)
+    sc2 = SimConfig(ConfigFile.from_string(config_text(64)))
+    batch2 = synthetic.message_ring_batch(64, n_rounds=64,
+                                          compute_per_round=8)
+    t1b, _ = _timed(sc2, batch2, None)
+    t8b, _ = _timed(sc2, batch2, make_tile_mesh(n_dev))
+    results.append(("ring_64t", t1b, t8b))
+
+    for name, a, b in results:
+        print(f"{name}: single={a*1e3:.0f} ms  {n_dev}dev={b*1e3:.0f} ms  "
+              f"ratio={b/a:.2f}x")
+    return results
+
+
+if __name__ == "__main__":
+    main()
