@@ -697,6 +697,15 @@ def subquantum_iteration(
     # stamps its time; COND_JOIN(k) waits for sequence >= k and takes the
     # stamped time (the waiter's wake).  The mutex dance around it uses
     # plain MUTEX_UNLOCK / MUTEX_LOCK records (see schema).
+    # Same-iteration race contract: when two lanes publish to one cond in
+    # the SAME subquantum iteration, both lanes read the post-scatter-add
+    # sequence, so only the final sequence's ring slot is stamped (with
+    # the max of both clocks) and the intermediate slot keeps its stale
+    # time — a COND_JOIN on the intermediate sequence then takes a
+    # bounded-stale timestamp.  Same class as the reference's racy
+    # same-instant signal ordering (its MCP serves them in host-arrival
+    # order); recorded traces order same-cond publishes through the
+    # recording app's own locking, so the window is one engine iteration.
     pub_now = active & (is_csig | is_cbcast) & (aux1 > 0)
 
     def _pub_block(_):

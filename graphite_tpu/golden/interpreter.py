@@ -144,6 +144,13 @@ class _HbhNet:
 
     def route(self, src, dst, payload_bytes, t_send_ps, enabled):
         """Returns the arrival time in ps (absolute)."""
+        return self.route_bits(
+            src, dst, (HEADER_BYTES + payload_bytes) * 8, t_send_ps,
+            enabled)
+
+    def route_bits(self, src, dst, bits, t_send_ps, enabled):
+        """Route a packet of `bits` modeled length (no NetPacket header —
+        the MEMORY net's ShmemMsg lengths are carried raw)."""
         from graphite_tpu.models.network_hop_by_hop import (
             NUM_PORTS, PORT_DOWN, PORT_INJECT, PORT_LEFT, PORT_RIGHT,
             PORT_SELF, PORT_UP,
@@ -152,7 +159,6 @@ class _HbhNet:
         p = self.p
         if not enabled:
             return t_send_ps
-        bits = (HEADER_BYTES + payload_bytes) * 8
         flits = max(_ceil_div(bits, p.flit_width_bits), 1)
         # Time::toCycles is ceil (`time_types.h:104-109`)
         t = _ceil_div(t_send_ps * p.freq_mhz, 10**6)
@@ -194,6 +200,45 @@ class _HbhNet:
         if src != dst:
             t += flits
         return cycles_to_ps(int(t), p.freq_mhz)
+
+    def fanout(self, src, targets, bits, t0_ps, enabled, n_copies=None,
+               ranks=None):
+        """A home's multicast, mirroring the ENGINE's shared fan-out
+        approximation (`memory/engine.py mem_net_fanout`): ONE inject-port
+        charge of n_copies*flits, rank-of-target serialization (by tile
+        id), then each copy's zero-load path — intermediate-hop queues are
+        neither read nor committed for fan-out copies.  This is the one
+        piece of the memory NoC the oracle shares with the engine by
+        construction instead of independently (documented there); all
+        unicast flows remain independently per-hop modeled.  Returns
+        {target: arrival_ps}."""
+        from graphite_tpu.models.network_hop_by_hop import (
+            NUM_PORTS, PORT_INJECT,
+        )
+
+        p = self.p
+        targets = sorted(targets)
+        if not enabled or not targets:
+            return {s: t0_ps for s in targets}
+        flits = max(_ceil_div(bits, p.flit_width_bits), 1)
+        k = n_copies if n_copies is not None else len(targets)
+        t0 = _ceil_div(t0_ps * p.freq_mhz, 10**6)
+        inj = 0
+        if p.contention_enabled:
+            qid = src * NUM_PORTS + PORT_INJECT
+            inj, _ = self._delay(qid, t0, k * flits)
+            self._commit(qid, t0, inj, k * flits)
+        w = p.mesh_width
+        step = p.router_delay + p.link_delay
+        out = {}
+        for i, s in enumerate(targets):
+            rank = ranks[s] if ranks is not None else i
+            hops = abs(src % w - s % w) + abs(src // w - s // w)
+            zl = p.router_delay + (hops + 1) * step + (
+                0 if s == src else flits)
+            out[s] = t0_ps + cycles_to_ps(
+                int(zl + inj + rank * flits), p.freq_mhz)
+        return out
 
 
 class _Tile:

@@ -198,6 +198,16 @@ class GoldenMemory:
         self.l2_cloc = [dict() for _ in range(T)]
         self.homes = {h: _Home(mp.dir_sets, mp.dir_ways)
                       for h in mp.mc_tiles}
+        # serial per-hop MEMORY net when `[network] memory =
+        # emesh_hop_by_hop` — the independent counterpart of the engine's
+        # mem_net_send routing (fan-outs share the engine's approximation;
+        # see _HbhNet.fanout)
+        if mp.net_hbh is not None:
+            from graphite_tpu.golden.interpreter import _HbhNet
+
+            self.net = _HbhNet(mp.net_hbh)
+        else:
+            self.net = None
         self.instr_buf = [-1] * T
         self.counters = {
             k: [0] * T
@@ -230,6 +240,27 @@ class GoldenMemory:
         if src != dst:
             cycles += _ceil_div(bits, mp.flit_width_bits)
         return _cycles_to_ps(cycles, mp.net_freq_mhz) if enabled else 0
+
+    def _net_arrive(self, src: int, dst: int, bits: int, t_send: int,
+                    enabled: bool) -> int:
+        """Arrival time of a unicast coherence message sent at t_send —
+        per-hop serial routing under memory = emesh_hop_by_hop, else
+        t_send + zero-load."""
+        if self.net is not None:
+            return self.net.route_bits(src, dst, bits, t_send, enabled)
+        return t_send + self._net_ps(src, dst, bits, enabled)
+
+    def _net_fanout(self, src: int, targets, bits: int, t0: int,
+                    enabled: bool, n_copies=None, ranks=None) -> dict:
+        """{target: arrival} for a home's multicast (engine contract —
+        see _HbhNet.fanout).  Broadcast sweeps pass n_copies (total
+        copies occupying the inject port) and ranks (target -> rank
+        among ALL copies)."""
+        if self.net is not None:
+            return self.net.fanout(src, targets, bits, t0, enabled,
+                                   n_copies, ranks)
+        return {s: t0 + self._net_ps(src, s, bits, enabled)
+                for s in targets}
 
     def _dram_ps(self, enabled: bool) -> int:
         mp = self.mp
@@ -320,7 +351,7 @@ class GoldenMemory:
             self.l2[s].set_state(line, way, wb_state)
         ack_bits = mp.req_bits if kind == "inv" else mp.rep_bits
         supplies = kind in ("flush", "wb")
-        return done + self._net_ps(s, home, ack_bits, enabled), supplies
+        return self._net_arrive(s, home, ack_bits, done, enabled), supplies
 
     # -- the directory transaction (`dram_directory_cntlr.cc:44-559`) ------
 
@@ -427,8 +458,8 @@ class GoldenMemory:
                 self.counters["dram_total_lat_ps"][home] += \
                     self._dram_ps(True)
             hm.last_line, hm.last_done_ps = line, rep_ready
-            return rep_ready + self._net_ps(home, requester, mp.rep_bits,
-                                            enabled)
+            return self._net_arrive(home, requester, mp.rep_bits,
+                                    rep_ready, enabled)
 
         # (b) fan-out: build the (target -> message kind) map
         if mp.is_mosi:
@@ -482,16 +513,31 @@ class GoldenMemory:
             entry.sharers = set()
             modified = False
 
-        if (mp.dir_type in ("ackwise", "limited_broadcast") and fan_inv
-                and len(sharers) > k and enabled):
+        broadcast = (mp.dir_type in ("ackwise", "limited_broadcast")
+                     and fan_inv and len(sharers) > k)
+        if broadcast and enabled:
             self.counters["dir_broadcasts"][home] += 1
 
-        # serve each forwarded request; acks gate the finish
+        # serve each forwarded request; acks gate the finish.  An
+        # overflowed-entry INV sweep broadcasts to EVERY tile (the
+        # engine's `send | over_bc` row): the inject port then carries T
+        # copies and each true holder's copy ranks by its tile id among
+        # all T — non-holders drop theirs silently, but their copies
+        # still occupy the port (n_copies/ranks mirror the engine's
+        # cumsum over the full broadcast row)
         txn_time = eff_time
         got_data = False
         dir_acc = self._dir_ps(mp.dir_access_cycles, enabled)
+        if broadcast:
+            f_arrivals = self._net_fanout(
+                home, list(targets), mp.req_bits, eff_time, enabled,
+                n_copies=mp.n_tiles,
+                ranks={s: s for s in targets})
+        else:
+            f_arrivals = self._net_fanout(home, list(targets), mp.req_bits,
+                                          eff_time, enabled)
         for s in sorted(targets):
-            f_arrive = eff_time + self._net_ps(home, s, mp.req_bits, enabled)
+            f_arrive = f_arrivals[s]
             ack_time, supplies = self._serve_fwd(
                 s, targets[s], line, f_arrive, home, enabled)
             txn_time = max(txn_time, ack_time + dir_acc)
@@ -527,8 +573,8 @@ class GoldenMemory:
         hm.last_line, hm.last_done_ps = line, rep_ready
         if is_nullify:
             return None
-        return rep_ready + self._net_ps(home, requester, mp.rep_bits,
-                                        enabled)
+        return self._net_arrive(home, requester, mp.rep_bits, rep_ready,
+                                enabled)
 
     # -- requester slot (`l1_cache_cntlr.cc:90-180` + reply fill) ----------
 
@@ -602,10 +648,10 @@ class GoldenMemory:
             self.l2_cloc[t].pop((line % self.l2[t].sets, l2_way), None)
             self._apply_eviction(
                 t, line, dirty,
-                req_send + self._net_ps(t, home, mp.req_bits, enabled),
+                self._net_arrive(t, home, mp.req_bits, req_send, enabled),
                 enabled)
 
-        arrival = req_send + self._net_ps(t, home, mp.req_bits, enabled)
+        arrival = self._net_arrive(t, home, mp.req_bits, req_send, enabled)
         rep_time = self._home_txn(home, t, line, write, arrival, enabled)
 
         # reply fill (`handleMsgFromDramDirectory` + insertCacheLine)
@@ -618,11 +664,11 @@ class GoldenMemory:
                 c["evictions"][t] += 1
             v_dirty = v_state in (MODIFIED, OWNED)
             v_home = self._home_of(v_line)
-            e_lat = self._net_ps(
-                t, v_home, mp.rep_bits if v_dirty else mp.req_bits, enabled)
+            e_arr = self._net_arrive(
+                t, v_home, mp.rep_bits if v_dirty else mp.req_bits,
+                fill_l2, enabled)
             self.l2_cloc[t].pop((v_line % self.l2[t].sets, v_way), None)
-            self._apply_eviction(t, v_line, v_dirty, fill_l2 + e_lat,
-                                 enabled)
+            self._apply_eviction(t, v_line, v_dirty, e_arr, enabled)
         l2.insert_at(line, v_way, new_state)
         self._fill_l1(t, is_icache, line, new_state, v_way)
         done = fill_l2 + l1_dat

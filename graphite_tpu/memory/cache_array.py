@@ -116,6 +116,7 @@ class CacheRow:
     st: jax.Array    # int32[T, W]  (int32 for arithmetic convenience)
     lru: jax.Array   # int32[T, W]
     sets: jax.Array  # int32[T]
+    meta0: jax.Array  # int64[T, W] packed words as gathered (delta base)
 
 
 def gather_row(cache: CacheArrays, line: jax.Array,
@@ -128,18 +129,22 @@ def gather_row(cache: CacheArrays, line: jax.Array,
     sets = (line % mod).astype(jnp.int32)
     meta = cache.meta[tiles, sets]                 # [T, W] — ONE gather
     tag, st, lru = _unpack(meta)
-    return CacheRow(tag=tag, st=st.astype(jnp.int32), lru=lru, sets=sets)
+    return CacheRow(tag=tag, st=st.astype(jnp.int32), lru=lru, sets=sets,
+                    meta0=meta)
 
 
 def scatter_row(cache: CacheArrays, row: CacheRow) -> CacheArrays:
     """Write each lane's row back — ONE scatter, no masking: the row_*
     ops are themselves masked per lane, so an untouched lane's row packs
-    back to exactly the live value (a redundant same-value write beats a
-    second gather to blend)."""
+    back to exactly the live value.  Written add-a-delta against the
+    gathered words (per-lane rows are distinct, so the add is exact):
+    the scatter is then the meta array's only remaining use and XLA
+    updates the loop-carried buffer in place instead of copying it."""
     T = cache.meta.shape[0]
     tiles = jnp.arange(T, dtype=jnp.int32)
     new_meta = _pack(row.tag, row.st, row.lru)
-    return cache.replace(meta=cache.meta.at[tiles, row.sets].set(new_meta))
+    return cache.replace(meta=cache.meta.at[tiles, row.sets].add(
+        new_meta - row.meta0, unique_indices=True, indices_are_sorted=True))
 
 
 def row_lookup(row: CacheRow, line: jax.Array):

@@ -176,6 +176,85 @@ def mem_net_latency_ps(mp: MemParams, src, dst, bits: int, enabled):
     return cycles_to_ps(cycles, mp.net_freq_mhz)
 
 
+def mem_net_send(mp: MemParams, noc, src, dst, bits, t0_ps, mask, enabled):
+    """Unicast a coherence message through the MEMORY network.
+
+    Returns (noc, arrival_ps[T]).  With `[network] memory =
+    emesh_hop_by_hop` (mp.net_hbh) the packet routes through the dense
+    per-hop contention engine on the memory NoC's own port-queue state
+    (`MemState.noc`) — the analog of the reference routing every ShmemMsg
+    through the configured memory network model
+    (`network_model_emesh_hop_by_hop.cc:146-265`, `carbon_sim.cfg:281`).
+    Otherwise zero-load hop-counter/magic math (state untouched)."""
+    if mp.net_hbh is None:
+        return noc, t0_ps + mem_net_latency_ps(mp, src, dst, bits, enabled)
+    from graphite_tpu.models.network_hop_by_hop import route_hop_by_hop
+
+    bits = jnp.broadcast_to(jnp.asarray(bits, I64), jnp.shape(src))
+    noc, arrival_ps, _, _ = route_hop_by_hop(
+        mp.net_hbh, noc, src, dst, bits, t0_ps, mask, enabled)
+    return noc, arrival_ps
+
+
+def mem_net_fanout(mp: MemParams, noc, send_hs, bits: int, t0_ps, enabled):
+    """A home's INV/FLUSH/WB multicast through the MEMORY network.
+
+    send_hs: bool[T(home), T(target)]; t0_ps: int64[T(home)].  Returns
+    (noc, arrival_ps[T, T]).
+
+    The reference (broadcast tree disabled, the default
+    `carbon_sim.cfg:304`) sends one unicast per target through the
+    memory model.  Dense per-pair routing would cost [T^2, h, w] grids,
+    so under hop_by_hop the fan-out charges the dominant contention
+    exactly and approximates the rest:
+     - the home's INJECT port serializes the k copies: each copy pays
+       the inject queue delay plus its rank among the targets (by tile
+       id, deterministic) times its flit count, and the port commits
+       k * flits of occupancy;
+     - each copy then pays the hop-by-hop ZERO-LOAD path cost (router +
+       per-hop router+link + receive serialization); intermediate-hop
+       queue contention for fan-out copies is NOT charged (documented
+       approximation — under the serialized oracle contract those queues
+       are empty, so serialized workloads remain exact).
+    """
+    T = mp.n_tiles
+    src = jnp.arange(T, dtype=jnp.int32)[:, None]
+    dst = jnp.arange(T, dtype=jnp.int32)[None, :]
+    if mp.net_hbh is None:
+        lat = mem_net_latency_ps(mp, src, dst, bits, enabled)
+        return noc, t0_ps[:, None] + lat
+    from graphite_tpu.models import queue_models as qm
+    from graphite_tpu.models.network_hop_by_hop import (
+        NUM_PORTS, PORT_INJECT,
+    )
+    from graphite_tpu.time_types import ps_to_cycles
+
+    p = mp.net_hbh
+    w = p.mesh_width
+    flits = max(1, (bits + p.flit_width_bits - 1) // p.flit_width_bits)
+    hops = (jnp.abs(src % w - dst % w)
+            + jnp.abs(src // w - dst // w)).astype(I64)
+    step = p.router_delay + p.link_delay
+    zl = p.router_delay + (hops + 1) * step + jnp.where(
+        src == dst, 0, flits)
+    fan = send_hs.any(axis=1)
+    k = send_hs.sum(axis=1, dtype=I64)
+    t0_cyc = ps_to_cycles(t0_ps, p.freq_mhz)
+    if p.contention_enabled:
+        qid = (jnp.arange(T, dtype=jnp.int32) * NUM_PORTS + PORT_INJECT)
+        queues, inj_delay = qm.scatter_queue_delay(
+            p.queue, noc.queues, qid, t0_cyc, k * flits,
+            fan & jnp.asarray(enabled, bool))
+        noc = noc.replace(queues=queues)
+    else:
+        inj_delay = jnp.zeros(T, I64)
+    rank = (jnp.cumsum(send_hs.astype(I64), axis=1) - 1)
+    cyc = zl + inj_delay[:, None] + rank * flits
+    cyc = jnp.where(jnp.asarray(enabled, bool), cyc, 0)
+    arrival = t0_ps[:, None] + cycles_to_ps(cyc, p.freq_mhz)
+    return noc, arrival
+
+
 @dataclasses.dataclass(frozen=True)
 class RecView:
     """Current trace record fields needed by the memory engine (all [T])."""
@@ -287,30 +366,35 @@ def _dir_gather(d, sets, way):
 
 def _dir_update(d, sets, way, mask, *, tags=None, dstate=None, owner=None,
                 sharers=None, nsharers=None):
-    """Masked per-lane write of one directory entry."""
+    """Masked per-lane write of one directory entry.
+
+    Add-a-delta scatters (new = cur + (new - cur) under mask): per-lane
+    indices are unique (row = lane), so the add is exact, and the scatter
+    becomes the array's only remaining use — XLA then updates the
+    loop-carried directory buffers in place instead of materializing a
+    copy per write (measured ~0.4 ms per copy of the [T, DS, DW, SW]
+    sharers tensor at 256 tiles; several writes per iteration)."""
     T = d.tags.shape[0]
     tiles = jnp.arange(T, dtype=jnp.int32)
     out = d
+
+    def delta(arr, new, m):
+        cur = arr[tiles, sets, way]
+        return arr.at[tiles, sets, way].add(
+            jnp.where(m, new - cur, jnp.zeros_like(cur)),
+            unique_indices=True, indices_are_sorted=True)
+
     if tags is not None:
-        cur = out.tags[tiles, sets, way]
-        out = out.replace(tags=out.tags.at[tiles, sets, way].set(
-            jnp.where(mask, tags, cur)))
+        out = out.replace(tags=delta(out.tags, tags, mask))
     if dstate is not None:
-        cur = out.dstate[tiles, sets, way]
-        out = out.replace(dstate=out.dstate.at[tiles, sets, way].set(
-            jnp.where(mask, jnp.asarray(dstate, jnp.uint8), cur)))
+        out = out.replace(dstate=delta(
+            out.dstate, jnp.asarray(dstate, jnp.uint8), mask))
     if owner is not None:
-        cur = out.owner[tiles, sets, way]
-        out = out.replace(owner=out.owner.at[tiles, sets, way].set(
-            jnp.where(mask, owner, cur)))
+        out = out.replace(owner=delta(out.owner, owner, mask))
     if sharers is not None:
-        cur = out.sharers[tiles, sets, way]
-        out = out.replace(sharers=out.sharers.at[tiles, sets, way].set(
-            jnp.where(mask[:, None], sharers, cur)))
+        out = out.replace(sharers=delta(out.sharers, sharers, mask[:, None]))
     if nsharers is not None:
-        cur = out.nsharers[tiles, sets, way]
-        out = out.replace(nsharers=out.nsharers.at[tiles, sets, way].set(
-            jnp.where(mask, nsharers, cur)))
+        out = out.replace(nsharers=delta(out.nsharers, nsharers, mask))
     return out
 
 
@@ -482,13 +566,15 @@ def memory_engine_step(
     l1_ev_line = jnp.where(l1i_ev, l1i_ev_line, l1d_ev_line)
     ev_hit, ev_way, _ = ca.lookup(ms.l2, l1_ev_line, mp.l2.sets_mod)
     ev_sets = (l1_ev_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-    l2_cloc = ms.l2_cloc.at[tiles, ev_sets, ev_way].set(
-        jnp.where(l1_ev & ev_hit, 0, ms.l2_cloc[tiles, ev_sets, ev_way]))
+    cur_cloc = ms.l2_cloc[tiles, ev_sets, ev_way]
+    l2_cloc = ms.l2_cloc.at[tiles, ev_sets, ev_way].add(
+        jnp.where(l1_ev & ev_hit, -cur_cloc, jnp.zeros_like(cur_cloc)))
     # record new cached-loc for the filled line
     f_sets = (s_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     new_cloc = jnp.where(s_comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8)
-    l2_cloc = l2_cloc.at[tiles, f_sets, l2_way].set(
-        jnp.where(l2_hit_now, new_cloc, l2_cloc[tiles, f_sets, l2_way]))
+    cur_cloc = l2_cloc[tiles, f_sets, l2_way]
+    l2_cloc = l2_cloc.at[tiles, f_sets, l2_way].add(
+        jnp.where(l2_hit_now, new_cloc - cur_cloc, jnp.zeros_like(cur_cloc)))
     if mp.l2.replacement != "round_robin":
         l2_row = ca.row_touch(l2_row, l2_way, l2_hit_now)
 
@@ -505,25 +591,26 @@ def memory_engine_step(
     l1d_upd = ca.scatter_row(ms.l1d, l1d_row)
     l2_upd = ca.scatter_row(ms.l2, l2_row)
     mail = ms.mail
+    noc = ms.noc
     up_msg = jnp.where(upgrade_dirty, MSG_FLUSH_REP,
                        MSG_INV_REP).astype(jnp.uint8)
     w_home = jnp.where(up_go, s_home, 0)
+    noc, up_arrival = mem_net_send(
+        mp, noc, tiles, s_home, mp.req_bits, req_send_ps, up_go, enabled)
     mail = mail.replace(
         evict_type=mail.evict_type.at[w_home, tiles].set(
             jnp.where(up_go, up_msg, mail.evict_type[w_home, tiles])),
         evict_line=mail.evict_line.at[w_home, tiles].set(
             jnp.where(up_go, s_line, mail.evict_line[w_home, tiles])),
         evict_time=mail.evict_time.at[w_home, tiles].set(
-            jnp.where(
-                up_go,
-                req_send_ps + mem_net_latency_ps(
-                    mp, tiles, s_home, mp.req_bits, enabled),
-                mail.evict_time[w_home, tiles])),
+            jnp.where(up_go, up_arrival,
+                      mail.evict_time[w_home, tiles])),
     )
     rq_type = jnp.where(s_write, MSG_EX_REQ, MSG_SH_REQ).astype(jnp.uint8)
     rq_home = jnp.where(l2_miss_go, s_home, 0)
-    rq_arrival = req_send_ps + mem_net_latency_ps(
-        mp, tiles, s_home, mp.req_bits, enabled)
+    noc, rq_arrival = mem_net_send(
+        mp, noc, tiles, s_home, mp.req_bits, req_send_ps, l2_miss_go,
+        enabled)
     mail = mail.replace(
         req_type=mail.req_type.at[rq_home, tiles].set(
             jnp.where(l2_miss_go, rq_type, mail.req_type[rq_home, tiles])),
@@ -585,35 +672,45 @@ def memory_engine_step(
 
     ms = ms.replace(
         l1i=l1i_upd, l1d=l1d_upd, l2=l2_upd, l2_cloc=l2_cloc,
-        mail=mail, req=req_state, counters=counters,
+        mail=mail, req=req_state, counters=counters, noc=noc,
     )
 
     # functional effect of slots completed via L1/L2 (loads/stores)
     ms = _apply_functional(mp, ms, rec, slot, s_addr, s_write,
                            slot_done_now & ~s_is_icache)
 
+    # The phase ORDER is chosen so a miss resolves in ONE engine iteration
+    # when no queued transaction is ahead of it: the request written by
+    # phase (1) above is popped by (3), whose INV/FLUSH/WB fan-out is
+    # served by (4), whose acks finish the transaction in (5), whose reply
+    # fills the requester in (6) — all mailbox hand-offs are visible
+    # same-iteration because each phase reads the matrices its predecessor
+    # just wrote.  Simulated time rides IN the messages, so this ordering
+    # only compresses wall-clock iterations (the old order needed 2 per
+    # fan-out miss); the timing algebra is unchanged.
+
     # ======================================================================
-    # (2) sharers consume one FWD per iteration
+    # (2) homes consume one EVICT per iteration
+    # ======================================================================
+    ms, progress = _home_evictions(mp, ms, dir_access_ps, enabled, progress)
+
+    # ======================================================================
+    # (3) homes start transactions (pop request / resume saved)
+    # ======================================================================
+    ms, progress = _home_starts(mp, ms, dram_lat_ps, dir_access_ps,
+                                sync_dir_l2, sync_dir_net, enabled, progress)
+
+    # ======================================================================
+    # (4) sharers consume one FWD per iteration
     # ======================================================================
     ms, progress = _sharer_step(mp, ms, fmhz, enabled, progress,
                                 sync_l2_net, sync_l1d_l2)
 
     # ======================================================================
-    # (3) homes consume one EVICT per iteration
-    # ======================================================================
-    ms, progress = _home_evictions(mp, ms, dir_access_ps, enabled, progress)
-
-    # ======================================================================
-    # (4) homes consume ACKs, finish transactions
+    # (5) homes consume ACKs, finish transactions
     # ======================================================================
     ms, progress = _home_acks_and_finish(mp, ms, dram_lat_ps, dir_access_ps,
                                          enabled, progress)
-
-    # ======================================================================
-    # (5) homes start transactions (pop request / resume saved)
-    # ======================================================================
-    ms, progress = _home_starts(mp, ms, dram_lat_ps, dir_access_ps,
-                                sync_dir_l2, sync_dir_net, enabled, progress)
 
     # ======================================================================
     # (6) requesters consume replies (fill L2+L1, complete slot)
@@ -718,8 +815,9 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     l2_r = ca.row_invalidate(l2_r, fline, inv_l1)
     l2_r = ca.row_set_state(l2_r, l2_way, wb_state, wb_l1)
     l2 = ca.scatter_row(ms.l2, l2_r)
-    l2_cloc = ms.l2_cloc.at[tiles, sets, l2_way].set(
-        jnp.where(inv_l1, 0, ms.l2_cloc[tiles, sets, l2_way]))
+    cur_cloc = ms.l2_cloc[tiles, sets, l2_way]
+    l2_cloc = ms.l2_cloc.at[tiles, sets, l2_way].add(
+        jnp.where(inv_l1, -cur_cloc, jnp.zeros_like(cur_cloc)))
 
     # ack message back to the home
     ack = jnp.where(
@@ -727,10 +825,10 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
         jnp.where(ftype == MSG_FLUSH_REQ, MSG_FLUSH_REP, MSG_WB_REP),
     ).astype(jnp.uint8)
     # serialization differs per type (INV acks are header-only, FLUSH/WB
-    # carry the line); compute both and select
-    lat_req = mem_net_latency_ps(mp, tiles, h, mp.req_bits, enabled)
-    lat_rep = mem_net_latency_ps(mp, tiles, h, mp.rep_bits, enabled)
-    ack_lat = jnp.where(is_inv, lat_req, lat_rep)
+    # carry the line)
+    ack_bits = jnp.where(is_inv, mp.req_bits, mp.rep_bits)
+    noc, ack_arrival = mem_net_send(
+        mp, ms.noc, tiles, h, ack_bits, done_ps, serve, enabled)
     wh = jnp.where(serve, h, 0)
     mail = mail.replace(
         ack_type=mail.ack_type.at[wh, tiles].set(
@@ -738,7 +836,7 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
         ack_line=mail.ack_line.at[wh, tiles].set(
             jnp.where(serve, fline, mail.ack_line[wh, tiles])),
         ack_time=mail.ack_time.at[wh, tiles].set(
-            jnp.where(serve, done_ps + ack_lat, mail.ack_time[wh, tiles])),
+            jnp.where(serve, ack_arrival, mail.ack_time[wh, tiles])),
     )
     # consume the fwd cell
     ch = jnp.where(found, h, 0)
@@ -752,7 +850,7 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     )
     progress = progress + jnp.sum(found, dtype=jnp.int32)
     return ms.replace(l1i=l1i, l1d=l1d, l2=l2, l2_cloc=l2_cloc, mail=mail,
-                      counters=counters), progress
+                      counters=counters, noc=noc), progress
 
 
 # --------------------------------------------------------------------------
@@ -876,14 +974,15 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     rbit_words = set_bit(rbit_words, r, finish)
 
     # EX finish: M, owner=r, sharers={r} (`processExReqFromL2Cache` UNCACHED
-    # branch after invalidations)
+    # branch after invalidations).  SH finish: add r as sharer.  MSI: entry
+    # becomes SHARED ownerless (`processWbRepFromL2Cache`).  MOSI: a dirty
+    # source keeps the line — M/O entries become/stay OWNED with the owner
+    # retained (mosi `processWbRepFromL2Cache` M→OWNED, `restartShmemReq`).
+    # The two cases are disjoint masks on the SAME entry, merged into ONE
+    # _dir_update: every scatter on the directory arrays that XLA fails to
+    # alias costs a whole-array copy per iteration (the [T, DS, DW, SW]
+    # sharers tensor is 2 GB at 1024 tiles — see PERF.md).
     exf = finish & is_ex & dfound
-    d = _dir_update(d, sets, way, exf, dstate=DIR_MODIFIED, owner=r,
-                    sharers=rbit_words, nsharers=jnp.ones(T, jnp.int32))
-    # SH finish: add r as sharer.  MSI: entry becomes SHARED ownerless
-    # (`processWbRepFromL2Cache`).  MOSI: a dirty source keeps the line —
-    # M/O entries become/stay OWNED with the owner retained
-    # (mosi `processWbRepFromL2Cache` M→OWNED, `restartShmemReq`)
     _, cur_dstate, cur_owner, cur_sharers, cur_nsh = _dir_gather(d, sets, way)
     shf = finish & is_sh & dfound
     had = test_bit(cur_sharers, r)
@@ -895,11 +994,14 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     else:
         sh_dstate = jnp.full(T, DIR_SHARED, jnp.uint8)
         sh_owner = jnp.full(T, -1, jnp.int32)
+    fin_upd = exf | shf
     d = _dir_update(
-        d, sets, way, shf, dstate=sh_dstate,
-        owner=sh_owner,
-        sharers=set_bit(cur_sharers, r, shf),
-        nsharers=cur_nsh + (~had).astype(jnp.int32))
+        d, sets, way, fin_upd,
+        dstate=jnp.where(exf, DIR_MODIFIED, sh_dstate).astype(jnp.uint8),
+        owner=jnp.where(exf, r, sh_owner),
+        sharers=jnp.where(exf[:, None], rbit_words,
+                          set_bit(cur_sharers, r, shf)),
+        nsharers=jnp.where(exf, 1, cur_nsh + (~had).astype(jnp.int32)))
     # NULLIFY finish: the entry was already replaced at allocation; nothing
     # directory-side remains (`processNullifyReq` UNCACHED branch)
 
@@ -910,9 +1012,10 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     data_avail = txn.data_cached | cdata_hit
     need_dram = finish & ~data_avail & ~is_nullify
     rep_ready_ps = txn.time_ps + jnp.where(need_dram, dram_lat_ps, 0)
-    rep_lat = mem_net_latency_ps(mp, tiles, r, mp.rep_bits, enabled)
     rep_msg = jnp.where(is_ex, MSG_EX_REP, MSG_SH_REP).astype(jnp.uint8)
     rep_go = finish & ~is_nullify
+    noc, rep_arrival = mem_net_send(
+        mp, ms.noc, tiles, r, mp.rep_bits, rep_ready_ps, rep_go, enabled)
     # add-delta scatter: target cells are zero (the requester resets both
     # fields on consumption), so masked-off dummy writes to cell 0 add 0
     # and can never clobber a live reply
@@ -921,7 +1024,7 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         rep_type=mail.rep_type.at[wr].add(
             jnp.where(rep_go, rep_msg, 0).astype(jnp.uint8)),
         rep_time=mail.rep_time.at[wr].add(
-            jnp.where(rep_go, rep_ready_ps + rep_lat, 0)),
+            jnp.where(rep_go, rep_arrival, 0)),
     )
     # clear our FWD column so stale multicasts cannot leak into the next
     # transaction (see module docstring)
@@ -948,7 +1051,7 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     progress = progress + jnp.sum(finish, dtype=jnp.int32) + jnp.sum(
         any_match, dtype=jnp.int32)
     return ms.replace(directory=d, txn=txn, mail=mail,
-                      counters=counters), progress
+                      counters=counters, noc=noc), progress
 
 
 # --------------------------------------------------------------------------
@@ -1012,15 +1115,11 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     # victim entry contents (for the NULLIFY transaction)
     v_line, v_dstate, v_owner, v_sharers, v_nsh = _dir_gather(d, sets, alloc_way)
 
-    # install the new entry (always, even when a NULLIFY must run first —
-    # `replaceDirectoryEntry` swaps immediately)
+    # the new entry's install (the reference's `replaceDirectoryEntry`
+    # immediate swap) is merged into the immediate-finish update below —
+    # one scatter on the directory arrays instead of two (each unaliased
+    # scatter costs a whole-array copy; see _dir_update)
     is_new = starting & ~dfound
-    d = _dir_update(
-        d, sets, alloc_way, is_new,
-        tags=rline, dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
-        owner=jnp.full(T, -1, jnp.int32),
-        sharers=jnp.zeros((T, mp.sharer_words), U32),
-        nsharers=jnp.zeros(T, jnp.int32))
 
     # ---- NULLIFY path ----------------------------------------------------
     # save the original request; run the nullify on the victim line
@@ -1098,19 +1197,32 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     cur_sh = jnp.where(imm_sh[:, None] & shared[:, None], v_sharers,
                        jnp.zeros_like(v_sharers))
     had = test_bit(cur_sh, rreq)
+    # ONE merged scatter: new-entry install (UNCACHED empty, including the
+    # entry swapped in under a pending NULLIFY) + immediate finishes; the
+    # two overlap on is_new & imm lanes where the finish value wins.  For
+    # imm-on-found lanes tags rewrite their current value (v_line == rline
+    # when dfound).
+    upd = is_new | imm
     d = _dir_update(
-        d, sets, alloc_way, imm,
-        dstate=jnp.where(imm_ex, DIR_MODIFIED, DIR_SHARED).astype(jnp.uint8),
+        d, sets, alloc_way, upd,
+        tags=jnp.where(is_new, rline, v_line),
+        dstate=jnp.where(
+            imm, jnp.where(imm_ex, DIR_MODIFIED, DIR_SHARED),
+            DIR_UNCACHED).astype(jnp.uint8),
         owner=jnp.where(imm_ex, rreq, -1),
-        sharers=cur_sh | rbit,
-        nsharers=jnp.where(imm_ex, 1,
-                           popcount(cur_sh) + (~had).astype(jnp.int32)))
+        sharers=jnp.where(imm[:, None], cur_sh | rbit,
+                          jnp.zeros((T, mp.sharer_words), U32)),
+        nsharers=jnp.where(
+            imm_ex, 1,
+            jnp.where(imm, popcount(cur_sh) + (~had).astype(jnp.int32), 0)))
     # UNCACHED/SHARED reads hit DRAM unless the home's flushed-data buffer
     # holds the line (`retrieveDataAndSendToL2Cache` cached-data lookup)
     cdata_imm = txn.cdata_valid & (txn.cdata_line == eff_line) & imm
     rep_ready = eff_time + jnp.where(cdata_imm, 0, dram_lat_ps)
     txn = txn.replace(cdata_valid=txn.cdata_valid & ~cdata_imm)
-    rep_lat = mem_net_latency_ps(mp, tiles, rreq, mp.rep_bits, enabled)
+    noc = ms.noc
+    noc, imm_arrival = mem_net_send(
+        mp, noc, tiles, rreq, mp.rep_bits, rep_ready, imm, enabled)
     # add-delta scatter (cells zero before a live write; see finish path)
     wr = jnp.where(imm, rreq, 0)
     mail = mail.replace(
@@ -1118,7 +1230,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
             jnp.where(imm, jnp.where(imm_ex, MSG_EX_REP, MSG_SH_REP), 0
                       ).astype(jnp.uint8)),
         rep_time=mail.rep_time.at[wr].add(
-            jnp.where(imm, rep_ready + rep_lat, 0)),
+            jnp.where(imm, imm_arrival, 0)),
     )
     txn = txn.replace(
         last_line=jnp.where(imm, eff_line, txn.last_line),
@@ -1242,10 +1354,8 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         over_bc = fan_inv & (v_nsh > k)
         send = send | over_bc[:, None]
         send_t = send.T
-    fwd_lat = mem_net_latency_ps(
-        mp, tiles[:, None], tiles[None, :], mp.req_bits, enabled
-    )  # [src=home? careful] — computed as [row, col] = (home, sharer)
-    arrive = eff_time[:, None] + fwd_lat          # [home, sharer]
+    noc, arrive = mem_net_fanout(
+        mp, noc, send, mp.req_bits, eff_time, enabled)  # [home, sharer]
     mail = mail.replace(
         fwd_type=jnp.where(send_t, msg_hs.T, mail.fwd_type),
         fwd_line=jnp.where(send_t, eff_line[None, :], mail.fwd_line),
@@ -1266,7 +1376,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
             + (over_bc & enabled).astype(I64))
     progress = progress + jnp.sum(starting, dtype=jnp.int32)
     return ms.replace(directory=d, txn=txn, mail=mail,
-                      counters=counters), progress
+                      counters=counters, noc=noc), progress
 
 
 # --------------------------------------------------------------------------
@@ -1304,10 +1414,12 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
     l2 = ca.scatter_row(ms.l2, ca.row_insert(l2_r, line, way, new_state,
                                              fill))
     sets = (line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-    l2_cloc = ms.l2_cloc.at[tiles, sets, way].set(
+    cur_cloc = ms.l2_cloc[tiles, sets, way]
+    l2_cloc = ms.l2_cloc.at[tiles, sets, way].add(
         jnp.where(fill,
-                  jnp.where(comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8),
-                  ms.l2_cloc[tiles, sets, way]))
+                  jnp.where(comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8)
+                  - cur_cloc,
+                  jnp.zeros_like(cur_cloc)))
 
     # eviction message (FLUSH_REP if dirty — MODIFIED, or OWNED in MOSI —
     # else INV_REP; `insertCacheLine`, `l2_cache_cntlr.cc:75-116`, mosi
@@ -1315,10 +1427,7 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
     v_dirty = (v_state == MODIFIED) | (v_state == OWNED)
     e_msg = jnp.where(v_dirty, MSG_FLUSH_REP,
                       MSG_INV_REP).astype(jnp.uint8)
-    e_bits_lat = jnp.where(
-        v_dirty,
-        mem_net_latency_ps(mp, tiles, v_home_all, mp.rep_bits, enabled),
-        mem_net_latency_ps(mp, tiles, v_home_all, mp.req_bits, enabled))
+    e_bits = jnp.where(v_dirty, mp.rep_bits, mp.req_bits)
     # fill timing: reply arrival + net sync + L2 insert (data+tags), then
     # second L1 pass: L2 sync + L1 data+tags (`processMemOpFromCore` loop)
     fill_l2_ps = mail.rep_time + sync_l2_net + ccyc(mp.l2.data_and_tags_cycles)
@@ -1326,6 +1435,9 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
                        ccyc(mp.l1d.data_and_tags_cycles))
     done_ps = fill_l2_ps + l1_dat
 
+    noc, e_arrival = mem_net_send(
+        mp, ms.noc, tiles, v_home_all, e_bits, fill_l2_ps, evict_go,
+        enabled)
     wh = jnp.where(evict_go, v_home_all, 0)
     mail = mail.replace(
         evict_type=mail.evict_type.at[wh, tiles].set(
@@ -1333,7 +1445,7 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
         evict_line=mail.evict_line.at[wh, tiles].set(
             jnp.where(evict_go, v_line, mail.evict_line[wh, tiles])),
         evict_time=mail.evict_time.at[wh, tiles].set(
-            jnp.where(evict_go, fill_l2_ps + e_bits_lat,
+            jnp.where(evict_go, e_arrival,
                       mail.evict_time[wh, tiles])),
         # reset BOTH fields so home-side add-delta reply writes stay exact
         rep_type=jnp.where(fill, MSG_NONE, mail.rep_type),
@@ -1359,8 +1471,9 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
     l1_ev_line = jnp.where(comp_l1i, l1i_vline, l1d_vline)
     ev_hit, ev_way, _ = ca.lookup(l2, l1_ev_line, mp.l2.sets_mod)
     ev_sets = (l1_ev_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-    l2_cloc = l2_cloc.at[tiles, ev_sets, ev_way].set(
-        jnp.where(l1_ev & ev_hit, 0, l2_cloc[tiles, ev_sets, ev_way]))
+    cur_cloc2 = l2_cloc[tiles, ev_sets, ev_way]
+    l2_cloc = l2_cloc.at[tiles, ev_sets, ev_way].add(
+        jnp.where(l1_ev & ev_hit, -cur_cloc2, jnp.zeros_like(cur_cloc2)))
 
     req = ms.req.replace(
         phase=jnp.where(fill, PHASE_IDLE, ms.req.phase),
@@ -1372,7 +1485,7 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
             (done_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
     )
     ms = ms.replace(l1i=l1i, l1d=l1d, l2=l2, l2_cloc=l2_cloc, mail=mail,
-                    req=req)
+                    req=req, noc=noc)
     # functional effect of the completed slot
     s_addr = jnp.where(ms.req.slot - 1 == 1, rec.addr0.astype(jnp.int32),
                        rec.addr1.astype(jnp.int32))

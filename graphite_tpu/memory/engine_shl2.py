@@ -34,7 +34,8 @@ from graphite_tpu.memory.cache_array import (
 )
 from graphite_tpu.memory.engine import (
     MemStepOut, RecView, _row_earliest, clear_bit, lowest_sharer,
-    mem_net_latency_ps, set_bit, test_bit, unpack_sharers,
+    mem_net_fanout, mem_net_latency_ps, mem_net_send, set_bit,
+    test_bit, unpack_sharers,
 )
 from graphite_tpu.memory.params import MemParams
 from graphite_tpu.memory.state import (
@@ -111,6 +112,9 @@ class ShL2State:
     # bool[] — any protocol state outstanding; False lets the step skip
     # the engine entirely (see engine.mem_idle_out)
     live: jax.Array
+    # MEMORY-NoC port-queue state when memory = emesh_hop_by_hop (see
+    # engine.mem_net_send); None otherwise
+    noc: "object" = None
 
 
 def init_shl2_state(mp: MemParams) -> ShL2State:
@@ -286,8 +290,9 @@ def shl2_engine_step(
     s_home = _l2_home(mp, s_line)
     rq_type = jnp.where(s_write, MSG_EX_REQ, MSG_SH_REQ).astype(jnp.uint8)
     req_send_ps = sclock + l1_tag + sync_l1_net
-    rq_arrival = req_send_ps + mem_net_latency_ps(
-        mp, tiles, s_home, mp.req_bits, enabled)
+    noc, rq_arrival = mem_net_send(
+        mp, ms.noc, tiles, s_home, mp.req_bits, req_send_ps, l1_miss,
+        enabled)
     mail = ms.mail
     rq_home = jnp.where(l1_miss, s_home, 0)
     mail = mail.replace(
@@ -335,7 +340,7 @@ def shl2_engine_step(
     )
     progress = progress + jnp.sum(slot_done_now | l1_miss, dtype=jnp.int32)
     ms = ms.replace(l1i=l1i_upd, l1d=l1d_upd, mail=mail, req=req_state,
-                    counters=counters)
+                    counters=counters, noc=noc)
     ms = _apply_functional(mp, ms, rec, slot, s_addr, s_write, slot_done_now)
 
     # ======================================================================
@@ -439,10 +444,9 @@ def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net):
                   MSG_FLUSH_REP)).astype(jnp.uint8)
     # a FLUSH of a clean (S/E) line carries no data: INV_REP
     ack = jnp.where((ftype == MSG_FLUSH_REQ) & ~was_dirty, MSG_INV_REP, ack)
-    ack_lat = jnp.where(
-        (ack == MSG_INV_REP),
-        mem_net_latency_ps(mp, tiles, h, mp.req_bits, enabled),
-        mem_net_latency_ps(mp, tiles, h, mp.rep_bits, enabled))
+    ack_bits = jnp.where(ack == MSG_INV_REP, mp.req_bits, mp.rep_bits)
+    noc, ack_arrival = mem_net_send(
+        mp, ms.noc, tiles, h, ack_bits, done_ps, serve, enabled)
     wh = jnp.where(serve, h, 0)
     mail = mail.replace(
         ack_type=mail.ack_type.at[wh, tiles].set(
@@ -450,7 +454,7 @@ def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net):
         ack_line=mail.ack_line.at[wh, tiles].set(
             jnp.where(serve, fline, mail.ack_line[wh, tiles])),
         ack_time=mail.ack_time.at[wh, tiles].set(
-            jnp.where(serve, done_ps + ack_lat, mail.ack_time[wh, tiles])),
+            jnp.where(serve, ack_arrival, mail.ack_time[wh, tiles])),
     )
     ch = jnp.where(found, h, 0)
     mail = mail.replace(
@@ -461,8 +465,8 @@ def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net):
         invalidations=ms.counters.invalidations
         + (serve & is_inv & enabled).astype(I64))
     progress = progress + jnp.sum(found, dtype=jnp.int32)
-    return ms.replace(l1i=l1i, l1d=l1d, mail=mail, counters=counters), \
-        progress
+    return ms.replace(l1i=l1i, l1d=l1d, mail=mail, counters=counters,
+                      noc=noc), progress
 
 
 def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress):
@@ -611,17 +615,18 @@ def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
 
     # reply to the requester (the slice access was charged at txn start)
     rep_ready = txn.time_ps + sync_l2_net
-    rep_lat = mem_net_latency_ps(mp, tiles, r, mp.rep_bits, enabled)
     rep_msg = jnp.where(
         finish & is_ex, MSG_EX_REP,
         jnp.where(excl, MSG_EXCL_REP, MSG_SH_REP)).astype(jnp.uint8)
     rep_go = finish & ~is_nullify
+    noc, rep_arrival = mem_net_send(
+        mp, ms.noc, tiles, r, mp.rep_bits, rep_ready, rep_go, enabled)
     wr = jnp.where(rep_go, r, 0)
     mail = mail.replace(
         rep_type=mail.rep_type.at[wr].add(
             jnp.where(rep_go, rep_msg, 0).astype(jnp.uint8)),
         rep_time=mail.rep_time.at[wr].add(
-            jnp.where(rep_go, rep_ready + rep_lat, 0)),
+            jnp.where(rep_go, rep_arrival, 0)),
     )
     mail = mail.replace(
         fwd_type=jnp.where(finish[None, :], MSG_NONE, mail.fwd_type))
@@ -637,7 +642,7 @@ def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     progress = progress + jnp.sum(finish, dtype=jnp.int32) + jnp.sum(
         any_match | dram_in, dtype=jnp.int32)
     return ms.replace(l2=l2, dir=d, mail=mail, txn=txn,
-                      counters=counters), progress
+                      counters=counters, noc=noc), progress
 
 
 def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
@@ -814,9 +819,8 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
         send = send | (over_bc[:, None]
                        & (tiles[None, :] != jnp.clip(rreq, 0, T - 1)[:, None]))
     send_t = send.T
-    fwd_lat = mem_net_latency_ps(
-        mp, tiles[:, None], tiles[None, :], mp.req_bits, enabled)
-    arrive = eff_time[:, None] + fwd_lat
+    noc, arrive = mem_net_fanout(
+        mp, ms.noc, send, mp.req_bits, eff_time, enabled)
     mail = mail.replace(
         fwd_type=jnp.where(send_t, fwd_msg[None, :], mail.fwd_type),
         fwd_line=jnp.where(send_t, eff_line[None, :], mail.fwd_line),
@@ -840,7 +844,7 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     )
     progress = progress + jnp.sum(starting, dtype=jnp.int32)
     return ms.replace(l2=l2, dir=d, mail=mail, txn=txn,
-                      counters=counters), progress
+                      counters=counters, noc=noc), progress
 
 
 def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
@@ -889,10 +893,9 @@ def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
                       MSG_INV_REP).astype(jnp.uint8)
     fill_ps = mail.rep_time + sync_l1_net + ccyc(
         mp.l1d.data_and_tags_cycles)
-    e_lat = jnp.where(
-        v_state == MODIFIED,
-        mem_net_latency_ps(mp, tiles, v_home, mp.rep_bits, enabled),
-        mem_net_latency_ps(mp, tiles, v_home, mp.req_bits, enabled))
+    e_bits = jnp.where(v_state == MODIFIED, mp.rep_bits, mp.req_bits)
+    noc, e_arrival = mem_net_send(
+        mp, ms.noc, tiles, v_home, e_bits, fill_ps, evict_go, enabled)
     wh = jnp.where(evict_go, v_home, 0)
     mail = mail.replace(
         evict_type=mail.evict_type.at[wh, tiles].set(
@@ -900,7 +903,7 @@ def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
         evict_line=mail.evict_line.at[wh, tiles].set(
             jnp.where(evict_go, v_line, mail.evict_line[wh, tiles])),
         evict_time=mail.evict_time.at[wh, tiles].set(
-            jnp.where(evict_go, fill_ps + e_lat,
+            jnp.where(evict_go, e_arrival,
                       mail.evict_time[wh, tiles])),
         rep_type=jnp.where(fill, MSG_NONE, mail.rep_type),
         rep_time=jnp.where(fill, 0, mail.rep_time),
@@ -914,7 +917,7 @@ def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
              & (jnp.arange(3)[None, :] == ms.req.slot[:, None])),
             (fill_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
     )
-    ms = ms.replace(l1i=l1i, l1d=l1d, mail=mail, req=req)
+    ms = ms.replace(l1i=l1i, l1d=l1d, mail=mail, req=req, noc=noc)
     s_addr = jnp.where(ms.req.slot - 1 == 1, rec.addr0.astype(jnp.int32),
                        rec.addr1.astype(jnp.int32))
     ms = _apply_functional(mp, ms, rec, ms.req.slot - 1, s_addr,

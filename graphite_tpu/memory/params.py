@@ -216,6 +216,12 @@ class MemParams:
     # engine knobs
     icache_modeling: bool
     func_mem_words: int       # functional memory size (0 = disabled)
+    # full per-hop MEMORY NoC with per-port contention
+    # (`[network] memory = emesh_hop_by_hop`, `carbon_sim.cfg:281-282`):
+    # every coherence message — request, eviction, INV/FLUSH/WB forward,
+    # ack, reply — routes through the dense hop-by-hop engine instead of
+    # the zero-load hop-counter math (HopByHopParams | None)
+    net_hbh: "object" = None
 
     @property
     def req_bits(self) -> int:
@@ -331,10 +337,24 @@ class MemParams:
             else "disabled"
         )
 
-        # --- memory network zero-load params -------------------------------
+        # --- memory network params -----------------------------------------
         from graphite_tpu.models.network_user import UserNetworkParams
 
+        mem_kind = sc.network_types[1]
+        if mem_kind == "atac":
+            # the reference supports atac as a memory network; the TPU
+            # engine does not model its timing for coherence messages yet
+            # — refuse loudly instead of flowing a degenerate mesh into
+            # the latency math
+            raise NotImplementedError(
+                "[network] memory = atac is not supported; use magic, "
+                "emesh_hop_counter, or emesh_hop_by_hop")
         netp = UserNetworkParams.from_config(sc, "memory")
+        net_hbh = None
+        if mem_kind == "emesh_hop_by_hop":
+            from graphite_tpu.models.network_hop_by_hop import HopByHopParams
+
+            net_hbh = HopByHopParams.from_config(sc, "memory")
 
         # --- DVFS domains for synchronization delay ------------------------
         from graphite_tpu.models.dvfs import module_domain_index, module_freq_mhz
@@ -372,6 +392,7 @@ class MemParams:
             mesh_width=netp.mesh_width,
             hop_latency_cycles=netp.hop_latency_cycles,
             flit_width_bits=netp.flit_width_bits,
+            net_hbh=net_hbh,
             module_domains=module_domains,
             sync_delay_cycles=cfg.get_int("dvfs/synchronization_delay", 2),
             icache_modeling=cfg.get_bool("general/enable_icache_modeling", False),

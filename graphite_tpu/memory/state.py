@@ -177,6 +177,9 @@ class MemState:
     # bool[] — any protocol state outstanding (messages, transactions,
     # waiting requesters); False lets the step skip the engine entirely
     live: jax.Array
+    # per-port queue state of the MEMORY NoC when `[network] memory =
+    # emesh_hop_by_hop` (models/network_hop_by_hop.NocState), else None
+    noc: "object" = None
 
 
 def init_mem_common(mp: MemParams) -> dict:

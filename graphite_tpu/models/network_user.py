@@ -50,8 +50,13 @@ class UserNetworkParams:
             # this placeholder only carries the domain frequency
             return cls(kind="atac", freq_mhz=freq_mhz)
         if kind in ("emesh_hop_counter", "emesh_hop_by_hop"):
-            # hop_by_hop zero-load reduces to hop_counter math; contention is
-            # layered on separately (models/network_emesh_hop_by_hop).
+            # These params carry only the ZERO-LOAD basis (hop-counter
+            # math).  When the configured model is emesh_hop_by_hop, the
+            # per-hop contention engine is built separately and carries
+            # the authoritative timing: HopByHopParams in
+            # EngineParams.user_hbh for the USER net, MemParams.net_hbh
+            # for the MEMORY net (every coherence message then routes
+            # through it — memory/engine.py mem_net_send).
             section = f"network/{kind}"
             router = cfg.cfg.get_int(f"{section}/router/delay", 1)
             link = cfg.cfg.get_int(f"{section}/link/delay", 1)

@@ -1,0 +1,221 @@
+"""The MEMORY network under emesh_hop_by_hop: coherence traffic sees
+per-port contention.
+
+Reference: every ShmemMsg routes through the configured memory network
+model (`carbon_sim.cfg:281-282` memory_model_1; per-hop queues
+`network_model_emesh_hop_by_hop.cc:146-265`); `tests/benchmarks/
+synthetic_memory` is the reference's stress generator for exactly this.
+
+Contract (BASELINE.md carve-outs):
+ - serialized coherence traffic is BIT-EXACT vs the golden oracle's
+   independent serial per-hop net (unicast flows fully independent;
+   fan-out multicasts share the engine's documented inject+rank
+   approximation);
+ - hop_by_hop must CHANGE measured completion vs hop_counter (the
+   round-2 gap was that `memory = emesh_hop_by_hop` silently degraded
+   to zero-load);
+ - memory = atac raises instead of flowing garbage mesh math.
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine.simulator import Simulator
+from graphite_tpu.golden import run_golden
+from graphite_tpu.trace import synthetic
+from graphite_tpu.trace.schema import TraceBatch, TraceBuilder
+
+MSI = "pr_l1_pr_l2_dram_directory_msi"
+MOSI = "pr_l1_pr_l2_dram_directory_mosi"
+
+
+def make_config(n_tiles, proto=MSI, net="emesh_hop_by_hop", extra=""):
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = true
+[network]
+user = magic
+memory = {net}
+[network/emesh_hop_counter]
+flit_width = 64
+[network/emesh_hop_counter/router]
+delay = 1
+[network/emesh_hop_counter/link]
+delay = 1
+[network/emesh_hop_by_hop]
+flit_width = 64
+[network/emesh_hop_by_hop/router]
+delay = 1
+[network/emesh_hop_by_hop/link]
+delay = 1
+[caching_protocol]
+type = {proto}
+[core/static_instruction_costs]
+mov = 1
+ialu = 1
+{extra}
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+def assert_exact(sc, batch):
+    res = Simulator(sc, batch).run()
+    gold = run_golden(sc, batch)
+    np.testing.assert_array_equal(res.clock_ps, gold.clock_ps,
+                                  err_msg="clock")
+    for k, g in gold.mem_counters.items():
+        np.testing.assert_array_equal(np.asarray(res.mem_counters[k]), g,
+                                      err_msg=k)
+    return res, gold
+
+
+def mutex_rmw(n, rounds, base=0x900000, lines=2):
+    """Mutex-serialized shared-line read-modify-writes: at any moment one
+    tile touches the shared data, so engine iteration order and oracle
+    clock order coincide — the bit-exactness regime."""
+    bs = [TraceBuilder() for _ in range(n)]
+    bs[0].mutex_init(0)
+    bs[0].barrier_init(9, n)
+    for b in bs:
+        b.barrier_wait(9)
+    for r in range(n * rounds):
+        b = bs[r % n]
+        b.mutex_lock(0)
+        for ln in range(lines):
+            addr = base + 64 * ln
+            b.load(addr, 8)
+            b.store(addr, 8)
+        b.mutex_unlock(0)
+    return TraceBatch.from_builders(bs)
+
+
+def disjoint_stream(n, accesses=60):
+    """Line-disjoint per-tile streams (capacity misses, no sharing)."""
+    bs = [TraceBuilder() for _ in range(n)]
+    for t, b in enumerate(bs):
+        for i in range(accesses):
+            addr = 0x100000 + (t * accesses + i) * 64
+            (b.store if i % 3 == 0 else b.load)(addr, 8)
+    return TraceBatch.from_builders(bs)
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_serialized_bit_exact_vs_oracle(proto):
+    sc = make_config(4, proto)
+    assert_exact(sc, mutex_rmw(4, rounds=6))
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_disjoint_concurrent_envelope(proto):
+    """Line-disjoint CONCURRENT streams are exact under zero-load nets
+    (test_memory_golden), but under hop_by_hop they contend for router
+    ports, so the same-call batching contract applies (packets of one
+    subquantum iteration see each other's occupancy only next iteration
+    — `scatter_queue_delay` contract): measured 4.8%, pinned at 7%
+    (BASELINE.md carve-outs; the USER net's adversarial case pins 15%).
+    Counters stay exact — contention shifts time, never traffic."""
+    sc = make_config(4, proto)
+    batch = disjoint_stream(4)
+    res = Simulator(sc, batch).run()
+    gold = run_golden(sc, batch)
+    rel = np.abs(res.clock_ps.astype(float) - gold.clock_ps.astype(float))
+    rel = rel / np.maximum(gold.clock_ps.astype(float), 1.0)
+    assert rel.max() <= 0.07, (
+        f"divergence {rel.max():.4f}: engine={res.clock_ps.tolist()} "
+        f"golden={gold.clock_ps.tolist()}")
+    for k, g in gold.mem_counters.items():
+        np.testing.assert_array_equal(np.asarray(res.mem_counters[k]), g,
+                                      err_msg=k)
+    assert int(gold.mem_counters["l2_misses"].sum()) > 0
+
+
+def test_hbh_memory_changes_completion():
+    """The contention-modeled memory net must produce different (higher)
+    completion times than zero-load hop-counter under load — the silent
+    hop_by_hop -> hop_counter degrade would make these equal."""
+    batch = synthetic.memory_stress_trace(
+        16, n_accesses=80, working_set_bytes=1 << 13,
+        write_fraction=0.4, shared_fraction=0.5, seed=3)
+    r_zero = Simulator(make_config(16, net="emesh_hop_counter"),
+                       batch).run()
+    r_hbh = Simulator(make_config(16, net="emesh_hop_by_hop"),
+                      batch).run()
+    assert r_hbh.completion_time_ps != r_zero.completion_time_ps
+    # contention only ever adds latency on top of an identical zero-load
+    # basis... but hop_by_hop's zero-load basis itself differs (router
+    # charge + per-hop router+link on the SELF hop), so just require a
+    # strictly larger completion under heavy shared traffic
+    assert r_hbh.completion_time_ps > r_zero.completion_time_ps
+
+
+def test_racy_envelope_vs_oracle():
+    """Free-running shared traffic under the contention-modeled memory
+    net compounds BOTH carve-outs (same-line race resolution ~3% +
+    same-call port batching ~7%; BASELINE.md): measured 5.2%, pinned at
+    their sum's ballpark, 8%."""
+    sc = make_config(4, MSI)
+    batch = synthetic.memory_stress_trace(
+        4, n_accesses=150, working_set_bytes=1 << 13,
+        write_fraction=0.4, shared_fraction=0.3, seed=5)
+    res = Simulator(sc, batch).run()
+    gold = run_golden(sc, batch)
+    rel = np.abs(res.clock_ps.astype(float) - gold.clock_ps.astype(float))
+    rel = rel / np.maximum(gold.clock_ps.astype(float), 1.0)
+    assert rel.max() <= 0.08, (
+        f"clock divergence {rel.max():.4f} exceeds envelope: "
+        f"engine={res.clock_ps.tolist()} golden={gold.clock_ps.tolist()}")
+    for k in ("l2_misses", "dram_reads"):
+        e = int(np.asarray(res.mem_counters[k]).sum())
+        g = int(gold.mem_counters[k].sum())
+        assert abs(e - g) <= max(2, 0.02 * max(e, g)), f"{k}: {e} vs {g}"
+
+
+def test_atac_memory_raises():
+    with pytest.raises(NotImplementedError, match="memory = atac"):
+        Simulator(make_config(4, net="atac"), disjoint_stream(4))
+
+
+def test_shl2_hbh_runs():
+    """The shared-L2 engines route through the same contention net; smoke
+    that the wiring compiles and produces traffic-dependent times."""
+    batch = synthetic.memory_stress_trace(
+        8, n_accesses=40, working_set_bytes=1 << 12,
+        write_fraction=0.4, shared_fraction=0.5, seed=2)
+    r_zero = Simulator(make_config(8, proto="pr_l1_sh_l2_msi",
+                                   net="emesh_hop_counter"), batch).run()
+    r_hbh = Simulator(make_config(8, proto="pr_l1_sh_l2_msi",
+                                  net="emesh_hop_by_hop"), batch).run()
+    assert r_hbh.completion_time_ps > r_zero.completion_time_ps
+
+
+def test_ackwise_broadcast_fanout_exact():
+    """Overflowed-entry INV sweep under the contention-modeled memory
+    net: the broadcast occupies the home's inject port with T copies and
+    each holder's copy ranks by tile id among ALL copies (engine's
+    `send | over_bc` row).  Serialized (mutex-ordered) accesses keep it
+    bit-exact vs the oracle, which mirrors the copy count and ranks
+    (n_copies/ranks in `_HbhNet.fanout`)."""
+    extra = "[dram_directory]\ndirectory_type = ackwise\nmax_hw_sharers = 2\n"
+    sc = make_config(4, MSI, extra=extra)
+    bs = [TraceBuilder() for _ in range(4)]
+    bs[0].mutex_init(0)
+    bs[0].barrier_init(9, 4)
+    for b in bs:
+        b.barrier_wait(9)
+    # 4 readers (> max_hw_sharers=2 overflows the entry), serialized
+    for t, b in enumerate(bs):
+        b.mutex_lock(0)
+        b.load(0x900000, 8)
+        b.mutex_unlock(0)
+    for b in bs:
+        b.barrier_wait(9)
+    # one writer: EX on the overflowed entry -> broadcast INV sweep
+    bs[0].mutex_lock(0)
+    bs[0].store(0x900000, 8)
+    bs[0].mutex_unlock(0)
+    res, gold = assert_exact(sc, TraceBatch.from_builders(bs))
+    assert int(gold.mem_counters["dir_broadcasts"].sum()) > 0
