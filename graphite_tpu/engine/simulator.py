@@ -140,6 +140,25 @@ def _mem_state_bytes(mp) -> int:
     return dir_bytes + cache_bytes + mail_bytes
 
 
+_STREAM_RUNNERS: dict = {}
+
+
+def _streamed_runner(params: EngineParams, quantum_ps, max_quanta: int):
+    """One jitted streamed-run wrapper per (params, quantum, max_quanta):
+    identical configs share a wrapper, so a warmup run on one Simulator
+    instance warms the executable every other instance uses."""
+    key = (params, quantum_ps, int(max_quanta))
+    fn = _STREAM_RUNNERS.get(key)
+    if fn is None:
+        from graphite_tpu.engine.step import run_simulation
+
+        fn = jax.jit(
+            lambda st, tr, base: run_simulation(
+                params, tr, st, quantum_ps, max_quanta, trace_base=base))
+        _STREAM_RUNNERS[key] = fn
+    return fn
+
+
 class Simulator:
     """Builds engine parameters from a SimConfig and runs a trace batch."""
 
@@ -184,6 +203,18 @@ class Simulator:
         has_mem = bool(
             np.any(trace.flags & (FLAG_MEM0_VALID | FLAG_MEM1_VALID))
         ) or cfg.get_bool("general/enable_icache_modeling", False)
+        # dynamic records (op 15-19) commit without waiting on memory
+        # completion, so memory flags on them would leave slot machinery
+        # dangling into the next record (and diverge from the golden
+        # oracle, which gives dynamic ops no memory slots) — reject the
+        # combination outright; no builder emits it
+        dyn_mem = np.any(
+            (trace.op >= 15) & (trace.op < 20)
+            & ((trace.flags & (FLAG_MEM0_VALID | FLAG_MEM1_VALID)) != 0))
+        if bool(dyn_mem):
+            raise ValueError(
+                "dynamic trace records (ops 15-19) must not carry "
+                "FLAG_MEM*_VALID memory operands")
         mem_params = None
         if config.enable_shared_mem and has_mem:
             from graphite_tpu.memory import MemParams
@@ -301,6 +332,17 @@ class Simulator:
             else:
                 self.state = self.state.replace(
                     mem=init_mem_state(mem_params))
+            if mem_params.net_hbh is not None:
+                # per-port queue state of the MEMORY NoC (`[network]
+                # memory = emesh_hop_by_hop`) — coherence messages route
+                # through it with per-hop contention (mem_net_send)
+                from graphite_tpu.models.network_hop_by_hop import (
+                    init_noc_state,
+                )
+
+                self.state = self.state.replace(
+                    mem=self.state.mem.replace(
+                        noc=init_noc_state(mem_params.net_hbh)))
         if user_hbh is not None:
             from graphite_tpu.models.network_hop_by_hop import init_noc_state
 
@@ -333,19 +375,22 @@ class Simulator:
         # streaming mode keeps the trace host-side; run_streamed() uploads
         # [T, W] windows on demand (bounded HBM regardless of trace size)
         self.stream = bool(stream)
+        self.mesh = mesh
         self.device_trace = None if stream else DeviceTrace.from_batch(trace)
-        if stream and mesh is not None:
-            raise NotImplementedError(
-                "streamed traces are single-chip for now (window uploads "
-                "are not mesh-sharded)")
         if mesh is not None:
             # Shard the tile axis over the device mesh (SURVEY §2.10): the
-            # TPU-native form of Graphite's process striping.
-            from graphite_tpu.parallel.mesh import shard_sim
+            # TPU-native form of Graphite's process striping.  Streamed
+            # runs shard the state here and each [T, W] window at upload
+            # (run_streamed) — the two scale mechanisms compose: bounded-
+            # HBM traces on a multi-chip mesh.
+            from graphite_tpu.parallel.mesh import shard_sim, shard_state
 
-            self.state, self.device_trace = shard_sim(
-                self.state, self.device_trace, mesh
-            )
+            if stream:
+                self.state = shard_state(self.state, mesh)
+            else:
+                self.state, self.device_trace = shard_sim(
+                    self.state, self.device_trace, mesh
+                )
         self._runner = None
         self._runner_max_quanta = None
 
@@ -450,31 +495,42 @@ class Simulator:
         with an async upload while the device crunches, overlapping
         transfer with compute.
         """
-        from graphite_tpu.engine.step import run_simulation
-
         W = int(window_records)
         batch = self.trace_batch
-        runner = jax.jit(
-            lambda st, tr, base: run_simulation(
-                self.params, tr, st, self.quantum_ps, max_quanta,
-                trace_base=base))
+        # module-level runner cache: a fresh jit(lambda) per call (or per
+        # Simulator — benchmark warmups use a throwaway instance) would
+        # register a new wrapper whose traces don't share the previous
+        # executables, silently putting re-compilation inside timed runs
+        runner = _streamed_runner(self.params, self.quantum_ps, max_quanta)
+
+        # mesh runs shard each [T, W] window + base vector on upload (row
+        # t of every window lives with tile t's shard) — streaming and
+        # multi-chip striping compose
+        if self.mesh is not None:
+            from graphite_tpu.parallel.mesh import shard_window
+
+            def place(win, b):
+                return shard_window(win, self.mesh, b)
+        else:
+            def place(win, b):
+                return win, jnp.asarray(b)
 
         bases = np.zeros(batch.n_tiles, np.int32)
         state = self.state
-        window = DeviceTrace.window(batch, bases, W)
+        window, dev_bases = place(DeviceTrace.window(batch, bases, W), bases)
         prefetch_bases = None
         prefetch = None
         prefetch_on = True  # lockstep so far; first miss turns it off
         n_quanta = 0
         for _ in range(max_windows):
-            out = runner(state, window, jnp.asarray(bases))
+            out = runner(state, window, dev_bases)
             # overlap: stage the lockstep-guess window during the run —
             # only while every slide so far matched the guess (a skewed
             # run would rebuild + re-upload a discarded window each slide)
             guess = bases + W
             if prefetch_on and (guess < batch.length).any():
                 prefetch_bases = guess
-                prefetch = DeviceTrace.window(batch, guess, W)
+                prefetch = place(DeviceTrace.window(batch, guess, W), guess)
             else:
                 prefetch_bases = None
             state, nq_dev, deadlock_dev = out
@@ -505,8 +561,9 @@ class Simulator:
                    and np.array_equal(prefetch_bases, bases))
             if not hit:
                 prefetch_on = False
-            window = (prefetch if hit
-                      else DeviceTrace.window(batch, bases, W))
+            window, dev_bases = (
+                prefetch if hit
+                else place(DeviceTrace.window(batch, bases, W), bases))
         else:
             raise RuntimeError(f"exceeded max_windows={max_windows}")
         self.state = state

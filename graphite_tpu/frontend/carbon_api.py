@@ -435,7 +435,15 @@ class CarbonBarrier:
         # split form (schema BARRIER_ARRIVE/BARRIER_SYNC): contribute the
         # arrival BEFORE blocking (a co-located peer's arrival would
         # otherwise sit unreachable behind this lane's blocked record),
-        # then rendezvous with the release generation that freed us
+        # then rendezvous with the release generation that freed us.
+        # Bounded-overcharge contract: the generation is read AFTER this
+        # thread resumes, so if another full release completes in the gap
+        # the recorded generation is one (or more) later and replay
+        # charges that later release's time — a small overcharge bounded
+        # by the live run's own scheduling skew, same class as the
+        # split-op contract documented at the schema.  (Capturing the
+        # generation inside the Barrier action hook cannot attribute it
+        # per-waiter: the hook runs once per release on one thread.)
         app = _app()
         app.builders[_tile()].barrier_arrive(self.id)
         _blocking_wait(app, app._barriers[self.id].wait)

@@ -79,6 +79,31 @@ def trace_shardings(trace: DeviceTrace, mesh: Mesh, n_tiles: int):
     )
 
 
+def shard_state(state: SimState, mesh: Mesh) -> SimState:
+    """Place the state alone on the mesh (streamed runs: the trace
+    arrives later as per-window uploads sharded by shard_window)."""
+    n_tiles = state.core.clock_ps.shape[0]
+    n_dev = mesh.devices.size
+    if n_tiles % n_dev != 0:
+        raise ValueError(
+            f"tile count {n_tiles} not divisible by mesh size {n_dev}"
+        )
+    return jax.device_put(state, state_shardings(state, mesh, n_tiles))
+
+
+def shard_window(window: DeviceTrace, mesh: Mesh, bases) -> tuple:
+    """Shard one streamed [T, W] trace window + its per-tile base vector
+    onto the mesh (row t of the window lives with tile t's shard)."""
+    n_tiles = window.op.shape[0]
+    window = jax.device_put(
+        window, trace_shardings(window, mesh, n_tiles))
+    import jax.numpy as jnp
+
+    bases = jax.device_put(
+        jnp.asarray(bases), NamedSharding(mesh, P(TILE_AXIS)))
+    return window, bases
+
+
 def shard_sim(
     state: SimState, trace: DeviceTrace, mesh: Mesh
 ) -> tuple[SimState, DeviceTrace]:
