@@ -1,0 +1,213 @@
+"""Capture a REAL program execution as a trace and calibrate the skeletons.
+
+This is not a synthetic generator: `run_fft_app` EXECUTES a parallel
+radix-2 decimation-in-time FFT — real butterflies over real data in
+16.16 fixed point, partitioned across Carbon threads with a barrier per
+stage — under the live-recording Carbon API (the reference analog is
+capturing a real binary under Pin, `pin/instruction_modeling.cc`).
+Every arithmetic operation is recorded as an instruction record and
+every element access goes through `carbon_load`/`carbon_store` with its
+true address, so the replay drives the full cache/coherence stack with
+the program's actual sharing pattern (adjacent elements share cache
+lines across tile-partition boundaries).
+
+The captured run is validated two ways:
+ - functionally on replay: stage reads are barrier-separated
+   single-writer, so they carry FLAG_CHECK — the coherence engine must
+   reproduce every loaded value (func_errors == 0);
+ - numerically at capture: the fixed-point result must match numpy.fft
+   within fixed-point tolerance.
+
+`measured_mix` then reports the real per-butterfly instruction mix, the
+calibration source for the `fft_trace` skeleton (see PERF.md
+"Trace-capture calibration").
+
+Usage:  python -m graphite_tpu.tools.capture_fft [out.npz]
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+FX = 16  # 16.16 fixed point
+
+
+def _fx(x: float) -> int:
+    return int(round(x * (1 << FX)))
+
+
+def _fxmul(a: int, b: int) -> int:
+    return (a * b) >> FX
+
+
+def _w32(v: int) -> int:
+    return ((v & 0xFFFFFFFF) ^ 0x80000000) - 0x80000000
+
+
+def run_fft_app(n_tiles: int = 4, n_points: int = 128, seed: int = 9):
+    """Execute the parallel FFT under the recording API.
+
+    Returns (TraceBatch, input_complex, output_complex) — the recorded
+    trace plus the program's actual numeric input/output for the
+    numerical check."""
+    from graphite_tpu.frontend import carbon_api as capi
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.tools._template import config_text
+
+    N = n_points
+    stages = int(math.log2(N))
+    assert 1 << stages == N, "n_points must be a power of 2"
+    BASE = 0x100000
+
+    def re_addr(i):
+        return BASE + 8 * i
+
+    def im_addr(i):
+        return BASE + 8 * i + 4
+
+    rng = np.random.default_rng(seed)
+    # small integer inputs (exact in fixed point): butterfly magnitudes
+    # grow up to 2^stages-fold, and intermediate values must stay inside
+    # int32 after the 16-bit scale — |x| < 16 keeps N <= 2048 safe
+    x = (rng.integers(-15, 16, size=N).astype(np.int64) << FX)
+    x_c = x.astype(np.float64) / (1 << FX)
+
+    # twiddles in fixed point (the app's own constant table — computed
+    # once, like the reference FFT's twiddle array)
+    wre = [_fx(math.cos(-2 * math.pi * k / N)) for k in range(N // 2)]
+    wim = [_fx(math.sin(-2 * math.pi * k / N)) for k in range(N // 2)]
+
+    sc = SimConfig(ConfigFile.from_string(config_text(
+        n_tiles, shared_mem=True, clock_scheme="lax")))
+    app = capi.CarbonApp(sc)
+
+    def main_fn():
+        bar = capi.CarbonBarrier(n_tiles)
+        tids = [capi.carbon_spawn_thread(worker, t, bar)
+                for t in range(1, n_tiles)]
+        worker(0, bar)
+        for tid in tids:
+            capi.carbon_join_thread(tid)
+
+    def worker(tile, bar):
+        # stage -1: bit-reverse permuted input, tile-partitioned writes
+        bits = stages
+        for i in range(tile, N, n_tiles):
+            r = int(f"{i:0{bits}b}"[::-1], 2)
+            capi.carbon_instr()  # index arithmetic (bit reverse)
+            capi.carbon_store(re_addr(i), _w32(int(x[r])))
+            capi.carbon_store(im_addr(i), 0)
+        bar.wait()
+        # butterfly stages: tile t owns butterflies t, t+T, t+2T, ...
+        for s in range(stages):
+            half = 1 << s
+            step = N // (2 * half)
+            bidx = 0
+            for g in range(0, N, 2 * half):
+                for j in range(half):
+                    if bidx % n_tiles == tile:
+                        a, b = g + j, g + j + half
+                        tw_r, tw_i = wre[j * step], wim[j * step]
+                        capi.carbon_instr()   # a index
+                        capi.carbon_instr()   # b index / twiddle index
+                        ar = capi.carbon_load(re_addr(a), check=True)
+                        ai = capi.carbon_load(im_addr(a), check=True)
+                        br = capi.carbon_load(re_addr(b), check=True)
+                        bi = capi.carbon_load(im_addr(b), check=True)
+                        ar, ai, br, bi = (_w32(v) for v in
+                                          (ar, ai, br, bi))
+                        # complex mul t = w * b: 4 FMUL + 2 FALU
+                        for _ in range(4):
+                            capi.carbon_instr(capi.Op.FMUL)
+                        tr = _fxmul(tw_r, br) - _fxmul(tw_i, bi)
+                        ti = _fxmul(tw_r, bi) + _fxmul(tw_i, br)
+                        for _ in range(2):
+                            capi.carbon_instr(capi.Op.FALU)
+                        # butterfly add/sub: 4 FALU
+                        for _ in range(4):
+                            capi.carbon_instr(capi.Op.FALU)
+                        capi.carbon_store(re_addr(a), _w32(ar + tr))
+                        capi.carbon_store(im_addr(a), _w32(ai + ti))
+                        capi.carbon_store(re_addr(b), _w32(ar - tr))
+                        capi.carbon_store(im_addr(b), _w32(ai - ti))
+                    bidx += 1
+            bar.wait()
+
+    batch = app.start(main_fn)
+
+    # the program's actual output, from the functional store
+    out = np.empty(N, np.complex128)
+    for i in range(N):
+        r = _w32(app._memory.get(re_addr(i), 0))
+        im = _w32(app._memory.get(im_addr(i), 0))
+        out[i] = complex(r, im) / (1 << FX)
+    return batch, x_c, out
+
+
+def verify_numerics(x_c, out, n_points) -> float:
+    """Max relative error of the captured run vs numpy.fft."""
+    ref = np.fft.fft(x_c)
+    scale = max(1.0, float(np.abs(ref).max()))
+    return float(np.abs(out - ref).max() / scale)
+
+
+def measured_mix(batch) -> dict:
+    """Instruction/memory mix of the captured trace, by record type."""
+    from graphite_tpu.trace.schema import (
+        FLAG_MEM0_VALID, FLAG_MEM0_WRITE, Op,
+    )
+
+    op = batch.op
+    flags = batch.flags
+    mem = (flags & FLAG_MEM0_VALID) != 0
+    return {
+        "records": int((op != int(Op.NOP)).sum()),
+        "fmul": int((op == int(Op.FMUL)).sum()),
+        "falu": int((op == int(Op.FALU)).sum()),
+        "ialu": int((op == int(Op.IALU)).sum()),
+        "loads": int((mem & ((flags & FLAG_MEM0_WRITE) == 0)).sum()),
+        "stores": int((mem & ((flags & FLAG_MEM0_WRITE) != 0)).sum()),
+    }
+
+
+def main(out_path: str = "fft_captured.npz",
+         n_tiles: int = 4, n_points: int = 128) -> dict:
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.engine.simulator import Simulator
+    from graphite_tpu.tools._template import config_text
+    from graphite_tpu.trace.io import load_trace_npz, save_trace_npz
+
+    batch, x_c, out = run_fft_app(n_tiles, n_points)
+    err = verify_numerics(x_c, out, n_points)
+    save_trace_npz(out_path, batch)
+    batch2 = load_trace_npz(out_path)
+
+    sc = SimConfig(ConfigFile.from_string(config_text(
+        n_tiles, shared_mem=True, clock_scheme="lax")))
+    res = Simulator(sc, batch2).run()
+    mix = measured_mix(batch2)
+    stages = int(math.log2(n_points))
+    butterflies = (n_points // 2) * stages
+    report = {
+        "npz": out_path,
+        "numeric_max_rel_err": err,
+        "func_errors": res.func_errors,
+        "completion_ns": res.completion_time_ps // 1000,
+        "instructions": res.total_instructions,
+        "l2_misses": int(np.asarray(res.mem_counters["l2_misses"]).sum()),
+        "mix": mix,
+        "fp_per_butterfly": (mix["fmul"] + mix["falu"]) / butterflies,
+        "mem_refs_per_butterfly": (mix["loads"] + mix["stores"])
+        / butterflies,
+    }
+    return report
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    path = sys.argv[1] if len(sys.argv) > 1 else "fft_captured.npz"
+    print(json.dumps(main(path), indent=1))

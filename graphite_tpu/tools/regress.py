@@ -44,8 +44,10 @@ def run_one(tiles, protocol, scheme, network, core, workload):
     if workload == "canneal":
         batch = BENCHMARKS[workload](tiles, footprint_lines=256,
                                      swaps_per_tile=6)
-    else:
+    elif workload == "fft":
         batch = BENCHMARKS[workload](tiles, points_per_tile=32)
+    else:
+        batch = BENCHMARKS[workload](tiles)
     sim = Simulator(SimConfig(cfg), batch)
     res = sim.run()
     return res
@@ -72,12 +74,21 @@ def main() -> int:
         ]
     else:
         # memory sweep: protocol x scheme (network/core fixed), then
-        # network x core (protocol fixed) on the fft kernel
+        # network x core (protocol fixed) on the fft kernel, then the
+        # full 13-kernel SPLASH-2/PARSEC roster under the default config
+        # (the reference's regress runs every SPLASH-2 app —
+        # `tools/regress/run_tests.py:44-58`)
+        from graphite_tpu.trace.benchmarks import BENCHMARKS
+
         matrix = [(p, s, "magic", "simple", "canneal")
                   for p, s in itertools.product(PROTOCOLS, SCHEMES)]
         matrix += [("pr_l1_pr_l2_dram_directory_msi", "full_map", n, c,
                     "fft")
                    for n, c in itertools.product(NETWORKS, CORES)]
+        matrix += [("pr_l1_pr_l2_dram_directory_msi", "full_map",
+                    "emesh_hop_counter", "simple", w)
+                   for w in sorted(BENCHMARKS)
+                   if w not in ("canneal", "fft")]
 
     failures = 0
     print(f"{'protocol':38} {'scheme':22} {'network':18} {'core':7} "

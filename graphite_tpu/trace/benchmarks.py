@@ -51,16 +51,25 @@ def _barrier(builders):
 
 
 def fft_trace(n_tiles: int, points_per_tile: int = 256,
-              use_memory: bool = False) -> TraceBatch:
+              use_memory: bool = False,
+              ops_per_point_per_stage: int = 6) -> TraceBatch:
     """Six-step FFT: transpose, column FFTs, twiddle, transpose, row FFTs,
-    transpose (SPLASH-2 fft.C structure).  Butterfly cost: ~10 fp ops per
-    point per log2 stage (complex mul + add) → FMUL/FALU bblocks.
+    transpose (SPLASH-2 fft.C structure).
+
+    Butterfly cost CALIBRATED against a real captured execution
+    (`tools/capture_fft.py` — an actual parallel radix-2 FFT recorded
+    instruction-by-instruction under the Carbon API): measured 10 fp ops
+    per BUTTERFLY (4 FMUL + 6 FALU: complex twiddle mul + add/sub) plus
+    ~2.3 integer index ops, i.e. ~5 fp + ~1.1 int = ~6 ops per POINT per
+    log2 stage.  The pre-calibration guess of 10 per point per stage
+    over-counted compute 1.7x (deltas recorded in PERF.md
+    "Trace-capture calibration").
 
     The default (no-memory) form is built as vectorized [T, L] numpy
     columns — the per-record Python-append path is O(T^2) at 1024 tiles
     (6M+ appends) and would dominate bench startup."""
     stages = max(1, int(np.log2(max(2, points_per_tile))))
-    fly_instr = points_per_tile * stages * 10
+    fly_instr = points_per_tile * stages * ops_per_point_per_stage
     msg_bytes = max(8, (points_per_tile // max(1, n_tiles)) * 16)
     if use_memory:
         return _fft_trace_with_memory(n_tiles, points_per_tile, fly_instr,
@@ -374,4 +383,189 @@ BENCHMARKS.update({
     "barnes": barnes_trace,
     "water-nsquared": water_nsquared_trace,
     "cholesky": cholesky_trace,
+})
+
+
+def water_spatial_trace(n_tiles: int, molecules_per_tile: int = 32,
+                        steps: int = 2) -> TraceBatch:
+    """Water-Spatial molecular dynamics (SPLASH-2 `apps/water-spatial`):
+    the O(n) spatial variant of water — molecules live in 3D cells, each
+    tile owns a cell block; per timestep: intra-molecule updates, pair
+    forces against molecules in NEIGHBORING cells only (~250 fp ops per
+    pair, half the 26-neighborhood by Newton's 3rd law — here the mesh
+    neighbor ring carries the boundary-molecule exchange), and the same
+    mutex-protected global virial accumulation as water-nsquared
+    (water-spatial's interf/bndry loops)."""
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    builders[0].barrier_init(_BAR, n_tiles)
+    builders[0].mutex_init(0)
+    _barrier(builders)
+    # neighbor pairs only: O(molecules * local density), not O(n^2)
+    pairs = molecules_per_tile * 8
+    boundary_bytes = max(8, molecules_per_tile // 4 * 72)  # 9 doubles/mol
+    for s in range(steps):
+        for b in builders:
+            b.bblock(molecules_per_tile * 40, molecules_per_tile * 40)
+        # boundary-cell molecule exchange with the ±1 mesh neighbors
+        for t, b in enumerate(builders):
+            b.send((t + 1) % n_tiles, boundary_bytes)
+            b.send((t - 1) % n_tiles, boundary_bytes)
+        for t, b in enumerate(builders):
+            b.recv((t - 1) % n_tiles, boundary_bytes)
+            b.recv((t + 1) % n_tiles, boundary_bytes)
+        for b in builders:
+            b.bblock(pairs * 250, pairs * 250)
+        for b in builders:
+            b.mutex_lock(0)
+            b.bblock(20, 20)
+            b.mutex_unlock(0)
+        _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+def volrend_trace(n_tiles: int, rays_per_tile: int = 128,
+                  frames: int = 2, seed: int = 21,
+                  use_memory: bool = False) -> TraceBatch:
+    """Volume rendering (SPLASH-2 `apps/volrend`): per frame each tile
+    ray-casts its image block — ~30 fp ops per sample, ~16 samples per
+    ray with early termination (adaptive ray lengths drawn per ray), and
+    irregular loads over the shared volume when use_memory; frames end
+    at a barrier after a mutex-protected image merge (volrend's
+    render/ray loops + the task-queue lock)."""
+    rng = np.random.default_rng(seed)
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    builders[0].barrier_init(_BAR, n_tiles)
+    builders[0].mutex_init(0)
+    _barrier(builders)
+    for f in range(frames):
+        for t, b in enumerate(builders):
+            lens = rng.integers(4, 17, size=min(rays_per_tile, 16))
+            for ray, ln in enumerate(lens):
+                if use_memory:
+                    b.load(int(rng.integers(1 << 14)) * 64)
+                b.bblock(int(ln) * 30, int(ln) * 30)
+            rem = rays_per_tile - len(lens)
+            if rem > 0:
+                b.bblock(rem * 10 * 30, rem * 10 * 30)
+        for b in builders:
+            b.mutex_lock(0)
+            b.bblock(16, 16)
+            b.mutex_unlock(0)
+        _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+def raytrace_trace(n_tiles: int, rays_per_tile: int = 128,
+                   seed: int = 33, use_memory: bool = False) -> TraceBatch:
+    """Ray tracing (SPLASH-2 `apps/raytrace`): a single frame of primary
+    rays over image tiles — per ray a BSP-tree walk (~log-depth cell
+    visits x ~40 fp intersection ops, depth drawn per ray for the
+    irregular secondary-ray fan-out) with irregular shared-geometry
+    loads; work stealing is modeled as a mutex-protected queue touch
+    every 32 rays (raytrace's GetJobs/PutJobs)."""
+    rng = np.random.default_rng(seed)
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    builders[0].barrier_init(_BAR, n_tiles)
+    builders[0].mutex_init(0)
+    _barrier(builders)
+    for t, b in enumerate(builders):
+        depths = rng.integers(2, 9, size=min(rays_per_tile, 16))
+        for ray, d in enumerate(depths):
+            if ray % 32 == 0:
+                b.mutex_lock(0)
+                b.bblock(10, 10)
+                b.mutex_unlock(0)
+            if use_memory:
+                b.load(int(rng.integers(1 << 14)) * 64)
+            b.bblock(int(d) * 40, int(d) * 40)
+        rem = rays_per_tile - len(depths)
+        if rem > 0:
+            b.bblock(rem * 5 * 40, rem * 5 * 40)
+    _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+def radiosity_trace(n_tiles: int, patches_per_tile: int = 32,
+                    iterations: int = 2, seed: int = 55) -> TraceBatch:
+    """Hierarchical radiosity (SPLASH-2 `apps/radiosity`): per iteration
+    each tile refines its patch interactions — ~60 fp ops per form-factor
+    + visibility test, patch counts drawn per tile for the strong load
+    imbalance the original exhibits — then distributes energy updates to
+    other patch owners (task-queue puts, modeled as point-to-point sends
+    to a random owner) behind a mutex; iterations end at a barrier
+    (radiosity's process_tasks loop)."""
+    rng = np.random.default_rng(seed)
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    builders[0].barrier_init(_BAR, n_tiles)
+    builders[0].mutex_init(0)
+    _barrier(builders)
+    for it in range(iterations):
+        counts = rng.integers(patches_per_tile // 2,
+                              patches_per_tile * 2, size=n_tiles)
+        tgt = [int(rng.integers(n_tiles)) for _ in range(n_tiles)]
+        for t, b in enumerate(builders):
+            b.bblock(int(counts[t]) * 60, int(counts[t]) * 60)
+            b.mutex_lock(0)
+            b.bblock(12, 12)
+            b.mutex_unlock(0)
+        # energy pushes: one update message to a random other owner,
+        # mirrored receives keep the rendezvous deterministic
+        for t, b in enumerate(builders):
+            dst = tgt[t] if tgt[t] != t else (t + 1) % n_tiles
+            b.send(dst, 64)
+        recv_from = [[] for _ in range(n_tiles)]
+        for t in range(n_tiles):
+            dst = tgt[t] if tgt[t] != t else (t + 1) % n_tiles
+            recv_from[dst].append(t)
+        for t, b in enumerate(builders):
+            for src in recv_from[t]:
+                b.recv(src, 64)
+            b.bblock(len(recv_from[t]) * 20 + 1, len(recv_from[t]) * 20 + 1)
+        _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+def fmm_trace(n_tiles: int, bodies_per_tile: int = 64,
+              multipole_terms: int = 4) -> TraceBatch:
+    """Fast Multipole Method N-body (SPLASH-2 `apps/fmm`): per step —
+    tree build (integer-heavy) | barrier | upward pass (multipole
+    moments, ~p^2 fp per cell) | interaction lists: each cell's V-list
+    multipole-to-local translations (~p^4 fp per interaction, exchanged
+    with mesh-neighbor owners) | downward pass + near-field direct
+    O(bodies x neighbors) | barrier (fmm's steps in interactions.C /
+    construct_grid)."""
+    p2 = multipole_terms * multipole_terms
+    p4 = p2 * p2
+    builders = [TraceBuilder() for _ in range(n_tiles)]
+    builders[0].barrier_init(_BAR, n_tiles)
+    cells = max(1, bodies_per_tile // 8)
+    for b in builders:
+        b.bblock(bodies_per_tile * 10, bodies_per_tile * 10)  # tree build
+    _barrier(builders)
+    for b in builders:
+        b.bblock(cells * p2, cells * p2)                      # upward
+    _barrier(builders)
+    # V-list exchange: moments to/from the ±1, ±2 mesh neighbors
+    mom_bytes = p2 * 16
+    for off in (1, 2):
+        for t, b in enumerate(builders):
+            b.send((t + off) % n_tiles, mom_bytes)
+        for t, b in enumerate(builders):
+            b.recv((t - off) % n_tiles, mom_bytes)
+    for b in builders:
+        b.bblock(cells * 8 * p4, cells * 8 * p4)              # M2L
+    _barrier(builders)
+    near = bodies_per_tile * 9 * 20
+    for b in builders:
+        b.bblock(cells * p2 + near, cells * p2 + near)        # down + near
+    _barrier(builders)
+    return TraceBatch.from_builders(builders)
+
+
+BENCHMARKS.update({
+    "water-spatial": water_spatial_trace,
+    "volrend": volrend_trace,
+    "raytrace": raytrace_trace,
+    "radiosity": radiosity_trace,
+    "fmm": fmm_trace,
 })

@@ -1,0 +1,56 @@
+"""Real captured execution -> npz -> full-stack replay (tools/capture_fft).
+
+The reference's benchmark tier runs real binaries under Pin; the TPU
+frontend's equivalent evidence is a real program (an actual parallel
+radix-2 FFT, not a skeleton) recorded instruction-by-instruction and
+replayed through the coherence engine with functional checking.
+"""
+
+import numpy as np
+
+from graphite_tpu.tools.capture_fft import (
+    measured_mix, run_fft_app, verify_numerics,
+)
+
+
+def test_captured_fft_is_numerically_real():
+    """The captured program computes a correct FFT (it is a real
+    execution, not a synthetic mix)."""
+    batch, x_c, out = run_fft_app(n_tiles=4, n_points=64)
+    err = verify_numerics(x_c, out, 64)
+    assert err < 1e-3, f"captured FFT numerically wrong: {err}"
+
+
+def test_captured_fft_replays_through_coherence(tmp_path):
+    """npz round trip + replay through the full MSI stack: every
+    barrier-separated load is FLAG_CHECKed against the live value, so
+    the coherence engine must reproduce the real program's dataflow."""
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.engine.simulator import Simulator
+    from graphite_tpu.tools._template import config_text
+    from graphite_tpu.trace.io import load_trace_npz, save_trace_npz
+
+    batch, _, _ = run_fft_app(n_tiles=4, n_points=64)
+    p = tmp_path / "fft.npz"
+    save_trace_npz(str(p), batch)
+    batch2 = load_trace_npz(str(p))
+
+    sc = SimConfig(ConfigFile.from_string(config_text(
+        4, shared_mem=True, clock_scheme="lax")))
+    res = Simulator(sc, batch2).run()
+    assert res.func_errors == 0
+    assert int(np.asarray(res.mem_counters["l2_misses"]).sum()) > 0
+    assert res.total_instructions > 0
+
+
+def test_measured_mix_matches_calibration():
+    """The skeleton calibration constants come from this measurement:
+    10 fp per butterfly (4 FMUL + 6 FALU), ~8-9 memory refs."""
+    batch, _, _ = run_fft_app(n_tiles=4, n_points=64)
+    mix = measured_mix(batch)
+    stages = 6
+    butterflies = 32 * stages
+    assert (mix["fmul"] + mix["falu"]) / butterflies == 10.0
+    assert mix["fmul"] / butterflies == 4.0
+    refs = (mix["loads"] + mix["stores"]) / butterflies
+    assert 8.0 <= refs <= 9.0
