@@ -241,6 +241,70 @@ class _HbhNet:
         return out
 
 
+class _AtacNet(_HbhNet):
+    """Serial ATAC optical-NoC oracle (`network_model_atac.cc:337-368`):
+    one packet at a time over per-hub queue dicts — the independent
+    counterpart of `models/network_atac.route_atac`.  Intra-cluster (or
+    short-distance under distance_based routing) unicasts ride the ENet
+    at hop cost; everything else pays ENet-to-hub, send-hub queue +
+    router, the optical link (waveguide + E-O/O-E), receive-hub queue +
+    router, and the receive net, plus receiver serialization."""
+
+    def route(self, src, dst, payload_bytes, t_send_ps, enabled):
+        p = self.p  # AtacParams
+        if not enabled:
+            return t_send_ps
+        bits = (HEADER_BYTES + payload_bytes) * 8
+        flits = max(_ceil_div(bits, p.flit_width_bits), 1)
+
+        def cyc_ps(n):
+            return _ceil_div(int(n) * 10**6, p.freq_mhz)
+
+        def cluster_of(t):
+            x, y = t % p.mesh_width, t // p.mesh_width
+            cpr = p.mesh_width // p.cluster_width
+            return (y // p.cluster_height) * cpr + (x // p.cluster_width)
+
+        def hub_tile(c):
+            cpr = p.mesh_width // p.cluster_width
+            return ((c // cpr) * p.cluster_height * p.mesh_width
+                    + (c % cpr) * p.cluster_width)
+
+        def hops(a, b):
+            w = p.mesh_width
+            return abs(a % w - b % w) + abs(a // w - b // w)
+
+        ser_ps = 0 if src == dst else cyc_ps(flits)
+        csrc, cdst = cluster_of(src), cluster_of(dst)
+        direct = hops(src, dst)
+        use_enet = csrc == cdst
+        if p.global_routing_strategy == "distance_based":
+            use_enet = use_enet or direct <= p.unicast_distance_threshold
+        if use_enet:
+            return t_send_ps + cyc_ps(direct * p.enet_hop_cycles) + ser_ps
+
+        sendhub_arrive = t_send_ps + cyc_ps(
+            hops(src, hub_tile(csrc)) * p.enet_hop_cycles)
+        if p.contention_enabled:
+            t_cyc = _ceil_div(sendhub_arrive * p.freq_mhz, 10**6)
+            d, _ = self._delay(csrc, t_cyc, flits)
+            self._commit(csrc, t_cyc, d, flits)
+        else:
+            d = 0
+        sendhub_done = sendhub_arrive + cyc_ps(d + p.send_hub_cycles)
+        recvhub_arrive = sendhub_done + p.optical_link_ps
+        if p.contention_enabled:
+            t_cyc = _ceil_div(recvhub_arrive * p.freq_mhz, 10**6)
+            d2, _ = self._delay(p.n_clusters + cdst, t_cyc, flits)
+            self._commit(p.n_clusters + cdst, t_cyc, d2, flits)
+        else:
+            d2 = 0
+        recvhub_done = recvhub_arrive + cyc_ps(d2 + p.receive_hub_cycles)
+        return (recvhub_done
+                + cyc_ps(p.receive_net_levels * p.receive_net_cycles)
+                + ser_ps)
+
+
 class _Tile:
     __slots__ = ("tid", "clock", "idx", "done", "blocked", "counts")
 
@@ -277,6 +341,10 @@ def run_golden(sim_config, batch: TraceBatch,
         from graphite_tpu.models.network_hop_by_hop import HopByHopParams
 
         net = _HbhNet(HopByHopParams.from_config(sim_config, "user"))
+    elif net_kind == "atac":
+        from graphite_tpu.models.network_atac import AtacParams
+
+        net = _AtacNet(AtacParams.from_config(sim_config, "user"))
     else:
         from graphite_tpu.models.network_user import mesh_dims
 
@@ -308,11 +376,17 @@ def run_golden(sim_config, batch: TraceBatch,
                 f"is {ct!r}")
     mem = None
     if sim_config.enable_shared_mem and has_mem:
-        from graphite_tpu.golden.memory_model import GoldenMemory
         from graphite_tpu.memory.params import MemParams
 
-        mem = GoldenMemory(MemParams.from_config(sim_config),
-                           module_freq_mhz(cfg, "CORE"))
+        mp = MemParams.from_config(sim_config)
+        if mp.protocol.startswith("pr_l1_sh_l2"):
+            from graphite_tpu.golden.memory_model_shl2 import GoldenShL2
+
+            mem = GoldenShL2(mp, module_freq_mhz(cfg, "CORE"))
+        else:
+            from graphite_tpu.golden.memory_model import GoldenMemory
+
+            mem = GoldenMemory(mp, module_freq_mhz(cfg, "CORE"))
 
     tiles = [_Tile(t) for t in range(T)]
     enabled = [True]  # models toggle is GLOBAL (PerformanceCounterManager)

@@ -1,0 +1,495 @@
+"""Sequential golden model of the shared-L2 protocols (pr_l1_sh_l2_*).
+
+Independent second implementation of `memory/engine_shl2.py` for
+differential testing — one access at a time over plain Python data
+structures, deliberately sharing no logic with the vectorized engine
+(only `MemParams`, the config-derived constants, and the reusable serial
+cache/net fixtures from the sibling oracles).
+
+Semantics modeled (reference: `pr_l1_sh_l2_{msi,mesi}/`):
+ - private L1s over a DISTRIBUTED shared L2: the slice at a line's home
+   tile (line % T, `l2_cache_hash_fn.cc`) holds data + an embedded
+   directory entry over the L1 copies (`l2_cache_cntlr.h:27-67`);
+ - L1 miss -> EX/SH_REQ to the home (`l1_cache_cntlr.cc:81-160`); the
+   home serves it from the slice, running the directory FSM over the L1
+   sharers (`l2_cache_cntlr.cc:443-700`), or allocates DATA_INVALID and
+   fetches from DRAM (`:541-560,900-915`);
+ - MESI grants EXCLUSIVE on a read of a line with no other L1 copies
+   (`pr_l1_sh_l2_mesi/l2_cache_cntlr.cc:660-680`) and promotes E->M
+   silently on a write hit;
+ - slice-victim replacement: a victim with live L1 copies runs NULLIFY
+   (INV/FLUSH sweep) before the original request resumes; a clean
+   UNCACHED victim dies silently (dirty -> DRAM write);
+ - engine-mirrored simplifications (documented there): upgrade replies
+   modeled as EX_REP, one transaction per home, the DRAM fetch is a
+   timing round trip to the line's DRAM home with zero-load net legs.
+
+Ordering discipline matches the private-L2 oracle: accesses are
+processed synchronously in core-clock order; differential tests assert
+bit-exactness on serialized/disjoint workloads and envelopes on racy
+ones (BASELINE.md carve-outs).
+"""
+
+from __future__ import annotations
+
+from graphite_tpu.golden.memory_model import (
+    EXCLUSIVE, INVALID, MODIFIED, SHARED,
+    _Cache, _ceil_div, _cycles_to_ps, _readable, _writable,
+)
+from graphite_tpu.memory.params import MemParams
+from graphite_tpu.memory.state import (
+    MOD_CORE, MOD_L1D, MOD_L1I, MOD_L2, MOD_NET_MEM,
+)
+from graphite_tpu.trace.schema import (
+    FLAG_MEM0_VALID, FLAG_MEM0_WRITE, FLAG_MEM1_VALID, FLAG_MEM1_WRITE, Op,
+)
+
+DIR_UNCACHED, DIR_SHARED, DIR_MODIFIED = 0, 1, 2
+DIR_EXCLUSIVE = 4
+DATA_INVALID = 5  # slice data still in flight from DRAM
+
+
+class _SliceEntry:
+    """Embedded directory entry of one L2-slice line."""
+
+    __slots__ = ("dstate", "owner", "sharers")
+
+    def __init__(self):
+        self.dstate = DIR_UNCACHED
+        self.owner = -1
+        self.sharers: set[int] = set()
+
+
+class GoldenShL2:
+    """Drop-in for GoldenMemory (same access_record interface) modeling
+    the shared-L2 protocols."""
+
+    def __init__(self, mp: MemParams, freq_mhz):
+        self.mp = mp
+        self.mesi = mp.protocol.endswith("mesi")
+        T = mp.n_tiles
+        self.freq = [int(f) for f in freq_mhz] if hasattr(
+            freq_mhz, "__len__") else [int(freq_mhz)] * T
+
+        def geom(lp, t):
+            s = lp.tile_sets[t] if lp.tile_sets is not None else lp.num_sets
+            w = lp.tile_ways[t] if lp.tile_ways is not None else lp.num_ways
+            return s, w
+
+        self.l1i = [_Cache(*geom(mp.l1i, t), mp.l1i.replacement)
+                    for t in range(T)]
+        self.l1d = [_Cache(*geom(mp.l1d, t), mp.l1d.replacement)
+                    for t in range(T)]
+        self.l2 = [_Cache(*geom(mp.l2, t), mp.l2.replacement)
+                   for t in range(T)]
+        # embedded directory per slice: (set, way) -> _SliceEntry
+        self.dir: list[dict] = [dict() for _ in range(T)]
+        self.last_line = [-1] * T      # per-home same-line floor
+        self.last_done = [0] * T
+        self.instr_buf = [-1] * T
+        if mp.net_hbh is not None:
+            from graphite_tpu.golden.interpreter import _HbhNet
+
+            self.net = _HbhNet(mp.net_hbh)
+        else:
+            self.net = None
+        self.counters = {
+            k: [0] * T
+            for k in ("l1i_hits", "l1i_misses", "l1d_read_hits",
+                      "l1d_read_misses", "l1d_write_hits",
+                      "l1d_write_misses", "l2_hits", "l2_misses",
+                      "evictions", "invalidations", "dir_accesses",
+                      "dir_broadcasts", "dram_reads", "dram_writes",
+                      "dram_total_lat_ps")
+        }
+
+    # -- timing helpers ----------------------------------------------------
+
+    def _cc(self, t, n, enabled):
+        if hasattr(n, "__len__"):
+            n = int(n[t])
+        return _cycles_to_ps(int(n), self.freq[t]) if enabled else 0
+
+    def _sync(self, t, a, b, enabled):
+        return self._cc(t, self.mp.sync_cycles(a, b), enabled)
+
+    def _net_zero_ps(self, src, dst, bits, enabled):
+        mp = self.mp
+        if mp.net_kind == "magic":
+            return _cycles_to_ps(1, mp.net_freq_mhz) if enabled else 0
+        w = mp.mesh_width
+        hops = abs(src % w - dst % w) + abs(src // w - dst // w)
+        cycles = hops * mp.hop_latency_cycles
+        if src != dst:
+            cycles += _ceil_div(bits, mp.flit_width_bits)
+        return _cycles_to_ps(cycles, mp.net_freq_mhz) if enabled else 0
+
+    def _net_arrive(self, src, dst, bits, t_send, enabled):
+        if self.net is not None:
+            return self.net.route_bits(src, dst, bits, t_send, enabled)
+        return t_send + self._net_zero_ps(src, dst, bits, enabled)
+
+    def _net_fanout(self, src, targets, bits, t0, enabled,
+                    n_copies=None, ranks=None):
+        if self.net is not None:
+            return self.net.fanout(src, targets, bits, t0, enabled,
+                                   n_copies, ranks)
+        return {s: t0 + self._net_zero_ps(src, s, bits, enabled)
+                for s in targets}
+
+    def _dram_rt(self, home, enabled):
+        """DRAM fetch round trip (engine `_dram_lat_ps`: zero-load net
+        legs + access, even under hop_by_hop — documented)."""
+        mp = self.mp
+        dram_home = mp.mc_tiles[home % len(mp.mc_tiles)]
+        net = self._net_zero_ps(home, dram_home, mp.rep_bits, enabled)
+        acc = ((mp.dram_latency_ns + mp.dram_processing_ns) * 1000
+               if enabled else 0)
+        return 2 * net + acc
+
+    def _home_of(self, line):
+        return line % self.mp.n_tiles
+
+    def _entry(self, home, line):
+        l2 = self.l2[home]
+        hit, way, _ = l2.lookup(line)
+        if not hit:
+            return None, -1
+        key = (line % l2.sets, way)
+        return self.dir[home].setdefault(key, _SliceEntry()), way
+
+    # -- sharer-side FWD service (`l1_cache_cntlr.cc` handlers) ------------
+
+    def _serve_fwd(self, s, kind, line, ftime, home, enabled):
+        """(ack_time, dirty_data_travels)."""
+        mp = self.mp
+        l1i, l1d = self.l1i[s], self.l1d[s]
+        hi, wi, sti = l1i.lookup(line)
+        hd, wd, std = l1d.lookup(line)
+        assert hi or hd, (
+            f"golden shl2: FWD {kind} to tile {s} line {line:#x} not held")
+        was_dirty = (hd and std == MODIFIED) or (hi and sti == MODIFIED)
+        done = (ftime + self._sync(s, MOD_L1D, MOD_NET_MEM, enabled)
+                + self._cc(s, mp.l1d.data_and_tags_cycles, enabled))
+        if kind == "wb":
+            if hi:
+                l1i.set_state(line, wi, SHARED)
+            if hd:
+                l1d.set_state(line, wd, SHARED)
+            ack_dirty = was_dirty
+            ack_is_inv = False
+        else:  # inv / flush
+            if hi:
+                l1i.invalidate(line)
+            if hd:
+                l1d.invalidate(line)
+            if kind == "inv" and enabled:
+                self.counters["invalidations"][s] += 1
+            ack_dirty = kind == "flush" and was_dirty
+            # a FLUSH of a clean line carries no data: INV_REP
+            ack_is_inv = kind == "inv" or (kind == "flush" and not was_dirty)
+        bits = mp.req_bits if ack_is_inv else mp.rep_bits
+        return self._net_arrive(s, home, bits, done, enabled), ack_dirty
+
+    # -- L1 eviction notices at the home -----------------------------------
+
+    def _apply_eviction(self, src, line, is_flush, etime, enabled):
+        home = self._home_of(line)
+        if enabled:
+            self.counters["evictions"][home] += 1
+        entry, way = self._entry(home, line)
+        if entry is None:
+            return
+        entry.sharers.discard(src)
+        if src == entry.owner:
+            entry.owner = -1
+        entry.dstate = DIR_UNCACHED if not entry.sharers else DIR_SHARED
+        if is_flush:
+            self.l2[home].set_state(line, way, MODIFIED)
+
+    # -- one home transaction ----------------------------------------------
+
+    def _home_txn(self, home, requester, line, is_write, arrival, enabled,
+                  _resumed=False):
+        """Serve one EX/SH request at the home slice; returns the reply
+        arrival time at the requester."""
+        mp = self.mp
+        l2 = self.l2[home]
+        c = self.counters
+        l2_acc = self._cc(home, mp.l2.data_and_tags_cycles, enabled)
+
+        rtime = arrival
+        if not _resumed:
+            rtime += self._sync(home, MOD_L2, MOD_NET_MEM, enabled)
+        if line == self.last_line[home]:
+            rtime = max(rtime, self.last_done[home])
+        if enabled:
+            c["dir_accesses"][home] += 1
+
+        hit, way, l2_state = l2.lookup(line)
+        if not hit:
+            # allocate: victim with live L1 copies runs NULLIFY first
+            v_way, v_valid, v_line, v_state = l2.pick_victim(line)
+            v_entry = (self.dir[home].get((v_line % l2.sets, v_way))
+                       if v_valid else None)
+            if v_valid and v_entry is not None and \
+                    v_entry.dstate != DIR_UNCACHED:
+                self._run_nullify(home, v_line, v_way, v_entry,
+                                  rtime, enabled)
+                # resume the original request (saved + re-run)
+                return self._home_txn(home, requester, line, is_write,
+                                      rtime, enabled, _resumed=True)
+            if v_valid:
+                # clean UNCACHED victim: silent kill (dirty -> DRAM)
+                if v_state == MODIFIED and enabled:
+                    c["dram_writes"][home] += 1
+                self.dir[home].pop((v_line % l2.sets, v_way), None)
+                l2.invalidate(v_line)
+            eff_time = rtime + l2_acc
+            l2.insert_at(line, v_way, DATA_INVALID)
+            self.dir[home][(line % l2.sets, v_way)] = _SliceEntry()
+            if enabled:
+                c["l2_misses"][home] += 1
+                c["dram_reads"][home] += 1
+                c["dram_total_lat_ps"][home] += (
+                    (mp.dram_latency_ns + mp.dram_processing_ns) * 1000)
+            txn_time = max(eff_time,
+                           eff_time + self._dram_rt(home, enabled))
+            l2.set_state(line, v_way, SHARED)
+            entry = self.dir[home][(line % l2.sets, v_way)]
+            way, l2_state = v_way, SHARED
+            got_flush = False
+        else:
+            eff_time = rtime + l2_acc
+            entry, _ = self._entry(home, line)
+            if enabled:
+                c["l2_hits"][home] += 1
+            txn_time = eff_time
+            got_flush = False
+
+            # fan-out per directory state
+            targets = {}
+            shared = entry.dstate == DIR_SHARED
+            owned_like = entry.dstate in (DIR_MODIFIED, DIR_EXCLUSIVE)
+            if is_write and shared:
+                for s in entry.sharers:
+                    if s != requester:  # upgrade keeps the requester copy
+                        targets[s] = "inv"
+            elif owned_like:
+                targets[entry.owner] = "wb" if not is_write else "flush"
+
+            broadcast = False
+            k = mp.max_hw_sharers
+            if mp.dir_type == "limited_no_broadcast" and not is_write:
+                already = requester in entry.sharers
+                if shared and len(entry.sharers) >= k and not already:
+                    victims = sorted(entry.sharers)
+                    victim = victims[0]
+                    entry.sharers.discard(victim)
+                    targets = {victim: "inv"}
+                elif owned_like and len(entry.sharers) >= k \
+                        and not already:
+                    targets = {entry.owner: "flush"}
+                    entry.dstate = DIR_UNCACHED
+                    entry.owner = -1
+                    entry.sharers = set()
+                    owned_like = False
+            if mp.dir_type in ("ackwise", "limited_broadcast") \
+                    and is_write and shared \
+                    and len(entry.sharers) > k:
+                broadcast = True
+                if enabled:
+                    c["dir_broadcasts"][home] += 1
+            if mp.dir_type == "limitless":
+                already = requester in entry.sharers
+                sw = (len(entry.sharers) > k
+                      or (not is_write and not already
+                          and len(entry.sharers) >= k
+                          and (shared or owned_like)))
+                if sw:
+                    eff_time += (_cycles_to_ps(mp.limitless_trap_cycles,
+                                               mp.dir_freq_mhz)
+                                 if enabled else 0)
+                    txn_time = eff_time
+
+            if targets:
+                if broadcast:
+                    f_arrivals = self._net_fanout(
+                        home, list(targets), mp.req_bits, eff_time,
+                        enabled, n_copies=mp.n_tiles - 1,
+                        ranks=self._bc_ranks(targets, requester))
+                else:
+                    f_arrivals = self._net_fanout(
+                        home, list(targets), mp.req_bits, eff_time,
+                        enabled)
+                for s in sorted(targets):
+                    ack_time, dirty = self._serve_fwd(
+                        s, targets[s], line, f_arrivals[s], home, enabled)
+                    txn_time = max(txn_time, ack_time + l2_acc)
+                    got_flush = got_flush or dirty
+                    if targets[s] in ("inv", "flush"):
+                        entry.sharers.discard(s)
+                        if s == entry.owner:
+                            entry.owner = -1
+                if got_flush:
+                    l2.set_state(line, way, MODIFIED)
+                if targets and any(v == "wb" for v in targets.values()):
+                    entry.dstate = DIR_SHARED
+
+        # finish: directory end state + reply
+        if is_write:
+            entry.dstate = DIR_MODIFIED
+            entry.owner = requester
+            entry.sharers = {requester}
+            rep = "ex"
+        else:
+            alone = len(entry.sharers - {requester}) == 0
+            if alone and self.mesi:
+                entry.dstate = DIR_EXCLUSIVE
+                entry.owner = requester
+                rep = "excl"
+            else:
+                entry.dstate = DIR_SHARED
+                entry.owner = -1
+                rep = "sh"
+            entry.sharers.add(requester)
+        rep_ready = txn_time + self._sync(home, MOD_L2, MOD_NET_MEM,
+                                          enabled)
+        self.last_line[home] = line
+        self.last_done[home] = rep_ready
+        return (self._net_arrive(home, requester, mp.rep_bits, rep_ready,
+                                 enabled), rep)
+
+    @staticmethod
+    def _bc_ranks(targets, requester):
+        """Engine broadcast ranks: cumsum over the `send | over_bc` row,
+        which covers every tile EXCEPT the requester — target s's rank is
+        its tile id minus one if the requester sits below it."""
+        return {s: s - (1 if requester < s else 0) for s in targets}
+
+    def _run_nullify(self, home, v_line, v_way, entry, rtime, enabled):
+        """Evict a slice victim with live L1 copies: INV the sharers (or
+        FLUSH the owner), then the entry dies; dirty data -> DRAM."""
+        mp = self.mp
+        l2 = self.l2[home]
+        c = self.counters
+        l2_acc = self._cc(home, mp.l2.data_and_tags_cycles, enabled)
+        # dir_accesses counts request pops + resumes only (the engine's
+        # `starting` — the nullify runs inside the pop's iteration)
+        eff_time = rtime + l2_acc
+        if entry.dstate in (DIR_MODIFIED, DIR_EXCLUSIVE):
+            targets = {entry.owner: "flush"}
+        else:
+            targets = {s: "inv" for s in entry.sharers}
+        txn_time = eff_time
+        got_flush = False
+        f_arrivals = self._net_fanout(home, list(targets), mp.req_bits,
+                                      eff_time, enabled)
+        for s in sorted(targets):
+            ack_time, dirty = self._serve_fwd(
+                s, targets[s], line=v_line, ftime=f_arrivals[s],
+                home=home, enabled=enabled)
+            txn_time = max(txn_time, ack_time + l2_acc)
+            got_flush = got_flush or dirty
+        _, _, v_state = l2.lookup(v_line)
+        if (v_state == MODIFIED or got_flush) and enabled:
+            c["dram_writes"][home] += 1
+        l2.invalidate(v_line)
+        self.dir[home].pop((v_line % l2.sets, v_way), None)
+        rep_ready = txn_time + self._sync(home, MOD_L2, MOD_NET_MEM,
+                                          enabled)
+        self.last_line[home] = v_line
+        self.last_done[home] = rep_ready
+
+    # -- requester slot ----------------------------------------------------
+
+    def _slot(self, t, is_icache, addr, write, clock_ps, enabled):
+        mp = self.mp
+        line = addr >> mp.line_bits
+        l1 = self.l1i[t] if is_icache else self.l1d[t]
+        lp = mp.l1i if is_icache else mp.l1d
+        c = self.counters
+
+        if is_icache:
+            ibuf_hit = line == self.instr_buf[t]
+            self.instr_buf[t] = line
+            if ibuf_hit:
+                if enabled:
+                    c["l1i_hits"][t] += 1
+                return self._cc(t, 1, enabled)
+
+        # engine uses sync(CORE, L1D) for both L1s (sync_core_l1)
+        sclock = clock_ps + self._sync(t, MOD_CORE, MOD_L1D, enabled)
+        l1_dat = self._cc(t, lp.data_and_tags_cycles, enabled)
+        l1_tag = self._cc(t, lp.tags_cycles, enabled)
+
+        hit, way, st = l1.lookup(line)
+        if hit and (_writable(st) if write else _readable(st)):
+            # MESI silent E->M promotion on a write hit
+            if write and st == EXCLUSIVE:
+                l1.set_state(line, way, MODIFIED)
+            l1.touch(line, way)
+            if enabled:
+                if is_icache:
+                    c["l1i_hits"][t] += 1
+                elif write:
+                    c["l1d_write_hits"][t] += 1
+                else:
+                    c["l1d_read_hits"][t] += 1
+            return sclock + l1_dat - clock_ps
+        if enabled:
+            if is_icache:
+                c["l1i_misses"][t] += 1
+            elif write:
+                c["l1d_write_misses"][t] += 1
+            else:
+                c["l1d_read_misses"][t] += 1
+
+        home = self._home_of(line)
+        req_send = sclock + l1_tag + self._sync(t, MOD_L1D, MOD_NET_MEM,
+                                                enabled)
+        arrival = self._net_arrive(t, home, mp.req_bits, req_send, enabled)
+        rep_time, rep = self._home_txn(home, t, line, write, arrival,
+                                       enabled)
+
+        # fill: upgrades land in the existing way, misses pick a victim
+        new_state = (MODIFIED if rep == "ex"
+                     else EXCLUSIVE if rep == "excl" else SHARED)
+        fill_ps = (rep_time + self._sync(t, MOD_L1D, MOD_NET_MEM, enabled)
+                   + self._cc(t, mp.l1d.data_and_tags_cycles, enabled))
+        hit2, way2, _ = l1.lookup(line)
+        if hit2:
+            l1.insert_at(line, way2, new_state)
+        else:
+            v_way, v_valid, v_line, v_state = l1.pick_victim(line)
+            if v_valid:
+                if enabled:
+                    c["evictions"][t] += 1
+                v_home = self._home_of(v_line)
+                e_bits = (mp.rep_bits if v_state == MODIFIED
+                          else mp.req_bits)
+                e_arr = self._net_arrive(t, v_home, e_bits, fill_ps,
+                                         enabled)
+                self._apply_eviction(t, v_line, v_state == MODIFIED,
+                                     e_arr, enabled)
+            l1.insert_at(line, v_way, new_state)
+        return fill_ps - clock_ps
+
+    # -- record entry (same interface as GoldenMemory) ---------------------
+
+    def access_record(self, t, op, flags, pc, addr0, addr1, clock_ps,
+                      enabled):
+        mp = self.mp
+        acc = 0
+        is_instr = op < 15 or op == int(Op.BBLOCK)
+        if mp.icache_modeling and enabled and is_instr:
+            acc += self._slot(t, True, pc, False, clock_ps, enabled)
+        if flags & FLAG_MEM0_VALID:
+            acc += self._slot(t, False, addr0,
+                              bool(flags & FLAG_MEM0_WRITE), clock_ps,
+                              enabled)
+        if flags & FLAG_MEM1_VALID:
+            acc += self._slot(t, False, addr1,
+                              bool(flags & FLAG_MEM1_WRITE), clock_ps,
+                              enabled)
+        return acc

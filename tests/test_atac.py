@@ -147,3 +147,72 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+class TestAtacGolden:
+    """Differential validation vs the serial _AtacNet oracle: the first
+    independent check of ATAC's timing algebra (round-2 gap — ATAC was
+    expectation-tested only)."""
+
+    def _assert_exact(self, sc, builders):
+        import numpy as np
+
+        from graphite_tpu.golden import run_golden
+
+        batch = TraceBatch.from_builders(builders)
+        res = Simulator(sc, batch).run()
+        gold = run_golden(sc, batch)
+        np.testing.assert_array_equal(res.clock_ps, gold.clock_ps,
+                                      err_msg="clock")
+        return res
+
+    def test_serialized_pingpong_exact(self):
+        """Cross-cluster ping-pong (strictly serialized by the
+        send/recv dependence): bit-exact incl. hub contention queues."""
+        sc = make_config(16, contention="true")
+        bs = [TraceBuilder() for _ in range(16)]
+        for r in range(8):
+            bs[0].send(15, 8)
+            bs[15].recv(0, 8)
+            bs[15].send(0, 8)
+            bs[0].recv(15, 8)
+        self._assert_exact(sc, bs)
+
+    def test_serialized_mixed_routes_exact(self):
+        """ENet (intra-cluster), ONet (cross-cluster), and self sends in
+        one serialized chain, both routing strategies."""
+        for strategy in ("cluster_based", "distance_based"):
+            sc = make_config(16, strategy=strategy, contention="true")
+            bs = [TraceBuilder() for _ in range(16)]
+            chain = [(0, 1), (1, 12), (12, 3), (3, 3), (3, 0)]
+            for (a, b) in chain:
+                bs[a].send(b, 32)
+                if a != b:
+                    bs[b].recv(a, 32)
+                else:
+                    bs[a].recv(a, 32)
+            self._assert_exact(sc, bs)
+
+    def test_hub_queue_compounding_exact(self):
+        """Back-to-back ONet packets from one cluster compound the send
+        hub's queue.  The sends are PROGRAM-ordered on one tile (no
+        round trips between them), so successive packets arrive inside
+        the hub's busy tail — measured per-packet hub delays 16, 32, ...
+        cycles — and the serial oracle must reproduce the compounding
+        exactly (still deterministic: one sender, program order)."""
+        sc = make_config(16, contention="true")
+        bs = [TraceBuilder() for _ in range(16)]
+        for r in range(6):
+            bs[0].send(15, 64)
+        for r in range(6):
+            bs[15].recv(0, 64)
+        res = self._assert_exact(sc, bs)
+        # vacuity guard: with contention off the completion must be
+        # strictly earlier (the queue delays above are real)
+        bs2 = [TraceBuilder() for _ in range(16)]
+        for r in range(6):
+            bs2[0].send(15, 64)
+        for r in range(6):
+            bs2[15].recv(0, 64)
+        r_off = run(make_config(16, contention="false"), bs2)
+        assert res.completion_time_ps > r_off.completion_time_ps
