@@ -117,6 +117,24 @@ class SimResults:
                 out.append("    Branch Predictor:")
                 out.append(f"      Num Correct: {int(self.bp_correct[t])}")
                 out.append(f"      Num Incorrect: {int(self.bp_incorrect[t])}")
+            if self.mem_counters is not None:
+                mc = self.mem_counters
+                out.append("  Cache Summary:")
+                out.append(f"    L1-I Misses: {int(mc['l1i_misses'][t])}")
+                out.append(
+                    "    L1-D Misses: "
+                    f"{int(mc['l1d_read_misses'][t] + mc['l1d_write_misses'][t])}")
+                out.append(f"    L2 Misses: {int(mc['l2_misses'][t])}")
+                # miss-type breakdown (`cache.cc outputSummary`, populated
+                # under `[l2_cache/<type>] track_miss_types`)
+                if int(mc["l2_cold_misses"][t] + mc["l2_capacity_misses"][t]
+                       + mc["l2_sharing_misses"][t]):
+                    out.append(
+                        f"      Cold Misses: {int(mc['l2_cold_misses'][t])}")
+                    out.append("      Capacity Misses: "
+                               f"{int(mc['l2_capacity_misses'][t])}")
+                    out.append("      Sharing Misses: "
+                               f"{int(mc['l2_sharing_misses'][t])}")
             out.append("  Network Summary (USER):")
             out.append(f"    Packets Sent: {int(self.packets_sent[t])}")
             out.append(f"    Packets Received: {int(self.packets_received[t])}")

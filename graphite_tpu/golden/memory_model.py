@@ -209,6 +209,12 @@ class GoldenMemory:
         else:
             self.net = None
         self.instr_buf = [-1] * T
+        # L2 miss-type tracking (`cache.cc getMissType`): three per-tile
+        # bucket sets (the model hashes lines to 2^16 buckets — the
+        # engine's bitmap spec, shared so the differential stays exact)
+        self.mt_fetched = [set() for _ in range(T)]
+        self.mt_evicted = [set() for _ in range(T)]
+        self.mt_invalidated = [set() for _ in range(T)]
         self.counters = {
             k: [0] * T
             for k in ("l1i_hits", "l1i_misses", "l1d_read_hits",
@@ -216,8 +222,48 @@ class GoldenMemory:
                       "l1d_write_misses", "l2_hits", "l2_misses",
                       "evictions", "invalidations", "dir_accesses",
                       "dir_broadcasts", "dram_reads", "dram_writes",
-                      "dram_total_lat_ps")
+                      "dram_total_lat_ps", "l2_cold_misses",
+                      "l2_capacity_misses", "l2_sharing_misses")
         }
+
+    # -- L2 miss-type tracking (`cache.h:45-49`, hashed-bucket model) ------
+
+    @staticmethod
+    def _mt_bucket(line):
+        return line & 0xFFFF
+
+    def _mt_classify(self, t, line, enabled):
+        if not self.mp.l2.track_miss_types or not enabled:
+            return
+        b = self._mt_bucket(line)
+        c = self.counters
+        if b in self.mt_evicted[t]:
+            c["l2_capacity_misses"][t] += 1
+        elif b in self.mt_invalidated[t] or b in self.mt_fetched[t]:
+            c["l2_sharing_misses"][t] += 1
+        else:
+            c["l2_cold_misses"][t] += 1
+
+    def _mt_invalidate(self, t, line):
+        if self.mp.l2.track_miss_types:
+            self.mt_invalidated[t].add(self._mt_bucket(line))
+
+    def _mt_evict(self, t, line):
+        if self.mp.l2.track_miss_types:
+            self.mt_evicted[t].add(self._mt_bucket(line))
+
+    def _mt_insert(self, t, line):
+        # clearMissTypeTrackingSets: erase from exactly ONE set
+        if not self.mp.l2.track_miss_types:
+            return
+        b = self._mt_bucket(line)
+        if b in self.mt_evicted[t]:
+            self.mt_evicted[t].discard(b)
+        elif b in self.mt_invalidated[t]:
+            self.mt_invalidated[t].discard(b)
+        else:
+            self.mt_fetched[t].discard(b)
+        self.mt_fetched[t].add(b)
 
     # -- timing helpers ----------------------------------------------------
 
@@ -334,6 +380,7 @@ class GoldenMemory:
             elif cloc == MOD_L1D:
                 self.l1d[s].invalidate(line)
             self.l2[s].set_state(line, way, INVALID)
+            self._mt_invalidate(s, line)
             self.l2_cloc[s].pop((line % self.l2[s].sets, way), None)
             if enabled and kind == "inv":
                 self.counters["invalidations"][s] += 1
@@ -642,9 +689,12 @@ class GoldenMemory:
         # upgrade: write to a readable-but-unwritable L2 line — invalidate
         # + eviction to the home, then a full EX refetch
         # (`processExReqFromL1Cache`; documented engine simplification)
+        # classification reads the sets BEFORE this access mutates them
+        self._mt_classify(t, line, enabled)
         if l2_hit and write and l2_st in (SHARED, OWNED):
             dirty = l2_st == OWNED
             l2.set_state(line, l2_way, INVALID)
+            self._mt_invalidate(t, line)
             self.l2_cloc[t].pop((line % self.l2[t].sets, l2_way), None)
             self._apply_eviction(
                 t, line, dirty,
@@ -662,6 +712,7 @@ class GoldenMemory:
         if v_valid:
             if enabled:
                 c["evictions"][t] += 1
+            self._mt_evict(t, v_line)
             v_dirty = v_state in (MODIFIED, OWNED)
             v_home = self._home_of(v_line)
             e_arr = self._net_arrive(
@@ -669,6 +720,7 @@ class GoldenMemory:
                 fill_l2, enabled)
             self.l2_cloc[t].pop((v_line % self.l2[t].sets, v_way), None)
             self._apply_eviction(t, v_line, v_dirty, e_arr, enabled)
+        self._mt_insert(t, line)
         l2.insert_at(line, v_way, new_state)
         self._fill_l1(t, is_icache, line, new_state, v_way)
         done = fill_l2 + l1_dat

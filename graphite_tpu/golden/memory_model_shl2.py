@@ -100,7 +100,8 @@ class GoldenShL2:
                       "l1d_write_misses", "l2_hits", "l2_misses",
                       "evictions", "invalidations", "dir_accesses",
                       "dir_broadcasts", "dram_reads", "dram_writes",
-                      "dram_total_lat_ps")
+                      "dram_total_lat_ps", "l2_cold_misses",
+                      "l2_capacity_misses", "l2_sharing_misses")
         }
 
     # -- timing helpers ----------------------------------------------------

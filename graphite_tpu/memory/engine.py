@@ -255,6 +255,35 @@ def mem_net_fanout(mp: MemParams, noc, send_hs, bits: int, t0_ps, enabled):
     return noc, arrival
 
 
+def _mt_bit(line):
+    """Hash bucket of a line in the miss-type bitmaps (MT_BITS buckets)."""
+    from graphite_tpu.memory.state import MT_BITS
+
+    h = (line.astype(jnp.uint32) & jnp.uint32(MT_BITS - 1))
+    return (h // 32).astype(jnp.int32), (h % 32).astype(jnp.uint32)
+
+
+def _mt_test(mt, row: int, line):
+    T = mt.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    w, b = _mt_bit(line)
+    return ((mt[tiles, row, w] >> b) & jnp.uint32(1)) != 0
+
+
+def _mt_update(mt, row: int, line, mask, set_bit_val: bool):
+    """Set or clear the line's bucket bit in bitmap `row` where mask
+    (delta-add scatter: per-lane rows are unique)."""
+    T = mt.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    w, b = _mt_bit(line)
+    cur = mt[tiles, row, w]
+    new = (cur | (jnp.uint32(1) << b)) if set_bit_val else (
+        cur & ~(jnp.uint32(1) << b))
+    return mt.at[tiles, row, w].add(
+        jnp.where(mask, new - cur, jnp.uint32(0)),
+        unique_indices=True, indices_are_sorted=True)
+
+
 @dataclasses.dataclass(frozen=True)
 class RecView:
     """Current trace record fields needed by the memory engine (all [T])."""
@@ -337,15 +366,23 @@ def mem_idle_out(mp: MemParams, ms, rec: "RecView", enabled) -> MemStepOut:
 
 
 # --------------------------------------------------------------------------
-# directory-entry helpers (operate on the [T, DS, DW] arrays per home lane)
+# directory-entry helpers (structured [T, DS, DW(, SW)] arrays — a flat
+# entry-major repack was built and measured 1.6x slower; see PERF.md
+# round-3 findings and the DirectoryArrays docstring).
+
+
+def _dir_row(d, sets):
+    """Gather one set's DW-entry row per home lane: ([T, DW] tags,
+    [T, DW] nsharers) — the two fields set-level decisions need."""
+    T = d.tags.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    return d.tags[tiles, sets], d.nsharers[tiles, sets]
 
 
 def _dir_lookup(mp: MemParams, d, line):
     """Per-home-lane directory set lookup: (set, found, way)."""
-    T = d.tags.shape[0]
-    tiles = jnp.arange(T, dtype=jnp.int32)
     sets = (line % mp.dir_sets).astype(jnp.int32)
-    tag_row = d.tags[tiles, sets]                     # [T, DW]
+    tag_row, _ = _dir_row(d, sets)
     way_hits = tag_row == line[:, None]
     found = way_hits.any(axis=1)
     way = jnp.argmax(way_hits, axis=1).astype(jnp.int32)
@@ -353,6 +390,7 @@ def _dir_lookup(mp: MemParams, d, line):
 
 
 def _dir_gather(d, sets, way):
+    """Gather one entry per home lane."""
     T = d.tags.shape[0]
     tiles = jnp.arange(T, dtype=jnp.int32)
     return (
@@ -364,16 +402,13 @@ def _dir_gather(d, sets, way):
     )
 
 
-def _dir_update(d, sets, way, mask, *, tags=None, dstate=None, owner=None,
-                sharers=None, nsharers=None):
+def _dir_update(d, sets, way, mask, *, tags=None,
+                dstate=None, owner=None, sharers=None, nsharers=None):
     """Masked per-lane write of one directory entry.
 
     Add-a-delta scatters (new = cur + (new - cur) under mask): per-lane
-    indices are unique (row = lane), so the add is exact, and the scatter
-    becomes the array's only remaining use — XLA then updates the
-    loop-carried directory buffers in place instead of materializing a
-    copy per write (measured ~0.4 ms per copy of the [T, DS, DW, SW]
-    sharers tensor at 256 tiles; several writes per iteration)."""
+    indices are unique (row = lane), so the add is exact and the scatter
+    can update the loop-carried buffers in place."""
     T = d.tags.shape[0]
     tiles = jnp.arange(T, dtype=jnp.int32)
     out = d
@@ -652,6 +687,27 @@ def memory_engine_step(
     # a busy evict cell (stall_start) retries `starting` every iteration
     # and must not re-count
     miss_go = l1_miss & ~stall_start
+    # L2 miss-type classification (`cache.cc getMissType` priority:
+    # evicted -> CAPACITY, else invalidated/fetched -> SHARING, else
+    # COLD), read BEFORE this access's own set updates
+    if mp.l2.track_miss_types:
+        from graphite_tpu.memory.state import (
+            MT_EVICTED, MT_FETCHED, MT_INVALIDATED,
+        )
+
+        cls = l2_miss_go & jnp.asarray(enabled, bool)
+        in_e = _mt_test(ms.mt, MT_EVICTED, s_line)
+        in_i = _mt_test(ms.mt, MT_INVALIDATED, s_line)
+        in_f = _mt_test(ms.mt, MT_FETCHED, s_line)
+        mt_cap = cls & in_e
+        mt_sha = cls & ~in_e & (in_i | in_f)
+        mt_cold = cls & ~in_e & ~in_i & ~in_f
+        # the upgrade's local L2 invalidate feeds the invalidated set
+        # (`setCacheLineInfo` INVALID transition)
+        new_mt = _mt_update(ms.mt, MT_INVALIDATED, s_line, up_go, True)
+        ms = ms.replace(mt=new_mt)
+    else:
+        mt_cap = mt_sha = mt_cold = jnp.zeros((T,), jnp.bool_)
     counters = ms.counters.replace(
         l1i_hits=ms.counters.l1i_hits
         + ((l1_hit_now | ibuf_hit) & s_comp_l1i & enabled).astype(I64),
@@ -667,6 +723,11 @@ def memory_engine_step(
         + (miss_go & ~s_comp_l1i & s_write & enabled).astype(I64),
         l2_hits=ms.counters.l2_hits + (l2_hit_now & enabled).astype(I64),
         l2_misses=ms.counters.l2_misses + (l2_miss_go & enabled).astype(I64),
+        l2_cold_misses=ms.counters.l2_cold_misses + mt_cold.astype(I64),
+        l2_capacity_misses=ms.counters.l2_capacity_misses
+        + mt_cap.astype(I64),
+        l2_sharing_misses=ms.counters.l2_sharing_misses
+        + mt_sha.astype(I64),
     )
     progress = progress + jnp.sum(slot_done_now | l2_miss_go, dtype=jnp.int32)
 
@@ -815,6 +876,11 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     l2_r = ca.row_invalidate(l2_r, fline, inv_l1)
     l2_r = ca.row_set_state(l2_r, l2_way, wb_state, wb_l1)
     l2 = ca.scatter_row(ms.l2, l2_r)
+    if mp.l2.track_miss_types:
+        from graphite_tpu.memory.state import MT_INVALIDATED
+
+        ms = ms.replace(mt=_mt_update(ms.mt, MT_INVALIDATED, fline,
+                                      inv_l1, True))
     cur_cloc = ms.l2_cloc[tiles, sets, l2_way]
     l2_cloc = ms.l2_cloc.at[tiles, sets, l2_way].add(
         jnp.where(inv_l1, -cur_cloc, jnp.zeros_like(cur_cloc)))
@@ -1101,12 +1167,11 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     d = ms.directory
     sets, dfound, way = _dir_lookup(mp, d, rline)
     # free way if no match (tags == -1)
-    tag_row = d.tags[tiles, sets]                          # [T, DW]
+    tag_row, nsh_row = _dir_row(d, sets)               # [T, DW] each
     free_ways = tag_row == -1
     any_free = free_ways.any(axis=1)
     free_way = jnp.argmax(free_ways, axis=1).astype(jnp.int32)
     # victim: min sharers (`processDirectoryEntryAllocationReq`)
-    nsh_row = d.nsharers[tiles, sets]
     victim_way = jnp.argmin(nsh_row, axis=1).astype(jnp.int32)
     alloc_way = jnp.where(dfound, way, jnp.where(any_free, free_way,
                                                  victim_way)).astype(jnp.int32)
@@ -1474,6 +1539,25 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
     cur_cloc2 = l2_cloc[tiles, ev_sets, ev_way]
     l2_cloc = l2_cloc.at[tiles, ev_sets, ev_way].add(
         jnp.where(l1_ev & ev_hit, -cur_cloc2, jnp.zeros_like(cur_cloc2)))
+
+    if mp.l2.track_miss_types:
+        from graphite_tpu.memory.state import (
+            MT_EVICTED, MT_FETCHED, MT_INVALIDATED,
+        )
+
+        mt = ms.mt
+        # victim -> evicted set (`insertCacheLine` eviction branch)
+        mt = _mt_update(mt, MT_EVICTED, v_line, evict_go, True)
+        # inserted line: clearMissTypeTrackingSets erases from exactly
+        # ONE set (evicted elif invalidated elif fetched), then the
+        # fetched set gains the line
+        e_in = _mt_test(mt, MT_EVICTED, line)
+        i_in = _mt_test(mt, MT_INVALIDATED, line)
+        mt = _mt_update(mt, MT_EVICTED, line, fill & e_in, False)
+        mt = _mt_update(mt, MT_INVALIDATED, line, fill & ~e_in & i_in,
+                        False)
+        mt = _mt_update(mt, MT_FETCHED, line, fill, True)
+        ms = ms.replace(mt=mt)
 
     req = ms.req.replace(
         phase=jnp.where(fill, PHASE_IDLE, ms.req.phase),

@@ -41,6 +41,11 @@ class CacheLevelParams:
     # `replacement_policy` (`carbon_sim.cfg:213`): lru | round_robin
     # (factory `CacheReplacementPolicy::create`)
     replacement: str = "lru"
+    # `num_banks` (`carbon_sim.cfg:212,223,234`): in the reference this
+    # knob has NO timing effect — its only consumer is the McPAT cache
+    # config (`mcpat_cache_interface.cc:226`); parsed and fed to the
+    # energy model accordingly
+    num_banks: int = 1
     # heterogeneous per-tile geometries (`misc/config.h:92-100` model_list
     # cache types): None = homogeneous; else int tuples of length T.  The
     # dense arrays are padded to the MAX geometry; per-tile set moduli and
@@ -168,6 +173,7 @@ class CacheLevelParams:
             track_miss_types=cfg.get_bool(f"{section}/track_miss_types", False),
             replacement=cfg.get_string(f"{section}/replacement_policy",
                                        "lru").strip(),
+            num_banks=cfg.get_int(f"{section}/num_banks", 1),
         )
 
 

@@ -69,7 +69,13 @@ MOD_NET_MEM = 5
 
 @struct.dataclass
 class DirectoryArrays:
-    """Per-home-slice directory cache (`cache/directory_cache.h:20-68`)."""
+    """Per-home-slice directory cache (`cache/directory_cache.h:20-68`).
+
+    Kept as structured [T, DS, DW(, SW)] arrays: a flat 2-D repack
+    (entry-major, large minor dim) was built and measured 1.6x SLOWER —
+    the computed-column gathers lower worse than structured indexing,
+    and the whole-array copies it targeted barely moved (PERF.md
+    round-3 findings)."""
 
     tags: jax.Array      # int32[T, DS, DW] line address, -1 = free
     dstate: jax.Array    # uint8[T, DS, DW]
@@ -159,6 +165,12 @@ class MemCounters:
     dram_reads: jax.Array
     dram_writes: jax.Array
     dram_total_lat_ps: jax.Array
+    # L2 miss-type classification (`cache.h:45-49` COLD/CAPACITY/SHARING;
+    # populated when `[l2_cache/<type>] track_miss_types` — private-L2
+    # engines only)
+    l2_cold_misses: jax.Array
+    l2_capacity_misses: jax.Array
+    l2_sharing_misses: jax.Array
 
 
 @struct.dataclass
@@ -180,6 +192,12 @@ class MemState:
     # per-port queue state of the MEMORY NoC when `[network] memory =
     # emesh_hop_by_hop` (models/network_hop_by_hop.NocState), else None
     noc: "object" = None
+    # L2 miss-type tracking bitmaps, uint32[T, 3, MT_WORDS] (rows:
+    # fetched / evicted / invalidated — the reference's three address
+    # sets, `cache.cc getMissType`, hashed to MT_BITS buckets per tile;
+    # bucket collisions are a documented approximation shared with the
+    # oracle).  None when track_miss_types is off.
+    mt: "object" = None
 
 
 def init_mem_common(mp: MemParams) -> dict:
@@ -227,6 +245,8 @@ def init_mem_common(mp: MemParams) -> dict:
         dir_accesses=zi64(), dir_broadcasts=zi64(),
         dram_reads=zi64(), dram_writes=zi64(),
         dram_total_lat_ps=zi64(),
+        l2_cold_misses=zi64(), l2_capacity_misses=zi64(),
+        l2_sharing_misses=zi64(),
     )
     return dict(
         l1i=make_cache(T, mp.l1i.num_sets, mp.l1i.num_ways),
@@ -239,6 +259,12 @@ def init_mem_common(mp: MemParams) -> dict:
         func_mem=jnp.zeros(max(mp.func_mem_words, 1) + 1, jnp.uint32),
         func_errors=jnp.zeros((), I64),
     )
+
+
+# miss-type tracking hash space: 2^16 buckets = 2048 uint32 words/set
+MT_BITS = 1 << 16
+MT_WORDS = MT_BITS // 32
+MT_FETCHED, MT_EVICTED, MT_INVALIDATED = 0, 1, 2
 
 
 def init_mem_state(mp: MemParams) -> MemState:
@@ -274,10 +300,13 @@ def init_mem_state(mp: MemParams) -> MemState:
         cdata_line=jnp.full(T, -1, jnp.int32),
         cdata_valid=jnp.zeros(T, jnp.bool_),
     )
+    mt = (jnp.zeros((T, 3, MT_WORDS), jnp.uint32)
+          if mp.l2.track_miss_types else None)
     return MemState(
         l2_cloc=jnp.zeros((T, mp.l2.num_sets, mp.l2.num_ways), jnp.uint8),
         directory=directory,
         txn=txn,
         live=jnp.zeros((), jnp.bool_),
+        mt=mt,
         **init_mem_common(mp),
     )

@@ -90,8 +90,13 @@ class McPATCacheInterface:
     """Per-cache-structure energy (`mcpat_cache_interface.h:22-72`)."""
 
     def __init__(self, node_nm: int, size_bytes: int, associativity: int,
-                 line_bytes: int = 64, ports: int = 1):
-        self._args = (node_nm, size_bytes, associativity, line_bytes, ports)
+                 line_bytes: int = 64, ports: int = 1, num_banks: int = 1):
+        # num_banks mirrors the reference's only use of the knob — the
+        # McPAT cache config (`mcpat_cache_interface.cc:226`): banked
+        # arrays split the bitline/wordline energy per access
+        self._args = (node_nm, max(size_bytes // max(num_banks, 1), 1024),
+                      associativity, line_bytes, ports)
+        self._num_banks = max(num_banks, 1)
         self._cache: dict = {}   # per-voltage operating points
 
     def at_voltage(self, voltage: float) -> _SramOut:
@@ -104,7 +109,7 @@ class McPATCacheInterface:
         return self._cache[voltage]
 
     def area_mm2(self, voltage: float = 1.0) -> float:
-        return self.at_voltage(voltage).area_mm2
+        return self.at_voltage(voltage).area_mm2 * self._num_banks
 
     def dynamic_energy_j(self, voltage: float, reads: int, writes: int,
                          tag_lookups: int = 0) -> float:
@@ -113,7 +118,9 @@ class McPATCacheInterface:
                 + tag_lookups * o.tag_energy_j)
 
     def leakage_energy_j(self, voltage: float, seconds: float) -> float:
-        return self.at_voltage(voltage).leakage_power_w * seconds
+        # all banks leak; dynamic energy is per-access in ONE bank
+        return (self.at_voltage(voltage).leakage_power_w
+                * self._num_banks * seconds)
 
 
 class McPATCoreInterface:
@@ -214,7 +221,7 @@ class TileEnergyMonitor:
     def _cache_if(self, lvl, line):
         return McPATCacheInterface(
             self.node_nm, lvl.num_sets * lvl.num_ways * line,
-            lvl.num_ways, line)
+            lvl.num_ways, line, num_banks=lvl.num_banks)
 
     def tile_energy_j(self, tile: int, voltage: float = 1.0) -> dict:
         r = self.results

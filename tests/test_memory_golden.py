@@ -387,3 +387,59 @@ def test_shl2_slice_nullify_exact(proto):
         bs[0].store(0x800000 + i * 2 * 64 * 64, 8)
         bs[0].mutex_unlock(0)
     assert_exact(sc, TraceBatch.from_builders(bs))
+
+
+# ---- L2 miss-type classification (`cache.h:45-49`) ------------------------
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_miss_type_classification(proto):
+    """COLD / CAPACITY / SHARING classification (`cache.cc getMissType`:
+    evicted-set -> capacity, invalidated/fetched-set -> sharing, else
+    cold), hashed-bucket model shared engine<->oracle.  A tiny L2 forces
+    capacity re-misses; a writer invalidating a reader forces sharing
+    misses; first touches are cold."""
+    extra = ("[l2_cache/T1]\ncache_size = 4\nassociativity = 1\n"
+             "track_miss_types = true\n")
+    sc = make_config(2, proto, extra=extra)
+    bs = [TraceBuilder() for _ in range(2)]
+    bs[0].mutex_init(0)
+    bs[0].barrier_init(9, 2)
+    for b in bs:
+        b.barrier_wait(9)
+    # capacity: tile 0 streams lines that collide in the 1-way sets,
+    # then re-touches them (evicted-set hits)
+    for rep in range(2):
+        for i in range(4):
+            bs[0].mutex_lock(0)
+            bs[0].load(0x100000 + i * 64 * 64, 8)
+            bs[0].mutex_unlock(0)
+    # sharing: tile 1 reads a line, tile 0 writes it (INV), tile 1
+    # re-reads (invalidated-set hit)
+    for b in bs:
+        b.barrier_wait(9)
+    for rep in range(3):
+        bs[1].mutex_lock(0)
+        bs[1].load(0x900000, 8)
+        bs[1].mutex_unlock(0)
+        for b in bs:
+            b.barrier_wait(9)
+        bs[0].mutex_lock(0)
+        bs[0].store(0x900000, 8)
+        bs[0].mutex_unlock(0)
+        for b in bs:
+            b.barrier_wait(9)
+    res, gold = assert_exact(sc, TraceBatch.from_builders(bs))
+    for k in ("l2_cold_misses", "l2_capacity_misses", "l2_sharing_misses"):
+        assert int(gold.mem_counters[k].sum()) > 0, k
+    # every classified miss is accounted exactly once
+    total = sum(int(gold.mem_counters[k].sum())
+                for k in ("l2_cold_misses", "l2_capacity_misses",
+                          "l2_sharing_misses"))
+    assert total == int(gold.mem_counters["l2_misses"].sum())
+
+
+def test_miss_types_off_by_default():
+    sc = make_config(2, MSI)
+    res, _ = assert_exact(sc, mutex_rmw(2, 3))
+    assert int(np.asarray(res.mem_counters["l2_cold_misses"]).sum()) == 0

@@ -106,3 +106,19 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+def test_cache_banks_knob():
+    """num_banks (`carbon_sim.cfg:212,223,234`) — the reference's only
+    consumer is the McPAT cache config: banked arrays pay per-bank
+    dynamic energy but ALL banks leak (and occupy area)."""
+    from graphite_tpu.power.interface import McPATCacheInterface
+
+    one = McPATCacheInterface(45, 512 * 1024, 8, 64)
+    four = McPATCacheInterface(45, 512 * 1024, 8, 64, num_banks=4)
+    # per-access dynamic energy shrinks with bank size
+    assert four.dynamic_energy_j(1.0, 1000, 0) < one.dynamic_energy_j(
+        1.0, 1000, 0)
+    # total leakage and area do not (every bank leaks)
+    assert four.leakage_energy_j(1.0, 1.0) > 0.5 * one.leakage_energy_j(
+        1.0, 1.0)
