@@ -1,6 +1,6 @@
 """Test configuration: force an 8-device virtual CPU platform.
 
-Multi-chip sharding (shard_map over a Mesh) is tested on 8 virtual CPU
+Multi-chip sharding (GSPMD specs over a Mesh) is tested on 8 virtual CPU
 devices since only one real TPU chip is available; the driver separately
 dry-runs the multi-chip path via __graft_entry__.dryrun_multichip.
 Must run before jax initializes its backends, hence env vars here.
