@@ -492,253 +492,268 @@ def memory_engine_step(
         return next_present_slot(present, slot)
 
     # ======================================================================
-    # (1) requester slot starts (app-thread L1/L2 path)
+    # (1) requester slot starts (app-thread L1/L2 path) — unrolled
+    # mp.requester_unroll times per engine iteration: records whose
+    # next slots HIT the L1 complete several slots per iteration (the
+    # repeat is ~15 cheap L1/L2-row kernels vs a whole extra engine
+    # iteration per slot).  A lane that misses sets PHASE_WAIT_REPLY
+    # and later repeats are no-ops for it; within-iteration repeats
+    # see no intervening protocol messages — the serialization the
+    # golden oracle itself uses (whole records at once).
     # ======================================================================
-    slot = next_present(ms.req.slot)
-    has_slot = slot < 3
-    idle = ms.req.phase == PHASE_IDLE
-    starting = active & idle & has_slot
+    def _requester_once(ms, progress):
+        # ======================================================================
+        # (1) requester slot starts (app-thread L1/L2 path)
+        # ======================================================================
+        slot = next_present(ms.req.slot)
+        has_slot = slot < 3
+        idle = ms.req.phase == PHASE_IDLE
+        starting = active & idle & has_slot
 
-    # slot attributes
-    s_is_icache = slot == 0
-    s_addr = jnp.where(
-        s_is_icache, rec.pc.astype(jnp.int32),
-        jnp.where(slot == 1, rec.addr0.astype(jnp.int32),
-                  rec.addr1.astype(jnp.int32)))
-    s_line = (s_addr.astype(jnp.uint32) >> mp.line_bits).astype(jnp.int32)
-    s_write = jnp.where(
-        s_is_icache, False,
-        jnp.where(slot == 1, (flags & FLAG_MEM0_WRITE) != 0,
-                  (flags & FLAG_MEM1_WRITE) != 0))
-    s_comp_l1i = s_is_icache
+        # slot attributes
+        s_is_icache = slot == 0
+        s_addr = jnp.where(
+            s_is_icache, rec.pc.astype(jnp.int32),
+            jnp.where(slot == 1, rec.addr0.astype(jnp.int32),
+                      rec.addr1.astype(jnp.int32)))
+        s_line = (s_addr.astype(jnp.uint32) >> mp.line_bits).astype(jnp.int32)
+        s_write = jnp.where(
+            s_is_icache, False,
+            jnp.where(slot == 1, (flags & FLAG_MEM0_WRITE) != 0,
+                      (flags & FLAG_MEM1_WRITE) != 0))
+        s_comp_l1i = s_is_icache
 
-    # instruction-buffer fast path (`core.cc:205-220`): hit = 1 cycle
-    ibuf_hit = starting & s_is_icache & (s_line == ms.req.instr_buf)
-    new_instr_buf = jnp.where(starting & s_is_icache, s_line, ms.req.instr_buf)
+        # instruction-buffer fast path (`core.cc:205-220`): hit = 1 cycle
+        ibuf_hit = starting & s_is_icache & (s_line == ms.req.instr_buf)
+        new_instr_buf = jnp.where(starting & s_is_icache, s_line, ms.req.instr_buf)
 
-    # L1 lookups (both caches, masked by component) — each lane's set rows
-    # are gathered ONCE per cache level here and scattered back once below
-    # (the engine is op-count-bound; see cache_array.py)
-    l1i_row = ca.gather_row(ms.l1i, s_line, mp.l1i.sets_mod)
-    l1d_row = ca.gather_row(ms.l1d, s_line, mp.l1d.sets_mod)
-    l2_row = ca.gather_row(ms.l2, s_line, mp.l2.sets_mod)
-    l1i_hit, l1i_way, l1i_state = ca.row_lookup(l1i_row, s_line)
-    l1d_hit, l1d_way, l1d_state = ca.row_lookup(l1d_row, s_line)
-    l1_state = jnp.where(s_comp_l1i, l1i_state, l1d_state)
-    l1_permit = jnp.where(s_write, state_writable(l1_state),
-                          state_readable(l1_state))
-    do_l1 = starting & ~ibuf_hit
+        # L1 lookups (both caches, masked by component) — each lane's set rows
+        # are gathered ONCE per cache level here and scattered back once below
+        # (the engine is op-count-bound; see cache_array.py)
+        l1i_row = ca.gather_row(ms.l1i, s_line, mp.l1i.sets_mod)
+        l1d_row = ca.gather_row(ms.l1d, s_line, mp.l1d.sets_mod)
+        l2_row = ca.gather_row(ms.l2, s_line, mp.l2.sets_mod)
+        l1i_hit, l1i_way, l1i_state = ca.row_lookup(l1i_row, s_line)
+        l1d_hit, l1d_way, l1d_state = ca.row_lookup(l1d_row, s_line)
+        l1_state = jnp.where(s_comp_l1i, l1i_state, l1d_state)
+        l1_permit = jnp.where(s_write, state_writable(l1_state),
+                              state_readable(l1_state))
+        do_l1 = starting & ~ibuf_hit
 
-    sync_core = jnp.where(s_comp_l1i, sync_core_l1i, sync_core_l1d)
-    l1_dat = jnp.where(
-        s_comp_l1i, ccycles(mp.l1i.data_and_tags_cycles),
-        ccycles(mp.l1d.data_and_tags_cycles))
-    l1_tag = jnp.where(
-        s_comp_l1i, ccycles(mp.l1i.tags_cycles), ccycles(mp.l1d.tags_cycles))
-    sync_l1_l2 = jnp.where(s_comp_l1i, sync_l1i_l2, sync_l1d_l2)
+        sync_core = jnp.where(s_comp_l1i, sync_core_l1i, sync_core_l1d)
+        l1_dat = jnp.where(
+            s_comp_l1i, ccycles(mp.l1i.data_and_tags_cycles),
+            ccycles(mp.l1d.data_and_tags_cycles))
+        l1_tag = jnp.where(
+            s_comp_l1i, ccycles(mp.l1i.tags_cycles), ccycles(mp.l1d.tags_cycles))
+        sync_l1_l2 = jnp.where(s_comp_l1i, sync_l1i_l2, sync_l1d_l2)
 
-    l1_hit_now = do_l1 & l1_permit
-    l1_miss = do_l1 & ~l1_permit
+        l1_hit_now = do_l1 & l1_permit
+        l1_miss = do_l1 & ~l1_permit
 
-    # L2 lookup for L1 misses
-    l2_hit, l2_way, l2_state = ca.row_lookup(l2_row, s_line)
-    l2_permit = jnp.where(s_write, state_writable(l2_state),
-                          state_readable(l2_state))
-    l2_hit_now = l1_miss & l2_permit
-    l2_miss = l1_miss & ~l2_permit
+        # L2 lookup for L1 misses
+        l2_hit, l2_way, l2_state = ca.row_lookup(l2_row, s_line)
+        l2_permit = jnp.where(s_write, state_writable(l2_state),
+                              state_readable(l2_state))
+        l2_hit_now = l1_miss & l2_permit
+        l2_miss = l1_miss & ~l2_permit
 
-    # upgrade (write to a readable-but-not-writable L2 line): invalidate L2
-    # + eviction message to home, then a full EX_REQ refetch
-    # (`l2_cache_cntlr.cc:261-282 processExReqFromL1Cache`; documented
-    # simplification: the reference's UPGRADE_REP without data is modeled
-    # as a refetch, same message count, slightly larger data serialization).
-    # MOSI: an OWNED line is dirty, so its upgrade eviction must FLUSH.
-    upgrade = l2_miss & s_write & (
-        (l2_state == SHARED) | (l2_state == OWNED))
-    upgrade_dirty = upgrade & (l2_state == OWNED)
-    s_home = home_of(s_line)
-    evict_cell_busy = ms.mail.evict_type[s_home, tiles] != MSG_NONE
-    stall_start = upgrade & evict_cell_busy
-    l2_miss_go = l2_miss & ~stall_start
+        # upgrade (write to a readable-but-not-writable L2 line): invalidate L2
+        # + eviction message to home, then a full EX_REQ refetch
+        # (`l2_cache_cntlr.cc:261-282 processExReqFromL1Cache`; documented
+        # simplification: the reference's UPGRADE_REP without data is modeled
+        # as a refetch, same message count, slightly larger data serialization).
+        # MOSI: an OWNED line is dirty, so its upgrade eviction must FLUSH.
+        upgrade = l2_miss & s_write & (
+            (l2_state == SHARED) | (l2_state == OWNED))
+        upgrade_dirty = upgrade & (l2_state == OWNED)
+        s_home = home_of(s_line)
+        evict_cell_busy = ms.mail.evict_type[s_home, tiles] != MSG_NONE
+        stall_start = upgrade & evict_cell_busy
+        l2_miss_go = l2_miss & ~stall_start
 
-    # --- apply the L1-hit path -------------------------------------------
-    sclock = clock_ps + sync_core           # processMemOpFromCore entry
-    l1_hit_done_ps = sclock + l1_dat
+        # --- apply the L1-hit path -------------------------------------------
+        sclock = clock_ps + sync_core           # processMemOpFromCore entry
+        l1_hit_done_ps = sclock + l1_dat
 
-    # hits refresh recency under LRU; round_robin's update is a no-op
-    if mp.l1i.replacement != "round_robin":
-        l1i_row = ca.row_touch(l1i_row, l1i_way, l1_hit_now & s_comp_l1i)
-    if mp.l1d.replacement != "round_robin":
-        l1d_row = ca.row_touch(l1d_row, l1d_way, l1_hit_now & ~s_comp_l1i)
+        # hits refresh recency under LRU; round_robin's update is a no-op
+        if mp.l1i.replacement != "round_robin":
+            l1i_row = ca.row_touch(l1i_row, l1i_way, l1_hit_now & s_comp_l1i)
+        if mp.l1d.replacement != "round_robin":
+            l1d_row = ca.row_touch(l1d_row, l1d_way, l1_hit_now & ~s_comp_l1i)
 
-    # L1 line invalidated on miss before L2 is consulted
-    # (`l1_cache_cntlr.cc:137`) — must precede the L2-hit fill below, so
-    # the fill lands in the just-freed way and survives
-    l1i_row = ca.row_invalidate(l1i_row, s_line, l1_miss & s_comp_l1i)
-    l1d_row = ca.row_invalidate(l1d_row, s_line, l1_miss & ~s_comp_l1i)
+        # L1 line invalidated on miss before L2 is consulted
+        # (`l1_cache_cntlr.cc:137`) — must precede the L2-hit fill below, so
+        # the fill lands in the just-freed way and survives
+        l1i_row = ca.row_invalidate(l1i_row, s_line, l1_miss & s_comp_l1i)
+        l1d_row = ca.row_invalidate(l1d_row, s_line, l1_miss & ~s_comp_l1i)
 
-    # --- apply the L2-hit path (fill L1 from L2) -------------------------
-    # timing: L1 tags (miss) + L2 sync + L2 data+tags + L1 data+tags
-    l2_hit_done_ps = sclock + l1_tag + sync_l1_l2 + ccycles(
-        mp.l2.data_and_tags_cycles) + l1_dat
-    # L1 fill state = L2 state (`insertCacheLineInL1`)
-    fill_l1i = l2_hit_now & s_comp_l1i
-    fill_l1d = l2_hit_now & ~s_comp_l1i
+        # --- apply the L2-hit path (fill L1 from L2) -------------------------
+        # timing: L1 tags (miss) + L2 sync + L2 data+tags + L1 data+tags
+        l2_hit_done_ps = sclock + l1_tag + sync_l1_l2 + ccycles(
+            mp.l2.data_and_tags_cycles) + l1_dat
+        # L1 fill state = L2 state (`insertCacheLineInL1`)
+        fill_l1i = l2_hit_now & s_comp_l1i
+        fill_l1d = l2_hit_now & ~s_comp_l1i
 
-    def l1_fill(row, mask, st, policy, ways):
-        way, v_valid, v_line, _ = ca.row_pick_victim(row, policy, ways)
-        out = ca.row_insert(row, s_line, way, st, mask)
-        return out, way, v_valid & mask, v_line
+        def l1_fill(row, mask, st, policy, ways):
+            way, v_valid, v_line, _ = ca.row_pick_victim(row, policy, ways)
+            out = ca.row_insert(row, s_line, way, st, mask)
+            return out, way, v_valid & mask, v_line
 
-    l1i_row, _, l1i_ev, l1i_ev_line = l1_fill(
-        l1i_row, fill_l1i, l2_state, mp.l1i.replacement,
-        mp.l1i.ways_limit)
-    l1d_row, _, l1d_ev, l1d_ev_line = l1_fill(
-        l1d_row, fill_l1d, l2_state, mp.l1d.replacement,
-        mp.l1d.ways_limit)
-    # L1 victims: clear their cached-loc in L2 (line stays valid in L2)
-    l1_ev = l1i_ev | l1d_ev
-    l1_ev_line = jnp.where(l1i_ev, l1i_ev_line, l1d_ev_line)
-    ev_hit, ev_way, _ = ca.lookup(ms.l2, l1_ev_line, mp.l2.sets_mod)
-    ev_sets = (l1_ev_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-    cur_cloc = ms.l2_cloc[tiles, ev_sets, ev_way]
-    l2_cloc = ms.l2_cloc.at[tiles, ev_sets, ev_way].add(
-        jnp.where(l1_ev & ev_hit, -cur_cloc, jnp.zeros_like(cur_cloc)))
-    # record new cached-loc for the filled line
-    f_sets = (s_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-    new_cloc = jnp.where(s_comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8)
-    cur_cloc = l2_cloc[tiles, f_sets, l2_way]
-    l2_cloc = l2_cloc.at[tiles, f_sets, l2_way].add(
-        jnp.where(l2_hit_now, new_cloc - cur_cloc, jnp.zeros_like(cur_cloc)))
-    if mp.l2.replacement != "round_robin":
-        l2_row = ca.row_touch(l2_row, l2_way, l2_hit_now)
+        l1i_row, _, l1i_ev, l1i_ev_line = l1_fill(
+            l1i_row, fill_l1i, l2_state, mp.l1i.replacement,
+            mp.l1i.ways_limit)
+        l1d_row, _, l1d_ev, l1d_ev_line = l1_fill(
+            l1d_row, fill_l1d, l2_state, mp.l1d.replacement,
+            mp.l1d.ways_limit)
+        # L1 victims: clear their cached-loc in L2 (line stays valid in L2)
+        l1_ev = l1i_ev | l1d_ev
+        l1_ev_line = jnp.where(l1i_ev, l1i_ev_line, l1d_ev_line)
+        ev_hit, ev_way, _ = ca.lookup(ms.l2, l1_ev_line, mp.l2.sets_mod)
+        ev_sets = (l1_ev_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
+        cur_cloc = ms.l2_cloc[tiles, ev_sets, ev_way]
+        l2_cloc = ms.l2_cloc.at[tiles, ev_sets, ev_way].add(
+            jnp.where(l1_ev & ev_hit, -cur_cloc, jnp.zeros_like(cur_cloc)))
+        # record new cached-loc for the filled line
+        f_sets = (s_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
+        new_cloc = jnp.where(s_comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8)
+        cur_cloc = l2_cloc[tiles, f_sets, l2_way]
+        l2_cloc = l2_cloc.at[tiles, f_sets, l2_way].add(
+            jnp.where(l2_hit_now, new_cloc - cur_cloc, jnp.zeros_like(cur_cloc)))
+        if mp.l2.replacement != "round_robin":
+            l2_row = ca.row_touch(l2_row, l2_way, l2_hit_now)
 
-    # --- apply the L2-miss path (send request) ---------------------------
-    # `processExReqFromL1Cache`/`processShReqFromL1Cache`: request time =
-    # entry sync + L1 tags + L2 tags
-    req_send_ps = sclock + l1_tag + ccycles(mp.l2.tags_cycles)
-    # upgrade: invalidate L2 + eviction message (INV_REP clean, FLUSH_REP
-    # for a dirty OWNED line)
-    up_go = upgrade & ~stall_start
-    l2_row = ca.row_invalidate(l2_row, s_line, up_go)
-    # scatter the three set rows back — ONE scatter per cache level
-    l1i_upd = ca.scatter_row(ms.l1i, l1i_row)
-    l1d_upd = ca.scatter_row(ms.l1d, l1d_row)
-    l2_upd = ca.scatter_row(ms.l2, l2_row)
-    mail = ms.mail
-    noc = ms.noc
-    up_msg = jnp.where(upgrade_dirty, MSG_FLUSH_REP,
-                       MSG_INV_REP).astype(jnp.uint8)
-    w_home = jnp.where(up_go, s_home, 0)
-    noc, up_arrival = mem_net_send(
-        mp, noc, tiles, s_home, mp.req_bits, req_send_ps, up_go, enabled)
-    mail = mail.replace(
-        evict_type=mail.evict_type.at[w_home, tiles].set(
-            jnp.where(up_go, up_msg, mail.evict_type[w_home, tiles])),
-        evict_line=mail.evict_line.at[w_home, tiles].set(
-            jnp.where(up_go, s_line, mail.evict_line[w_home, tiles])),
-        evict_time=mail.evict_time.at[w_home, tiles].set(
-            jnp.where(up_go, up_arrival,
-                      mail.evict_time[w_home, tiles])),
-    )
-    rq_type = jnp.where(s_write, MSG_EX_REQ, MSG_SH_REQ).astype(jnp.uint8)
-    rq_home = jnp.where(l2_miss_go, s_home, 0)
-    noc, rq_arrival = mem_net_send(
-        mp, noc, tiles, s_home, mp.req_bits, req_send_ps, l2_miss_go,
-        enabled)
-    mail = mail.replace(
-        req_type=mail.req_type.at[rq_home, tiles].set(
-            jnp.where(l2_miss_go, rq_type, mail.req_type[rq_home, tiles])),
-        req_line=mail.req_line.at[rq_home, tiles].set(
-            jnp.where(l2_miss_go, s_line, mail.req_line[rq_home, tiles])),
-        req_time=mail.req_time.at[rq_home, tiles].set(
-            jnp.where(l2_miss_go, rq_arrival, mail.req_time[rq_home, tiles])),
-    )
-
-    # --- requester bookkeeping for this iteration's starts ----------------
-    slot_done_now = ibuf_hit | l1_hit_now | l2_hit_now
-    slot_done_ps = jnp.where(
-        ibuf_hit, clock_ps + ccycles(1),
-        jnp.where(l1_hit_now, l1_hit_done_ps, l2_hit_done_ps))
-
-    req_state = ms.req.replace(
-        phase=jnp.where(l2_miss_go, PHASE_WAIT_REPLY, ms.req.phase),
-        line=jnp.where(l2_miss_go, s_line, ms.req.line),
-        is_write=jnp.where(l2_miss_go, s_write, ms.req.is_write),
-        component=jnp.where(
-            l2_miss_go, jnp.where(s_comp_l1i, MOD_L1I, MOD_L1D),
-            ms.req.component).astype(jnp.uint8),
-        clock_ps=jnp.where(l2_miss_go, req_send_ps, ms.req.clock_ps),
-        acc_ps=ms.req.acc_ps
-        + jnp.where(slot_done_now, slot_done_ps - clock_ps, 0),
-        # per-slot latency for the iocoom operand algebra
-        slot_lat_ps=jnp.where(
-            (slot_done_now[:, None]
-             & (jnp.arange(3)[None, :] == slot[:, None])),
-            (slot_done_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
-        instr_buf=new_instr_buf,
-        # slot advances on completion; on miss it stays (the reply path
-        # advances it); skipped-over absent slots jump to the live one
-        slot=jnp.where(slot_done_now, slot + 1,
-                       jnp.where(starting, slot, ms.req.slot)),
-    )
-
-    # count misses only when the miss actually proceeds: a lane stalled on
-    # a busy evict cell (stall_start) retries `starting` every iteration
-    # and must not re-count
-    miss_go = l1_miss & ~stall_start
-    # L2 miss-type classification (`cache.cc getMissType` priority:
-    # evicted -> CAPACITY, else invalidated/fetched -> SHARING, else
-    # COLD), read BEFORE this access's own set updates
-    if mp.l2.track_miss_types:
-        from graphite_tpu.memory.state import (
-            MT_EVICTED, MT_FETCHED, MT_INVALIDATED,
+        # --- apply the L2-miss path (send request) ---------------------------
+        # `processExReqFromL1Cache`/`processShReqFromL1Cache`: request time =
+        # entry sync + L1 tags + L2 tags
+        req_send_ps = sclock + l1_tag + ccycles(mp.l2.tags_cycles)
+        # upgrade: invalidate L2 + eviction message (INV_REP clean, FLUSH_REP
+        # for a dirty OWNED line)
+        up_go = upgrade & ~stall_start
+        l2_row = ca.row_invalidate(l2_row, s_line, up_go)
+        # scatter the three set rows back — ONE scatter per cache level
+        l1i_upd = ca.scatter_row(ms.l1i, l1i_row)
+        l1d_upd = ca.scatter_row(ms.l1d, l1d_row)
+        l2_upd = ca.scatter_row(ms.l2, l2_row)
+        mail = ms.mail
+        noc = ms.noc
+        up_msg = jnp.where(upgrade_dirty, MSG_FLUSH_REP,
+                           MSG_INV_REP).astype(jnp.uint8)
+        w_home = jnp.where(up_go, s_home, 0)
+        noc, up_arrival = mem_net_send(
+            mp, noc, tiles, s_home, mp.req_bits, req_send_ps, up_go, enabled)
+        mail = mail.replace(
+            evict_type=mail.evict_type.at[w_home, tiles].set(
+                jnp.where(up_go, up_msg, mail.evict_type[w_home, tiles])),
+            evict_line=mail.evict_line.at[w_home, tiles].set(
+                jnp.where(up_go, s_line, mail.evict_line[w_home, tiles])),
+            evict_time=mail.evict_time.at[w_home, tiles].set(
+                jnp.where(up_go, up_arrival,
+                          mail.evict_time[w_home, tiles])),
+        )
+        rq_type = jnp.where(s_write, MSG_EX_REQ, MSG_SH_REQ).astype(jnp.uint8)
+        rq_home = jnp.where(l2_miss_go, s_home, 0)
+        noc, rq_arrival = mem_net_send(
+            mp, noc, tiles, s_home, mp.req_bits, req_send_ps, l2_miss_go,
+            enabled)
+        mail = mail.replace(
+            req_type=mail.req_type.at[rq_home, tiles].set(
+                jnp.where(l2_miss_go, rq_type, mail.req_type[rq_home, tiles])),
+            req_line=mail.req_line.at[rq_home, tiles].set(
+                jnp.where(l2_miss_go, s_line, mail.req_line[rq_home, tiles])),
+            req_time=mail.req_time.at[rq_home, tiles].set(
+                jnp.where(l2_miss_go, rq_arrival, mail.req_time[rq_home, tiles])),
         )
 
-        cls = l2_miss_go & jnp.asarray(enabled, bool)
-        in_e = _mt_test(ms.mt, MT_EVICTED, s_line)
-        in_i = _mt_test(ms.mt, MT_INVALIDATED, s_line)
-        in_f = _mt_test(ms.mt, MT_FETCHED, s_line)
-        mt_cap = cls & in_e
-        mt_sha = cls & ~in_e & (in_i | in_f)
-        mt_cold = cls & ~in_e & ~in_i & ~in_f
-        # the upgrade's local L2 invalidate feeds the invalidated set
-        # (`setCacheLineInfo` INVALID transition)
-        new_mt = _mt_update(ms.mt, MT_INVALIDATED, s_line, up_go, True)
-        ms = ms.replace(mt=new_mt)
-    else:
-        mt_cap = mt_sha = mt_cold = jnp.zeros((T,), jnp.bool_)
-    counters = ms.counters.replace(
-        l1i_hits=ms.counters.l1i_hits
-        + ((l1_hit_now | ibuf_hit) & s_comp_l1i & enabled).astype(I64),
-        l1i_misses=ms.counters.l1i_misses
-        + (miss_go & s_comp_l1i & enabled).astype(I64),
-        l1d_read_hits=ms.counters.l1d_read_hits
-        + (l1_hit_now & ~s_comp_l1i & ~s_write & enabled).astype(I64),
-        l1d_read_misses=ms.counters.l1d_read_misses
-        + (miss_go & ~s_comp_l1i & ~s_write & enabled).astype(I64),
-        l1d_write_hits=ms.counters.l1d_write_hits
-        + (l1_hit_now & ~s_comp_l1i & s_write & enabled).astype(I64),
-        l1d_write_misses=ms.counters.l1d_write_misses
-        + (miss_go & ~s_comp_l1i & s_write & enabled).astype(I64),
-        l2_hits=ms.counters.l2_hits + (l2_hit_now & enabled).astype(I64),
-        l2_misses=ms.counters.l2_misses + (l2_miss_go & enabled).astype(I64),
-        l2_cold_misses=ms.counters.l2_cold_misses + mt_cold.astype(I64),
-        l2_capacity_misses=ms.counters.l2_capacity_misses
-        + mt_cap.astype(I64),
-        l2_sharing_misses=ms.counters.l2_sharing_misses
-        + mt_sha.astype(I64),
-    )
-    progress = progress + jnp.sum(slot_done_now | l2_miss_go, dtype=jnp.int32)
+        # --- requester bookkeeping for this iteration's starts ----------------
+        slot_done_now = ibuf_hit | l1_hit_now | l2_hit_now
+        slot_done_ps = jnp.where(
+            ibuf_hit, clock_ps + ccycles(1),
+            jnp.where(l1_hit_now, l1_hit_done_ps, l2_hit_done_ps))
 
-    ms = ms.replace(
-        l1i=l1i_upd, l1d=l1d_upd, l2=l2_upd, l2_cloc=l2_cloc,
-        mail=mail, req=req_state, counters=counters, noc=noc,
-    )
+        req_state = ms.req.replace(
+            phase=jnp.where(l2_miss_go, PHASE_WAIT_REPLY, ms.req.phase),
+            line=jnp.where(l2_miss_go, s_line, ms.req.line),
+            is_write=jnp.where(l2_miss_go, s_write, ms.req.is_write),
+            component=jnp.where(
+                l2_miss_go, jnp.where(s_comp_l1i, MOD_L1I, MOD_L1D),
+                ms.req.component).astype(jnp.uint8),
+            clock_ps=jnp.where(l2_miss_go, req_send_ps, ms.req.clock_ps),
+            acc_ps=ms.req.acc_ps
+            + jnp.where(slot_done_now, slot_done_ps - clock_ps, 0),
+            # per-slot latency for the iocoom operand algebra
+            slot_lat_ps=jnp.where(
+                (slot_done_now[:, None]
+                 & (jnp.arange(3)[None, :] == slot[:, None])),
+                (slot_done_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
+            instr_buf=new_instr_buf,
+            # slot advances on completion; on miss it stays (the reply path
+            # advances it); skipped-over absent slots jump to the live one
+            slot=jnp.where(slot_done_now, slot + 1,
+                           jnp.where(starting, slot, ms.req.slot)),
+        )
 
-    # functional effect of slots completed via L1/L2 (loads/stores)
-    ms = _apply_functional(mp, ms, rec, slot, s_addr, s_write,
-                           slot_done_now & ~s_is_icache)
+        # count misses only when the miss actually proceeds: a lane stalled on
+        # a busy evict cell (stall_start) retries `starting` every iteration
+        # and must not re-count
+        miss_go = l1_miss & ~stall_start
+        # L2 miss-type classification (`cache.cc getMissType` priority:
+        # evicted -> CAPACITY, else invalidated/fetched -> SHARING, else
+        # COLD), read BEFORE this access's own set updates
+        if mp.l2.track_miss_types:
+            from graphite_tpu.memory.state import (
+                MT_EVICTED, MT_FETCHED, MT_INVALIDATED,
+            )
+
+            cls = l2_miss_go & jnp.asarray(enabled, bool)
+            in_e = _mt_test(ms.mt, MT_EVICTED, s_line)
+            in_i = _mt_test(ms.mt, MT_INVALIDATED, s_line)
+            in_f = _mt_test(ms.mt, MT_FETCHED, s_line)
+            mt_cap = cls & in_e
+            mt_sha = cls & ~in_e & (in_i | in_f)
+            mt_cold = cls & ~in_e & ~in_i & ~in_f
+            # the upgrade's local L2 invalidate feeds the invalidated set
+            # (`setCacheLineInfo` INVALID transition)
+            new_mt = _mt_update(ms.mt, MT_INVALIDATED, s_line, up_go, True)
+            ms = ms.replace(mt=new_mt)
+        else:
+            mt_cap = mt_sha = mt_cold = jnp.zeros((T,), jnp.bool_)
+        counters = ms.counters.replace(
+            l1i_hits=ms.counters.l1i_hits
+            + ((l1_hit_now | ibuf_hit) & s_comp_l1i & enabled).astype(I64),
+            l1i_misses=ms.counters.l1i_misses
+            + (miss_go & s_comp_l1i & enabled).astype(I64),
+            l1d_read_hits=ms.counters.l1d_read_hits
+            + (l1_hit_now & ~s_comp_l1i & ~s_write & enabled).astype(I64),
+            l1d_read_misses=ms.counters.l1d_read_misses
+            + (miss_go & ~s_comp_l1i & ~s_write & enabled).astype(I64),
+            l1d_write_hits=ms.counters.l1d_write_hits
+            + (l1_hit_now & ~s_comp_l1i & s_write & enabled).astype(I64),
+            l1d_write_misses=ms.counters.l1d_write_misses
+            + (miss_go & ~s_comp_l1i & s_write & enabled).astype(I64),
+            l2_hits=ms.counters.l2_hits + (l2_hit_now & enabled).astype(I64),
+            l2_misses=ms.counters.l2_misses + (l2_miss_go & enabled).astype(I64),
+            l2_cold_misses=ms.counters.l2_cold_misses + mt_cold.astype(I64),
+            l2_capacity_misses=ms.counters.l2_capacity_misses
+            + mt_cap.astype(I64),
+            l2_sharing_misses=ms.counters.l2_sharing_misses
+            + mt_sha.astype(I64),
+        )
+        progress = progress + jnp.sum(slot_done_now | l2_miss_go, dtype=jnp.int32)
+
+        ms = ms.replace(
+            l1i=l1i_upd, l1d=l1d_upd, l2=l2_upd, l2_cloc=l2_cloc,
+            mail=mail, req=req_state, counters=counters, noc=noc,
+        )
+
+        # functional effect of slots completed via L1/L2 (loads/stores)
+        ms = _apply_functional(mp, ms, rec, slot, s_addr, s_write,
+                               slot_done_now & ~s_is_icache)
+        return ms, progress
+
+    for _ in range(max(int(mp.requester_unroll), 1)):
+        ms, progress = _requester_once(ms, progress)
 
     # The phase ORDER is chosen so a miss resolves in ONE engine iteration
     # when no queued transaction is ahead of it: the request written by

@@ -228,6 +228,15 @@ class MemParams:
     # ack, reply — routes through the dense hop-by-hop engine instead of
     # the zero-load hop-counter math (HopByHopParams | None)
     net_hbh: "object" = None
+    # how many requester slot-starts run per engine iteration: >1 lets a
+    # record whose slots HIT the L1 complete several slots per iteration.
+    # Measured A/B: a win only for hit-dominated multi-slot records —
+    # miss-heavy storms (canneal) pay the repeat for nothing (~1.4x
+    # slower at 64 tiles), so the default stays 1; opt in per study via
+    # `[general] requester_unroll`.  PRIVATE-L2 engines only: the
+    # shared-L2 engine's requester phase does not read it (its L1-only
+    # hit path is already a single cheap lookup per iteration)
+    requester_unroll: int = 1
 
     @property
     def req_bits(self) -> int:
@@ -370,14 +379,20 @@ class MemParams:
         module_domains = tuple(module_domain_index(cfg, m) for m in modules)
         dir_freq_mhz = module_freq_mhz(cfg, "DIRECTORY")
 
+        protocol = cfg.get_string(
+            "caching_protocol/type", "pr_l1_pr_l2_dram_directory_msi")
+        requester_unroll = cfg.get_int("general/requester_unroll", 1)
+        if requester_unroll > 1 and protocol.startswith("pr_l1_sh_l2"):
+            raise NotImplementedError(
+                "[general] requester_unroll > 1 applies to the private-L2 "
+                "engines only (the shared-L2 requester phase does not "
+                "read it)")
         return cls(
             dir_freq_mhz=dir_freq_mhz,
             n_tiles=T,
             line_size=line,
             line_bits=line_bits,
-            protocol=cfg.get_string(
-                "caching_protocol/type", "pr_l1_pr_l2_dram_directory_msi"
-            ),
+            protocol=protocol,
             l1i=l1i,
             l1d=l1d,
             l2=l2,
@@ -403,6 +418,7 @@ class MemParams:
             sync_delay_cycles=cfg.get_int("dvfs/synchronization_delay", 2),
             icache_modeling=cfg.get_bool("general/enable_icache_modeling", False),
             func_mem_words=cfg.get_int("general/functional_memory_kb", 256) * 256,
+            requester_unroll=requester_unroll,
         )
 
     def sync_cycles(self, module_a: int, module_b: int) -> int:

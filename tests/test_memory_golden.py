@@ -443,3 +443,13 @@ def test_miss_types_off_by_default():
     sc = make_config(2, MSI)
     res, _ = assert_exact(sc, mutex_rmw(2, 3))
     assert int(np.asarray(res.mem_counters["l2_cold_misses"]).sum()) == 0
+
+
+def test_requester_unroll_bit_exact():
+    """`[general] requester_unroll` packs several L1-hitting slots of one
+    record into a single engine iteration; slot times are measured from
+    the record's base clock, so timing must be BIT-identical to the
+    oracle (and to unroll=1) on serialized workloads."""
+    extra = "[general]\nrequester_unroll = 3\n"
+    sc = make_config(4, MSI, extra=extra)
+    assert_exact(sc, mutex_rmw(4, rounds=5, lines=2))
