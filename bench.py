@@ -90,10 +90,12 @@ scheme = lax
             )
         batch = BENCHMARKS[WORKLOAD](N_TILES)
         desc = WORKLOAD
-    # FFT: at most one in-flight message per (src,dst) pair between
-    # barriers, so depth-2 rings suffice (overflow raises, never corrupts);
-    # smaller [T,T,depth] rings cut per-iteration HBM traffic ~1.4x
-    depth = 2 if WORKLOAD == "fft" else 8
+    # Barrier-phased workloads auto-size their [T,T,depth] rings from
+    # the trace (Simulator auto_mailbox_depth -> 2 for FFT); the ring
+    # workload's unphased send stream keeps an explicit small depth (its
+    # recv interlock bounds true occupancy, which the trace-order bound
+    # cannot see)
+    depth = None if WORKLOAD != "ring" else 8
     # Big per-instruction traces stream host->HBM in windows instead of
     # living resident (trace/schema.py streaming mode): device trace
     # memory is bounded by one [T, W] window regardless of trace length.
@@ -165,12 +167,12 @@ scheme = lax
             64, core="iocoom", shared_mem=True, clock_scheme="lax")))
         msi_rate = _timed_rate(Simulator(
             sc_msi, fft_trace(64, points_per_tile=512, use_memory=True),
-            mailbox_depth=2, inner_block=64))
+            inner_block=64))
         sc_hbh = SimConfig(ConfigFile.from_string(config_text(
             256, network="emesh_hop_by_hop", clock_scheme="lax")))
         hbh_rate = _timed_rate(Simulator(
             sc_hbh, radix_trace(256, keys_per_tile=1024),
-            mailbox_depth=8, inner_block=64))
+            inner_block=64))
         companions = {
             "coherence_msi_instr_per_s": round(msi_rate),
             "hop_by_hop_instr_per_s": round(hbh_rate),

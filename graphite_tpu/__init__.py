@@ -17,7 +17,8 @@ Layer map (mirrors SURVEY.md §1, reference layers L0–L7):
     memory/     cache arrays + coherence protocol engines (MSI/MOSI/shL2)
     engine/     the quantum-step state machine + Simulator orchestration
     golden/     sequential differential oracles (core + memory hierarchy)
-    parallel/   device-mesh sharding (pjit/shard_map over ICI)
+    parallel/   device-mesh sharding: shard_map packed exchange (default
+                multi-chip program) + legacy GSPMD specs, over ICI
     power/      McPAT/DSENT-equivalent energy models fed by event counters
     system/     host-side MCP analogs: threads, syscalls, stats, checkpoint
     tools/      drivers (graduated runner, regress sweep, output parsing)

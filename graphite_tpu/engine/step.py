@@ -46,6 +46,7 @@ from jax import lax
 
 from graphite_tpu.engine.state import SimState, DeviceTrace
 from graphite_tpu.models.network_user import UserNetworkParams, route_latency_ps
+from graphite_tpu.parallel.px import IDENT, ParallelCtx
 from graphite_tpu.trace.schema import (
     FLAG_BRANCH_TAKEN,
     Op,
@@ -127,6 +128,7 @@ def subquantum_iteration(
     state: SimState,
     quantum_end_ps: jax.Array,
     trace_base: jax.Array | None = None,
+    px: ParallelCtx = IDENT,
 ) -> tuple[SimState, jax.Array]:
     """Process one trace record per tile; returns (state, tiles_advanced).
 
@@ -137,6 +139,13 @@ def subquantum_iteration(
     global idx has run past their window's end simply pause (wall-time
     only; clocks and all protocol state carry over) until the host
     slides their window.
+
+    With a sharded `px` (shard_map multi-chip), `trace` and
+    `core.bp_bits` hold only this device's block of tile rows; every
+    other input is replicated.  Block-local reads are packed into one
+    all-gather here (and one per memory-engine phase); all decision
+    logic then runs replicated, and block-local arrays take their lanes'
+    writes locally (see parallel/px.py).
     """
     T = params.n_tiles
     D = params.mailbox_depth
@@ -153,13 +162,16 @@ def subquantum_iteration(
     # on TPU (gather lowers poorly), so when every tile is at the SAME
     # column — the common case for lockstep stretches — read the column
     # with one dynamic_slice instead.  The gather path runs only when tiles
-    # have diverged (blocked on sync/messages).
+    # have diverged (blocked on sync/messages).  Under a sharded px the
+    # trace and bp_bits rows are block-local: the reads below see only
+    # this device's lanes and ONE packed all-gather replicates them.
     gather_fields = (trace.op, trace.flags, trace.pc, trace.aux0, trace.aux1,
                      trace.dyn_ps) + (
         (trace.addr0, trace.addr1) if params.mem is not None else ()) + (
         (trace.rreg0, trace.rreg1, trace.wreg)
         if params.iocoom is not None else ())
     uniform = jnp.all(idx == idx[0])
+    idx_l = px.lo(idx)
 
     def _read_uniform(_):
         return tuple(
@@ -168,9 +180,15 @@ def subquantum_iteration(
         )
 
     def _read_gather(_):
-        return tuple(_gather_field(f, idx) for f in gather_fields)
+        return tuple(_gather_field(f, idx_l) for f in gather_fields)
 
-    fetched = lax.cond(uniform, _read_uniform, _read_gather, None)
+    fetched_l = lax.cond(uniform, _read_uniform, _read_gather, None)
+    # branch prediction reads ride the same exchange (bp_bits block-local)
+    bp_index_l = (fetched_l[2] % params.bp_size).astype(jnp.int32)
+    bp_pred_l = jnp.take_along_axis(
+        core.bp_bits, bp_index_l[:, None], axis=1)[:, 0]
+    agd = px.ag(fetched_l + (bp_pred_l,))
+    fetched, bp_pred = agd[:-1], agd[-1]
     op = fetched[0].astype(jnp.int32)
     flags = fetched[1].astype(jnp.int32)
     pc = fetched[2]
@@ -231,7 +249,10 @@ def subquantum_iteration(
         # with provably no memory work: no live protocol state and no
         # active lane whose record carries memory slots.  Compute-heavy
         # stretches (bblock runs) then pay ~nothing for the memory model.
-        if params.mem_gate:
+        # Sharded px runs ungated: the engine's per-phase all-gathers must
+        # not sit inside a lax.cond (and the sharded workloads are
+        # coherence-dense, so the gate would rarely skip anyway).
+        if params.mem_gate and not px.sharded:
             need_mem = state.mem.live | jnp.any(
                 active & slots_present(params.mem, rec, enabled).any(axis=1))
             mem_out = lax.cond(
@@ -244,7 +265,7 @@ def subquantum_iteration(
         else:
             mem_out = engine_step(
                 params.mem, state.mem, rec, core.clock_ps, core.freq_mhz,
-                active, enabled)
+                active, enabled, px=px)
         mem_state = mem_out.ms
         mem_ok = mem_out.mem_complete
         mem_acc_ps = mem_out.acc_ps
@@ -291,8 +312,7 @@ def subquantum_iteration(
     cost_table = jnp.asarray(params.static_cost_cycles, dtype=I64)
     static_cycles = cost_table[jnp.clip(op, 0, 19)]
 
-    bp_index = (pc % params.bp_size).astype(jnp.int32)
-    bp_pred = jnp.take_along_axis(core.bp_bits, bp_index[:, None], axis=1)[:, 0]
+    bp_index = (pc % params.bp_size).astype(jnp.int32)  # bp_pred: fetch ag
     taken = ((flags & FLAG_BRANCH_TAKEN) != 0).astype(jnp.uint8)
     bp_correct_now = bp_pred == taken
     if params.bp_enabled:
@@ -736,21 +756,17 @@ def subquantum_iteration(
         cjoin_now, jnp.maximum(cjoin_time - core.clock_ps, 0), 0)
 
     # --- JOIN ------------------------------------------------------------
+    # The target's liveness is read off its own fetched record (every
+    # lane's current op is already in hand — same clipped index the fetch
+    # used), so the old per-target trace re-gather is gone; a paused
+    # streaming target's window-edge record must not read as THREAD_EXIT.
+    at_exit = op == Op.THREAD_EXIT
+    if in_window is not None:
+        at_exit = at_exit & in_window
+
     def _join_block(_):
         join_target = jnp.clip(aux0, 0, T - 1)
-        if trace_base is None:
-            target_idx = jnp.minimum(core.idx[join_target], trace.length - 1)
-            target_in_win = True
-        else:
-            tb = trace_base[join_target]
-            target_idx = jnp.clip(core.idx[join_target] - tb,
-                                  0, trace.length - 1)
-            # a paused target's edge record must not read as THREAD_EXIT
-            target_in_win = core.idx[join_target] < (tb + trace.length)
-        target_done = state.done[join_target] | (
-            target_in_win
-            & (trace.op[join_target, target_idx] == Op.THREAD_EXIT)
-        )
+        target_done = state.done[join_target] | at_exit[join_target]
         join_now = active & is_join & target_done
         join_time = jnp.maximum(core.clock_ps, core.clock_ps[join_target])
         return join_now, join_time
@@ -929,11 +945,13 @@ def subquantum_iteration(
         + jnp.where(enabled, bsync_wait_ps + cjoin_wait_ps, 0),
         # delta-add (uint8 modular): old + (taken - old) == taken; avoids a
         # second gather of bp_bits inside the scatter so the buffer updates
-        # in place ((tiles, bp_index) pairs are unique per lane)
-        bp_bits=core.bp_bits.at[tiles, bp_index].add(
-            jnp.where(active & is_branch & enabled, taken - bp_pred, 0)
-            .astype(jnp.uint8)
-        ),
+        # in place ((tiles, bp_index) pairs are unique per lane); applied
+        # block-local under a sharded px
+        bp_bits=px.lane_col_add(
+            core.bp_bits, *px.lo((
+                bp_index,
+                jnp.where(active & is_branch & enabled, taken - bp_pred, 0)
+                .astype(jnp.uint8)))),
         bp_correct=core.bp_correct
         + (active & is_branch & bp_correct_now & enabled).astype(I64),
         bp_incorrect=core.bp_incorrect
@@ -998,7 +1016,7 @@ def subquantum_iteration(
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 
 
-def _quantum_loop(params, trace, state, qend, trace_base=None):
+def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT):
     """Blocks of `inner_block` iterations until no tile makes progress.
     Returns (state, total_progress)."""
 
@@ -1006,7 +1024,7 @@ def _quantum_loop(params, trace, state, qend, trace_base=None):
         def body(carry, _):
             st, prog = carry
             st, adv = subquantum_iteration(params, trace, st, qend,
-                                           trace_base)
+                                           trace_base, px=px)
             return (st, prog + adv), None
 
         (state, progress), _ = lax.scan(
@@ -1054,6 +1072,7 @@ def run_simulation(
     quantum_ps: int | None,
     max_quanta: int = 1_000_000,
     trace_base: jax.Array | None = None,
+    px: ParallelCtx = IDENT,
 ):
     """The whole simulation as ONE compiled region: an outer while_loop over
     lax-barrier quanta (the MCP barrier loop, `lax_barrier_sync_server.h`)
@@ -1094,7 +1113,8 @@ def run_simulation(
             qend = INF_QEND
         else:
             qend = jnp.maximum(prev_qend + qps, next_boundary(min_pending))
-        st2, progress = _quantum_loop(params, trace, st, qend, trace_base)
+        st2, progress = _quantum_loop(params, trace, st, qend, trace_base,
+                                      px=px)
         # Zero progress: if some non-done tile sits beyond qend (it crossed
         # the boundary executing one long record), jump the window up to it
         # — blocked peers may wait on its future sends.  Only when every

@@ -133,6 +133,16 @@ def gather_row(cache: CacheArrays, line: jax.Array,
                     meta0=meta)
 
 
+def row_from_meta(meta: jax.Array, sets: jax.Array) -> CacheRow:
+    """Rebuild a CacheRow from its packed (meta, sets) pair — the compact
+    form a row travels in through the shard_map phase exchange (pack ∘
+    unpack is the identity, so the rebuilt row is bit-equal to the
+    gather_row original)."""
+    tag, st, lru = _unpack(meta)
+    return CacheRow(tag=tag, st=st.astype(jnp.int32), lru=lru, sets=sets,
+                    meta0=meta)
+
+
 def scatter_row(cache: CacheArrays, row: CacheRow) -> CacheArrays:
     """Write each lane's row back — ONE scatter, no masking: the row_*
     ops are themselves masked per lane, so an untouched lane's row packs

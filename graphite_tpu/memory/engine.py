@@ -65,9 +65,11 @@ from graphite_tpu.memory.state import (
     MSG_EX_REP, MSG_EX_REQ, MSG_FLUSH_REP, MSG_FLUSH_REQ, MSG_INV_REP,
     MSG_INV_REQ, MSG_NONE, MSG_NULLIFY, MSG_SH_REP, MSG_SH_REQ, MSG_WB_REP,
     MSG_WB_REQ,
+    MT_EVICTED, MT_FETCHED, MT_INVALIDATED,
     PHASE_IDLE, PHASE_WAIT_REPLY,
     MemState,
 )
+from graphite_tpu.parallel.px import IDENT, ParallelCtx
 from graphite_tpu.time_types import cycles_to_ps
 from graphite_tpu.trace.schema import (
     FLAG_CHECK, FLAG_MEM0_VALID, FLAG_MEM0_WRITE, FLAG_MEM1_VALID,
@@ -272,7 +274,9 @@ def _mt_test(mt, row: int, line):
 
 def _mt_update(mt, row: int, line, mask, set_bit_val: bool):
     """Set or clear the line's bucket bit in bitmap `row` where mask
-    (delta-add scatter: per-lane rows are unique)."""
+    (delta-add scatter: per-lane rows are unique).  Operates on whatever
+    block of tile rows `mt` holds — sharded callers pass block-local
+    line/mask."""
     T = mt.shape[0]
     tiles = jnp.arange(T, dtype=jnp.int32)
     w, b = _mt_bit(line)
@@ -282,6 +286,106 @@ def _mt_update(mt, row: int, line, mask, set_bit_val: bool):
     return mt.at[tiles, row, w].add(
         jnp.where(mask, new - cur, jnp.uint32(0)),
         unique_indices=True, indices_are_sorted=True)
+
+
+def _mt_same_bucket(a, b):
+    """Do two lines hash to the same miss-type bucket?  (Pure math — lets
+    the sharded path fold a just-applied local bitmap write into an
+    already-exchanged pre-write test bit.)"""
+    from graphite_tpu.memory.state import MT_BITS
+
+    m = jnp.uint32(MT_BITS - 1)
+    return (a.astype(jnp.uint32) & m) == (b.astype(jnp.uint32) & m)
+
+
+# --------------------------------------------------------------------------
+# shard_map phase-exchange helpers: block-local row gathers packed into one
+# all-gather per engine phase (identity under the single-device px) — see
+# parallel/px.py for the exchange design.
+
+
+def _row_pack(row: "ca.CacheRow"):
+    """The compact exchanged form of a gathered cache row."""
+    return row.meta0, row.sets
+
+
+def _rows_exchange(px: ParallelCtx, local_rows, extra=()):
+    """Exchange locally gathered CacheRows (+ any extra per-lane fields)
+    to full tile width in ONE packed collective (identity single-device)."""
+    if not px.sharded:
+        return tuple(local_rows), tuple(extra)
+    packed = tuple(_row_pack(r) for r in local_rows)
+    out = px.ag((packed, tuple(extra)))
+    rows = tuple(ca.row_from_meta(m, s) for (m, s) in out[0])
+    return rows, out[1]
+
+
+class _DirSetView:
+    """Each home lane's directory SET at `line`, behind one interface for
+    both programs:
+
+     - single-device (IDENT px): lazy way-level gathers — exactly the
+       access pattern the engine always had (a tags-row gather for the
+       lookup, one element gather per entry field), so the TPU kernel
+       count is unchanged;
+     - sharded px: the whole set's rows are gathered block-locally and
+       exchanged in ONE collective up front; lookup/entry() are then
+       replicated take_along_axis selections (a second exchange for the
+       way-dependent entry read would double the phase's collectives).
+    """
+
+    def __init__(self, px: ParallelCtx, d: "DirectoryArrays", line, mp):
+        self.sets = (line % mp.dir_sets).astype(jnp.int32)
+        self._line = line
+        self._sharded = px.sharded
+        if px.sharded:
+            line_l = px.lo(line)
+            Tl = d.tags.shape[0]
+            lt = jnp.arange(Tl, dtype=jnp.int32)
+            sets_l = (line_l % mp.dir_sets).astype(jnp.int32)
+            (self._tags_r, self._dstate_r, self._owner_r, self._sharers_r,
+             self._nsh_r) = px.ag((
+                 d.tags[lt, sets_l], d.dstate[lt, sets_l],
+                 d.owner[lt, sets_l], d.sharers[lt, sets_l],
+                 d.nsharers[lt, sets_l]))
+        else:
+            self._d = d
+            T = d.tags.shape[0]
+            self._tiles = jnp.arange(T, dtype=jnp.int32)
+            self._tags_r = None
+
+    def rows(self):
+        """(tag_row, nsharers_row) — the [T, DW] set rows the allocation
+        decisions (free way / min-sharer victim) need."""
+        if self._tags_r is None:
+            self._tags_r = self._d.tags[self._tiles, self.sets]
+        if self._sharded:
+            return self._tags_r, self._nsh_r
+        return self._tags_r, self._d.nsharers[self._tiles, self.sets]
+
+    def lookup(self):
+        """(found, way) of `line` within the set."""
+        tag_row = self.rows()[0] if self._tags_r is None else self._tags_r
+        way_hits = tag_row == self._line[:, None]
+        found = way_hits.any(axis=1)
+        way = jnp.argmax(way_hits, axis=1).astype(jnp.int32)
+        return found, way
+
+    def entry(self, way):
+        """(tags, dstate, owner, sharers, nsh) at `way`."""
+        if self._sharded:
+            def sel(r):
+                if r.ndim == 3:
+                    return jnp.take_along_axis(
+                        r, way[:, None, None], axis=1)[:, 0]
+                return jnp.take_along_axis(r, way[:, None], axis=1)[:, 0]
+
+            return (sel(self._tags_r), sel(self._dstate_r),
+                    sel(self._owner_r), sel(self._sharers_r),
+                    sel(self._nsh_r))
+        d, t, s = self._d, self._tiles, self.sets
+        return (d.tags[t, s, way], d.dstate[t, s, way], d.owner[t, s, way],
+                d.sharers[t, s, way], d.nsharers[t, s, way])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -371,49 +475,22 @@ def mem_idle_out(mp: MemParams, ms, rec: "RecView", enabled) -> MemStepOut:
 # round-3 findings and the DirectoryArrays docstring).
 
 
-def _dir_row(d, sets):
-    """Gather one set's DW-entry row per home lane: ([T, DW] tags,
-    [T, DW] nsharers) — the two fields set-level decisions need."""
-    T = d.tags.shape[0]
-    tiles = jnp.arange(T, dtype=jnp.int32)
-    return d.tags[tiles, sets], d.nsharers[tiles, sets]
-
-
-def _dir_lookup(mp: MemParams, d, line):
-    """Per-home-lane directory set lookup: (set, found, way)."""
-    sets = (line % mp.dir_sets).astype(jnp.int32)
-    tag_row, _ = _dir_row(d, sets)
-    way_hits = tag_row == line[:, None]
-    found = way_hits.any(axis=1)
-    way = jnp.argmax(way_hits, axis=1).astype(jnp.int32)
-    return sets, found, way
-
-
-def _dir_gather(d, sets, way):
-    """Gather one entry per home lane."""
-    T = d.tags.shape[0]
-    tiles = jnp.arange(T, dtype=jnp.int32)
-    return (
-        d.tags[tiles, sets, way],
-        d.dstate[tiles, sets, way],
-        d.owner[tiles, sets, way],
-        d.sharers[tiles, sets, way],   # [T, SW]
-        d.nsharers[tiles, sets, way],
-    )
-
-
-def _dir_update(d, sets, way, mask, *, tags=None,
+def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
                 dstate=None, owner=None, sharers=None, nsharers=None):
     """Masked per-lane write of one directory entry.
 
     Add-a-delta scatters (new = cur + (new - cur) under mask): per-lane
     indices are unique (row = lane), so the add is exact and the scatter
-    can update the loop-carried buffers in place."""
+    can update the loop-carried buffers in place.  The operands arrive
+    replicated full-width; a sharded px applies only this device's home
+    rows."""
+    sets, way, mask = px.lo((sets, way, mask))
     T = d.tags.shape[0]
     tiles = jnp.arange(T, dtype=jnp.int32)
     out = d
 
     def delta(arr, new, m):
+        new = px.lo(new)
         cur = arr[tiles, sets, way]
         return arr.at[tiles, sets, way].add(
             jnp.where(m, new - cur, jnp.zeros_like(cur)),
@@ -445,6 +522,7 @@ def memory_engine_step(
     freq_mhz: jax.Array,      # int32[T] per-tile core/cache frequency
     active: jax.Array,        # bool[T] lane may start new work this iter
     enabled,                  # bool[] models enabled
+    px: ParallelCtx = IDENT,  # shard_map exchange context (parallel/px.py)
 ) -> MemStepOut:
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
@@ -529,10 +607,24 @@ def memory_engine_step(
 
         # L1 lookups (both caches, masked by component) — each lane's set rows
         # are gathered ONCE per cache level here and scattered back once below
-        # (the engine is op-count-bound; see cache_array.py)
-        l1i_row = ca.gather_row(ms.l1i, s_line, mp.l1i.sets_mod)
-        l1d_row = ca.gather_row(ms.l1d, s_line, mp.l1d.sets_mod)
-        l2_row = ca.gather_row(ms.l2, s_line, mp.l2.sets_mod)
+        # (the engine is op-count-bound; see cache_array.py).  Under a
+        # sharded px the gathers read this device's block and ONE packed
+        # all-gather replicates the rows (plus the pre-update miss-type
+        # test bits, which must be read before this phase's own writes).
+        s_line_l = px.lo(s_line)
+        rows_l = (
+            ca.gather_row(ms.l1i, s_line_l, px.lo_const(mp.l1i.sets_mod)),
+            ca.gather_row(ms.l1d, s_line_l, px.lo_const(mp.l1d.sets_mod)),
+            ca.gather_row(ms.l2, s_line_l, px.lo_const(mp.l2.sets_mod)),
+        )
+        if mp.l2.track_miss_types:
+            mt_bits_l = (_mt_test(ms.mt, MT_EVICTED, s_line_l),
+                         _mt_test(ms.mt, MT_INVALIDATED, s_line_l),
+                         _mt_test(ms.mt, MT_FETCHED, s_line_l))
+        else:
+            mt_bits_l = ()
+        (l1i_row, l1d_row, l2_row), mt_bits = _rows_exchange(
+            px, rows_l, mt_bits_l)
         l1i_hit, l1i_way, l1i_state = ca.row_lookup(l1i_row, s_line)
         l1d_hit, l1d_way, l1d_state = ca.row_lookup(l1d_row, s_line)
         l1_state = jnp.where(s_comp_l1i, l1i_state, l1d_state)
@@ -607,20 +699,22 @@ def memory_engine_step(
         l1d_row, _, l1d_ev, l1d_ev_line = l1_fill(
             l1d_row, fill_l1d, l2_state, mp.l1d.replacement,
             mp.l1d.ways_limit)
-        # L1 victims: clear their cached-loc in L2 (line stays valid in L2)
+        # L1 victims: clear their cached-loc in L2 (line stays valid in L2).
+        # The whole read-modify-write chain is block-local: its only
+        # consumer is the local cloc scatter, so nothing travels.
         l1_ev = l1i_ev | l1d_ev
         l1_ev_line = jnp.where(l1i_ev, l1i_ev_line, l1d_ev_line)
-        ev_hit, ev_way, _ = ca.lookup(ms.l2, l1_ev_line, mp.l2.sets_mod)
-        ev_sets = (l1_ev_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-        cur_cloc = ms.l2_cloc[tiles, ev_sets, ev_way]
-        l2_cloc = ms.l2_cloc.at[tiles, ev_sets, ev_way].add(
-            jnp.where(l1_ev & ev_hit, -cur_cloc, jnp.zeros_like(cur_cloc)))
+        ev_line_l = px.lo(l1_ev_line)
+        l2_mod_l = px.lo_const(mp.l2.sets_mod)
+        ev_hit_l, ev_way_l, _ = ca.lookup(ms.l2, ev_line_l, l2_mod_l)
+        ev_sets_l = (ev_line_l % jnp.asarray(l2_mod_l)).astype(jnp.int32)
+        l2_cloc = px.entry_set(ms.l2_cloc, ev_sets_l, ev_way_l,
+                               px.lo(l1_ev) & ev_hit_l, 0)
         # record new cached-loc for the filled line
         f_sets = (s_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
         new_cloc = jnp.where(s_comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8)
-        cur_cloc = l2_cloc[tiles, f_sets, l2_way]
-        l2_cloc = l2_cloc.at[tiles, f_sets, l2_way].add(
-            jnp.where(l2_hit_now, new_cloc - cur_cloc, jnp.zeros_like(cur_cloc)))
+        l2_cloc = px.entry_set(
+            l2_cloc, *px.lo((f_sets, l2_way, l2_hit_now, new_cloc)))
         if mp.l2.replacement != "round_robin":
             l2_row = ca.row_touch(l2_row, l2_way, l2_hit_now)
 
@@ -632,10 +726,11 @@ def memory_engine_step(
         # for a dirty OWNED line)
         up_go = upgrade & ~stall_start
         l2_row = ca.row_invalidate(l2_row, s_line, up_go)
-        # scatter the three set rows back — ONE scatter per cache level
-        l1i_upd = ca.scatter_row(ms.l1i, l1i_row)
-        l1d_upd = ca.scatter_row(ms.l1d, l1d_row)
-        l2_upd = ca.scatter_row(ms.l2, l2_row)
+        # scatter the three set rows back — ONE scatter per cache level,
+        # each device taking its own lanes' rows
+        l1i_upd = ca.scatter_row(ms.l1i, px.lo(l1i_row))
+        l1d_upd = ca.scatter_row(ms.l1d, px.lo(l1d_row))
+        l2_upd = ca.scatter_row(ms.l2, px.lo(l2_row))
         mail = ms.mail
         noc = ms.noc
         up_msg = jnp.where(upgrade_dirty, MSG_FLUSH_REP,
@@ -702,20 +797,15 @@ def memory_engine_step(
         # evicted -> CAPACITY, else invalidated/fetched -> SHARING, else
         # COLD), read BEFORE this access's own set updates
         if mp.l2.track_miss_types:
-            from graphite_tpu.memory.state import (
-                MT_EVICTED, MT_FETCHED, MT_INVALIDATED,
-            )
-
             cls = l2_miss_go & jnp.asarray(enabled, bool)
-            in_e = _mt_test(ms.mt, MT_EVICTED, s_line)
-            in_i = _mt_test(ms.mt, MT_INVALIDATED, s_line)
-            in_f = _mt_test(ms.mt, MT_FETCHED, s_line)
+            in_e, in_i, in_f = mt_bits  # pre-update reads (exchanged above)
             mt_cap = cls & in_e
             mt_sha = cls & ~in_e & (in_i | in_f)
             mt_cold = cls & ~in_e & ~in_i & ~in_f
             # the upgrade's local L2 invalidate feeds the invalidated set
             # (`setCacheLineInfo` INVALID transition)
-            new_mt = _mt_update(ms.mt, MT_INVALIDATED, s_line, up_go, True)
+            new_mt = _mt_update(ms.mt, MT_INVALIDATED, s_line_l,
+                                px.lo(up_go), True)
             ms = ms.replace(mt=new_mt)
         else:
             mt_cap = mt_sha = mt_cold = jnp.zeros((T,), jnp.bool_)
@@ -768,31 +858,33 @@ def memory_engine_step(
     # ======================================================================
     # (2) homes consume one EVICT per iteration
     # ======================================================================
-    ms, progress = _home_evictions(mp, ms, dir_access_ps, enabled, progress)
+    ms, progress = _home_evictions(mp, ms, dir_access_ps, enabled, progress,
+                                   px)
 
     # ======================================================================
     # (3) homes start transactions (pop request / resume saved)
     # ======================================================================
     ms, progress = _home_starts(mp, ms, dram_lat_ps, dir_access_ps,
-                                sync_dir_l2, sync_dir_net, enabled, progress)
+                                sync_dir_l2, sync_dir_net, enabled, progress,
+                                px)
 
     # ======================================================================
     # (4) sharers consume one FWD per iteration
     # ======================================================================
     ms, progress = _sharer_step(mp, ms, fmhz, enabled, progress,
-                                sync_l2_net, sync_l1d_l2)
+                                sync_l2_net, sync_l1d_l2, px)
 
     # ======================================================================
     # (5) homes consume ACKs, finish transactions
     # ======================================================================
     ms, progress = _home_acks_and_finish(mp, ms, dram_lat_ps, dir_access_ps,
-                                         enabled, progress)
+                                         enabled, progress, px)
 
     # ======================================================================
     # (6) requesters consume replies (fill L2+L1, complete slot)
     # ======================================================================
     ms, progress = _requester_fill(mp, ms, rec, clock_ps, fmhz, enabled,
-                                   progress, sync_l2_net)
+                                   progress, sync_l2_net, px)
 
     # ---- completion signal ----------------------------------------------
     final_slot = next_present(ms.req.slot)
@@ -835,7 +927,7 @@ def _apply_functional(mp, ms: MemState, rec: RecView, slot, s_addr, s_write,
 
 
 def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
-                 sync_l2_net, sync_l1d_l2):
+                 sync_l2_net, sync_l1d_l2, px: ParallelCtx = IDENT):
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     mail = ms.mail
@@ -849,7 +941,22 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     fline = mail.fwd_line[tiles, h]
     ftime = mail.fwd_time[tiles, h]
 
-    l2_r = ca.gather_row(ms.l2, fline, mp.l2.sets_mod)
+    # block-local row gathers at the served line (+ the cached-loc SET row
+    # — way selection happens replicated after the exchange; single-device
+    # keeps the direct element read)
+    fline_l = px.lo(fline)
+    l2_mod_l = px.lo_const(mp.l2.sets_mod)
+    sets_l = (fline_l % jnp.asarray(l2_mod_l)).astype(jnp.int32)
+    lt = jnp.arange(ms.l2.meta.shape[0], dtype=jnp.int32)
+    rows_l = (ca.gather_row(ms.l2, fline_l, l2_mod_l),
+              ca.gather_row(ms.l1i, fline_l, px.lo_const(mp.l1i.sets_mod)),
+              ca.gather_row(ms.l1d, fline_l, px.lo_const(mp.l1d.sets_mod)))
+    if px.sharded:
+        (l2_r, l1i_r, l1d_r), (cloc_row,) = _rows_exchange(
+            px, rows_l, (ms.l2_cloc[lt, sets_l],))
+    else:
+        l2_r, l1i_r, l1d_r = rows_l
+        cloc_row = None
     l2_hit, l2_way, l2_state = ca.row_lookup(l2_r, fline)
     serve = found & l2_hit & (l2_state != INVALID)
     silent = found & ~serve  # already evicted; eviction msg satisfies home
@@ -864,11 +971,12 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
 
     # invalidate / downgrade L1 (whichever L1 holds it, by cached-loc)
     sets = (fline % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-    cloc = ms.l2_cloc[tiles, sets, l2_way]
+    if cloc_row is not None:
+        cloc = jnp.take_along_axis(cloc_row, l2_way[:, None], axis=1)[:, 0]
+    else:
+        cloc = ms.l2_cloc[tiles, sets, l2_way]
     inv_l1 = serve & (ftype != MSG_WB_REQ)
     wb_l1 = serve & (ftype == MSG_WB_REQ)
-    l1i_r = ca.gather_row(ms.l1i, fline, mp.l1i.sets_mod)
-    l1d_r = ca.gather_row(ms.l1d, fline, mp.l1d.sets_mod)
     l1i_r = ca.row_invalidate(l1i_r, fline, inv_l1 & (cloc == MOD_L1I))
     l1d_r = ca.row_invalidate(l1d_r, fline, inv_l1 & (cloc == MOD_L1D))
     l1i_hit, l1i_way, _ = ca.row_lookup(l1i_r, fline)
@@ -884,21 +992,18 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
                              wb_l1 & (cloc == MOD_L1I) & l1i_hit)
     l1d_r = ca.row_set_state(l1d_r, l1d_way, wb_state,
                              wb_l1 & (cloc == MOD_L1D) & l1d_hit)
-    l1i = ca.scatter_row(ms.l1i, l1i_r)
-    l1d = ca.scatter_row(ms.l1d, l1d_r)
+    l1i = ca.scatter_row(ms.l1i, px.lo(l1i_r))
+    l1d = ca.scatter_row(ms.l1d, px.lo(l1d_r))
 
     # L2: invalidate (INV/FLUSH) or downgrade (WB)
     l2_r = ca.row_invalidate(l2_r, fline, inv_l1)
     l2_r = ca.row_set_state(l2_r, l2_way, wb_state, wb_l1)
-    l2 = ca.scatter_row(ms.l2, l2_r)
+    l2 = ca.scatter_row(ms.l2, px.lo(l2_r))
     if mp.l2.track_miss_types:
-        from graphite_tpu.memory.state import MT_INVALIDATED
-
-        ms = ms.replace(mt=_mt_update(ms.mt, MT_INVALIDATED, fline,
-                                      inv_l1, True))
-    cur_cloc = ms.l2_cloc[tiles, sets, l2_way]
-    l2_cloc = ms.l2_cloc.at[tiles, sets, l2_way].add(
-        jnp.where(inv_l1, -cur_cloc, jnp.zeros_like(cur_cloc)))
+        ms = ms.replace(mt=_mt_update(ms.mt, MT_INVALIDATED, fline_l,
+                                      px.lo(inv_l1), True))
+    l2_cloc = px.entry_set(ms.l2_cloc, sets_l, px.lo(l2_way),
+                           px.lo(inv_l1), 0)
 
     # ack message back to the home
     ack = jnp.where(
@@ -939,7 +1044,8 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
 # "just an eviction" branches)
 
 
-def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress):
+def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress,
+                    px: ParallelCtx = IDENT):
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     mail = ms.mail
@@ -950,9 +1056,11 @@ def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress):
     etime = mail.evict_time[tiles, src]
 
     d = ms.directory
-    sets, dfound, way = _dir_lookup(mp, d, eline)
+    dsv = _DirSetView(px, d, eline, mp)
+    sets = dsv.sets
+    dfound, way = dsv.lookup()
     apply = found & dfound
-    _, dstate, owner, sharers, nsh = _dir_gather(d, sets, way)
+    _, dstate, owner, sharers, nsh = dsv.entry(way)
 
     was_sharer = test_bit(sharers, src)
     new_sharers = clear_bit(sharers, src, apply)
@@ -968,8 +1076,8 @@ def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress):
                   jnp.where(is_flush, DIR_SHARED, dstate)),
         dstate,
     ).astype(jnp.uint8)
-    d = _dir_update(d, sets, way, apply, dstate=new_dstate, owner=new_owner,
-                    sharers=new_sharers, nsharers=new_nsh)
+    d = _dir_update(d, sets, way, apply, px=px, dstate=new_dstate,
+                    owner=new_owner, sharers=new_sharers, nsharers=new_nsh)
 
     # active same-line transaction: treat the eviction as the ack
     txn = ms.txn
@@ -1006,7 +1114,7 @@ def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress):
 
 
 def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
-                          enabled, progress):
+                          enabled, progress, px: ParallelCtx = IDENT):
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     mail = ms.mail
@@ -1049,7 +1157,9 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     is_nullify = txn.mtype == MSG_NULLIFY
 
     d = ms.directory
-    sets, dfound, way = _dir_lookup(mp, d, txn.line)
+    dsv = _DirSetView(px, d, txn.line, mp)
+    sets = dsv.sets
+    dfound, way = dsv.lookup()
     r = txn.requester
     rbit_words = jnp.zeros((T, mp.sharer_words), U32)
     rbit_words = set_bit(rbit_words, r, finish)
@@ -1064,7 +1174,7 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     # alias costs a whole-array copy per iteration (the [T, DS, DW, SW]
     # sharers tensor is 2 GB at 1024 tiles — see PERF.md).
     exf = finish & is_ex & dfound
-    _, cur_dstate, cur_owner, cur_sharers, cur_nsh = _dir_gather(d, sets, way)
+    _, cur_dstate, cur_owner, cur_sharers, cur_nsh = dsv.entry(way)
     shf = finish & is_sh & dfound
     had = test_bit(cur_sharers, r)
     if mp.is_mosi:
@@ -1077,7 +1187,7 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         sh_owner = jnp.full(T, -1, jnp.int32)
     fin_upd = exf | shf
     d = _dir_update(
-        d, sets, way, fin_upd,
+        d, sets, way, fin_upd, px=px,
         dstate=jnp.where(exf, DIR_MODIFIED, sh_dstate).astype(jnp.uint8),
         owner=jnp.where(exf, r, sh_owner),
         sharers=jnp.where(exf[:, None], rbit_words,
@@ -1140,7 +1250,8 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
 
 
 def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
-                 sync_dir_l2, sync_dir_net, enabled, progress):
+                 sync_dir_l2, sync_dir_net, enabled, progress,
+                 px: ParallelCtx = IDENT):
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     mail = ms.mail
@@ -1180,9 +1291,11 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
 
     # ---- directory entry lookup / allocation -----------------------------
     d = ms.directory
-    sets, dfound, way = _dir_lookup(mp, d, rline)
+    dsv = _DirSetView(px, d, rline, mp)
+    sets = dsv.sets
+    dfound, way = dsv.lookup()
+    tag_row, nsh_row = dsv.rows()
     # free way if no match (tags == -1)
-    tag_row, nsh_row = _dir_row(d, sets)               # [T, DW] each
     free_ways = tag_row == -1
     any_free = free_ways.any(axis=1)
     free_way = jnp.argmax(free_ways, axis=1).astype(jnp.int32)
@@ -1193,7 +1306,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     need_nullify = starting & ~dfound & ~any_free
 
     # victim entry contents (for the NULLIFY transaction)
-    v_line, v_dstate, v_owner, v_sharers, v_nsh = _dir_gather(d, sets, alloc_way)
+    v_line, v_dstate, v_owner, v_sharers, v_nsh = dsv.entry(alloc_way)
 
     # the new entry's install (the reference's `replaceDirectoryEntry`
     # immediate swap) is merged into the immediate-finish update below —
@@ -1284,7 +1397,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     # when dfound).
     upd = is_new | imm
     d = _dir_update(
-        d, sets, alloc_way, upd,
+        d, sets, alloc_way, upd, px=px,
         tags=jnp.where(is_new, rline, v_line),
         dstate=jnp.where(
             imm, jnp.where(imm_ex, DIR_MODIFIED, DIR_SHARED),
@@ -1362,7 +1475,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         # drop the victim from the entry now — its INV/FLUSH ack is consumed
         # by this transaction, not the eviction path (one txn per home)
         d = _dir_update(
-            d, sets, alloc_way, sh_over,
+            d, sets, alloc_way, sh_over, px=px,
             sharers=v_sharers & ~victim_bits,
             nsharers=v_nsh - 1,
             owner=jnp.where(victim_is_owner, -1, v_owner),
@@ -1380,7 +1493,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         fwd_msg = jnp.where(sh_over_m, MSG_FLUSH_REQ, fwd_msg).astype(
             jnp.uint8)
         d = _dir_update(
-            d, sets, alloc_way, sh_over_m,
+            d, sets, alloc_way, sh_over_m, px=px,
             sharers=jnp.zeros((T, mp.sharer_words), U32),
             nsharers=jnp.zeros(T, jnp.int32),
             owner=jnp.full(T, -1, jnp.int32),
@@ -1465,7 +1578,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
 
 
 def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
-                    progress, sync_l2_net):
+                    progress, sync_l2_net, px: ParallelCtx = IDENT):
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     mail = ms.mail
@@ -1478,9 +1591,22 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
     line = ms.req.line
     comp_l1i = ms.req.component == MOD_L1I
 
+    # block-local row gathers at the filled line (+ the pre-update
+    # miss-type test bits — the victim's own bitmap write is folded back
+    # in below via the bucket-collision correction)
+    line_l = px.lo(line)
+    rows_l = (ca.gather_row(ms.l2, line_l, px.lo_const(mp.l2.sets_mod)),
+              ca.gather_row(ms.l1i, line_l, px.lo_const(mp.l1i.sets_mod)),
+              ca.gather_row(ms.l1d, line_l, px.lo_const(mp.l1d.sets_mod)))
+    if mp.l2.track_miss_types:
+        mt_bits_l = (_mt_test(ms.mt, MT_EVICTED, line_l),
+                     _mt_test(ms.mt, MT_INVALIDATED, line_l))
+    else:
+        mt_bits_l = ()
+    (l2_r, l1i_r, l1d_r), mt_bits = _rows_exchange(px, rows_l, mt_bits_l)
+
     # L2 victim for the fill; a valid victim emits an eviction message that
     # needs its (home, us) EVICT cell free — else stall this iteration
-    l2_r = ca.gather_row(ms.l2, line, mp.l2.sets_mod)
     way, v_valid, v_line, v_state = ca.row_pick_victim(
         l2_r, mp.l2.replacement, mp.l2.ways_limit)
     v_home_all = jnp.asarray(mp.mc_tiles, jnp.int32)[
@@ -1491,15 +1617,13 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
     evict_go = need_evict & fill
 
     new_state = jnp.where(mail.rep_type == MSG_EX_REP, MODIFIED, SHARED)
-    l2 = ca.scatter_row(ms.l2, ca.row_insert(l2_r, line, way, new_state,
-                                             fill))
+    l2 = ca.scatter_row(ms.l2, px.lo(ca.row_insert(l2_r, line, way,
+                                                   new_state, fill)))
     sets = (line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-    cur_cloc = ms.l2_cloc[tiles, sets, way]
-    l2_cloc = ms.l2_cloc.at[tiles, sets, way].add(
-        jnp.where(fill,
-                  jnp.where(comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8)
-                  - cur_cloc,
-                  jnp.zeros_like(cur_cloc)))
+    l2_cloc = px.entry_set(
+        ms.l2_cloc, *px.lo((
+            sets, way, fill,
+            jnp.where(comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8))))
 
     # eviction message (FLUSH_REP if dirty — MODIFIED, or OWNED in MOSI —
     # else INV_REP; `insertCacheLine`, `l2_cache_cntlr.cc:75-116`, mosi
@@ -1532,46 +1656,43 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
         rep_time=jnp.where(fill, 0, mail.rep_time),
     )
 
-    # L1 fill
+    # L1 fill (the rows were gathered in the phase exchange above)
     l1_state = new_state  # L1 gets the L2 state (`insertCacheLineInL1`)
-    l1i_r = ca.gather_row(ms.l1i, line, mp.l1i.sets_mod)
-    l1d_r = ca.gather_row(ms.l1d, line, mp.l1d.sets_mod)
     l1i_way, l1i_vv, l1i_vline, _ = ca.row_pick_victim(
         l1i_r, mp.l1i.replacement, mp.l1i.ways_limit)
     l1d_way, l1d_vv, l1d_vline, _ = ca.row_pick_victim(
         l1d_r, mp.l1d.replacement, mp.l1d.ways_limit)
     l1i = ca.scatter_row(
-        ms.l1i, ca.row_insert(l1i_r, line, l1i_way, l1_state,
-                              fill & comp_l1i))
+        ms.l1i, px.lo(ca.row_insert(l1i_r, line, l1i_way, l1_state,
+                                    fill & comp_l1i)))
     l1d = ca.scatter_row(
-        ms.l1d, ca.row_insert(l1d_r, line, l1d_way, l1_state,
-                              fill & ~comp_l1i))
-    # clear cached-loc of L1 victims in L2
+        ms.l1d, px.lo(ca.row_insert(l1d_r, line, l1d_way, l1_state,
+                                    fill & ~comp_l1i)))
+    # clear cached-loc of L1 victims in L2 (block-local RMW chain)
     l1_ev = (fill & comp_l1i & l1i_vv) | (fill & ~comp_l1i & l1d_vv)
     l1_ev_line = jnp.where(comp_l1i, l1i_vline, l1d_vline)
-    ev_hit, ev_way, _ = ca.lookup(l2, l1_ev_line, mp.l2.sets_mod)
-    ev_sets = (l1_ev_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-    cur_cloc2 = l2_cloc[tiles, ev_sets, ev_way]
-    l2_cloc = l2_cloc.at[tiles, ev_sets, ev_way].add(
-        jnp.where(l1_ev & ev_hit, -cur_cloc2, jnp.zeros_like(cur_cloc2)))
+    ev_line_l = px.lo(l1_ev_line)
+    l2_mod_l = px.lo_const(mp.l2.sets_mod)
+    ev_hit_l, ev_way_l, _ = ca.lookup(l2, ev_line_l, l2_mod_l)
+    ev_sets_l = (ev_line_l % jnp.asarray(l2_mod_l)).astype(jnp.int32)
+    l2_cloc = px.entry_set(l2_cloc, ev_sets_l, ev_way_l,
+                           px.lo(l1_ev) & ev_hit_l, 0)
 
     if mp.l2.track_miss_types:
-        from graphite_tpu.memory.state import (
-            MT_EVICTED, MT_FETCHED, MT_INVALIDATED,
-        )
-
         mt = ms.mt
         # victim -> evicted set (`insertCacheLine` eviction branch)
-        mt = _mt_update(mt, MT_EVICTED, v_line, evict_go, True)
+        mt = _mt_update(mt, MT_EVICTED, px.lo(v_line), px.lo(evict_go), True)
         # inserted line: clearMissTypeTrackingSets erases from exactly
         # ONE set (evicted elif invalidated elif fetched), then the
-        # fetched set gains the line
-        e_in = _mt_test(mt, MT_EVICTED, line)
-        i_in = _mt_test(mt, MT_INVALIDATED, line)
-        mt = _mt_update(mt, MT_EVICTED, line, fill & e_in, False)
-        mt = _mt_update(mt, MT_INVALIDATED, line, fill & ~e_in & i_in,
-                        False)
-        mt = _mt_update(mt, MT_FETCHED, line, fill, True)
+        # fetched set gains the line.  The tests must see the victim's
+        # just-applied EVICTED bit; the exchanged pre-write bit is
+        # corrected for a same-bucket victim write instead of re-reading.
+        e_in = mt_bits[0] | (evict_go & _mt_same_bucket(v_line, line))
+        i_in = mt_bits[1]
+        mt = _mt_update(mt, MT_EVICTED, line_l, px.lo(fill & e_in), False)
+        mt = _mt_update(mt, MT_INVALIDATED, line_l,
+                        px.lo(fill & ~e_in & i_in), False)
+        mt = _mt_update(mt, MT_FETCHED, line_l, px.lo(fill), True)
         ms = ms.replace(mt=mt)
 
     req = ms.req.replace(

@@ -205,7 +205,14 @@ def shl2_engine_step(
     freq_mhz: jax.Array,
     active: jax.Array,
     enabled,
+    px=None,
 ) -> MemStepOut:
+    if px is not None and px.sharded:
+        # shared-L2 multichip runs ride the GSPMD specs path (the
+        # Simulator routes them there); the packed shard_map exchange
+        # currently covers the private-L2 engines
+        raise NotImplementedError(
+            "shard_map exchange not yet wired for the shared-L2 engine")
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     fmhz = freq_mhz.astype(I64)

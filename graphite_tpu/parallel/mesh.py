@@ -104,6 +104,129 @@ def shard_window(window: DeviceTrace, mesh: Mesh, bases) -> tuple:
     return window, bases
 
 
+# --------------------------------------------------------------------------
+# The packed shard_map path (the default multi-chip runner).
+#
+# Unlike the GSPMD specs above — which shard every tile-major array and let
+# the partitioner insert one small collective per scatter (~270/iteration,
+# measured 16x SLOWER than single-device at 8 devices; PERF.md) — the
+# shard_map program keeps exactly the BIG per-tile arrays block-local and
+# recomputes all [T]-vector control state replicated on every device, so
+# the only collectives are the engine's packed per-phase row exchanges
+# (parallel/px.py; ~7 per subquantum iteration).  This is the TPU-native
+# form of the reference's process striping: big state partitioned like the
+# per-process tile models (`config.cc` computeProcessToTileMapping), small
+# control traffic exchanged like its TCP messages (`socktransport.cc`).
+
+# state leaves that are block-local under shard_map (dotted field paths);
+# everything else is replicated
+_SHARD_MAP_LOCAL = {
+    "core.bp_bits",
+    "mem.l1i.meta", "mem.l1d.meta", "mem.l2.meta",
+    "mem.l2_cloc", "mem.mt",
+    "mem.directory.tags", "mem.directory.dstate", "mem.directory.owner",
+    "mem.directory.sharers", "mem.directory.nsharers",
+}
+
+
+def _path_name(path) -> str:
+    names = []
+    for p in path:
+        n = getattr(p, "name", None)
+        if n is not None:
+            names.append(str(n))
+    return ".".join(names)
+
+
+def shard_map_state_specs(state: SimState):
+    """PartitionSpec tree for the shard_map path: big arrays block-local
+    on the tile axis, everything else replicated."""
+
+    def spec(path, leaf):
+        if _path_name(path) in _SHARD_MAP_LOCAL:
+            return P(TILE_AXIS, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, state)
+
+
+def shard_map_trace_specs(trace: DeviceTrace):
+    return jax.tree.map(lambda leaf: P(TILE_AXIS, None), trace)
+
+
+def place_shard_map(state: SimState, mesh: Mesh, trace=None):
+    """Device-put state (and optionally the trace) with the shard_map
+    layout so the jitted runner starts without a resharding pass."""
+    n_tiles = state.core.clock_ps.shape[0]
+    n_dev = mesh.devices.size
+    if n_tiles % n_dev != 0:
+        raise ValueError(
+            f"tile count {n_tiles} not divisible by mesh size {n_dev}")
+    state = jax.device_put(state, jax.tree.map(
+        lambda s: NamedSharding(mesh, s), shard_map_state_specs(state),
+        is_leaf=lambda x: isinstance(x, P)))
+    if trace is None:
+        return state
+    trace = jax.device_put(trace, jax.tree.map(
+        lambda s: NamedSharding(mesh, s), shard_map_trace_specs(trace),
+        is_leaf=lambda x: isinstance(x, P)))
+    return state, trace
+
+
+def place_shard_map_window(window: DeviceTrace, mesh: Mesh, bases):
+    """Place one streamed [T, W] trace window (block-local rows) + its
+    per-tile base vector (replicated control state — the engine lo()s it
+    for local reads) for the shard_map runner."""
+    import jax.numpy as jnp
+
+    window = jax.device_put(window, jax.tree.map(
+        lambda s: NamedSharding(mesh, s), shard_map_trace_specs(window),
+        is_leaf=lambda x: isinstance(x, P)))
+    bases = jax.device_put(jnp.asarray(bases), NamedSharding(mesh, P()))
+    return window, bases
+
+
+def make_shard_map_runner(params, quantum_ps, max_quanta: int, mesh: Mesh,
+                          state_example: SimState, trace_example,
+                          streamed: bool = False):
+    """The jitted multi-chip runner: run_simulation under jax.shard_map
+    with the packed px exchange.  Takes (state, trace[, trace_base]) —
+    the trace is an argument (not a closure) so streamed windows shard.
+
+    check_vma=False: control state is replicated by construction (same
+    deterministic integer math from identical inputs on every device) and
+    the big arrays' collectives are the explicit px exchanges — the
+    varying-axis checker cannot see either invariant."""
+    from graphite_tpu.engine.step import run_simulation
+    from graphite_tpu.parallel.px import ParallelCtx
+
+    px = ParallelCtx(axis=TILE_AXIS, n_dev=int(mesh.devices.size))
+    state_specs = shard_map_state_specs(state_example)
+    trace_specs = shard_map_trace_specs(trace_example)
+
+    if streamed:
+        def body(st, tr, base):
+            return run_simulation(params, tr, st, quantum_ps, max_quanta,
+                                  trace_base=base, px=px)
+
+        sm = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(state_specs, trace_specs, P()),
+            out_specs=(state_specs, P(), P()),
+            check_vma=False)
+        return jax.jit(sm)
+
+    def body(st, tr):
+        return run_simulation(params, tr, st, quantum_ps, max_quanta, px=px)
+
+    sm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(state_specs, trace_specs),
+        out_specs=(state_specs, P(), P()),
+        check_vma=False)
+    return jax.jit(sm)
+
+
 def shard_sim(
     state: SimState, trace: DeviceTrace, mesh: Mesh
 ) -> tuple[SimState, DeviceTrace]:

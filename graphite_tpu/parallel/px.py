@@ -1,0 +1,147 @@
+"""The packed shard_map exchange context (``ParallelCtx``).
+
+The multi-chip form of the engine runs ONE program per device under
+``jax.shard_map``: big per-tile arrays (trace, cache meta words, the
+directory, branch-predictor bits, miss-type bitmaps) live block-local —
+each device holds rows ``[i*Tl, (i+1)*Tl)`` of the tile axis — while every
+per-lane ``[T]`` control vector, the ``[T, T]`` mailbox matrices, the sync
+tables and the NoC state stay REPLICATED and are recomputed identically on
+every device (integer math, deterministic, so the replicas cannot diverge).
+
+Cross-device data motion is then exactly the engine's phase structure:
+each protocol phase gathers its lanes' rows from the block-local arrays,
+packs every gathered field into ONE ``[Tl, K]`` int64 descriptor, and
+all-gathers it — a handful of collectives per subquantum iteration instead
+of the ~270 tiny per-scatter collectives GSPMD inserts for the same
+program (PERF.md "Multi-device step wall-clock"; the reference's analog of
+this exchange is the process-striped directory traffic over
+`common/transport/socktransport.cc`, one TCP message per protocol hop).
+
+``ParallelCtx`` is threaded through `engine/step.py` and
+`memory/engine.py`; the default ``IDENT`` context makes every operation an
+identity, so the single-device path compiles to exactly the program it
+always was.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+I64 = jnp.int64
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelCtx:
+    """Identity (single-device) or shard_map (per-device block) context.
+
+    axis: mesh axis name the tile dimension is sharded over, or None.
+    n_dev: number of devices on that axis.
+    """
+
+    axis: str | None = None
+    n_dev: int = 1
+
+    @property
+    def sharded(self) -> bool:
+        return self.axis is not None
+
+    # -- local block addressing ------------------------------------------
+
+    def lo(self, tree):
+        """Slice full [T, ...] arrays down to this device's [Tl, ...] block
+        (identity when single-device).  Works on pytrees."""
+        if not self.sharded:
+            return tree
+
+        def f(x):
+            T = x.shape[0]
+            Tl = T // self.n_dev
+            i = jax.lax.axis_index(self.axis)
+            return jax.lax.dynamic_slice_in_dim(x, i * Tl, Tl, axis=0)
+
+        return jax.tree.map(f, tree)
+
+    # -- the packed exchange ---------------------------------------------
+
+    def ag(self, tree):
+        """All-gather local [Tl, ...] arrays to full [T, ...] via ONE
+        packed [Tl, K] int64 collective (identity when single-device).
+
+        Every leaf is flattened to [Tl, k_i], widened to int64, and
+        concatenated; the single tiled all_gather moves the whole
+        descriptor; leaves are then split back out and narrowed.  One
+        collective per call regardless of how many fields ride it —
+        per-collective latency, not bytes, is what the virtual mesh (and
+        real ICI) charges for."""
+        if not self.sharded:
+            return tree
+        leaves, tdef = jax.tree.flatten(tree)
+        if not leaves:
+            return tree
+        cols = []
+        meta = []
+        for leaf in leaves:
+            k = 1
+            for d in leaf.shape[1:]:
+                k *= d
+            meta.append((leaf.shape, leaf.dtype, k))
+            flat = leaf.reshape(leaf.shape[0], k)
+            if leaf.dtype == jnp.uint32:
+                # widen via uint64 so values >= 2^31 survive the round trip
+                flat = flat.astype(jnp.uint64).astype(I64)
+            else:
+                flat = flat.astype(I64)
+            cols.append(flat)
+        buf = cols[0] if len(cols) == 1 else jnp.concatenate(cols, axis=1)
+        full = jax.lax.all_gather(buf, self.axis, axis=0, tiled=True)
+        out = []
+        off = 0
+        for shape, dtype, k in meta:
+            piece = full[:, off:off + k]
+            off += k
+            if dtype == jnp.uint32:
+                piece = piece.astype(jnp.uint64).astype(dtype)
+            elif dtype == jnp.bool_:
+                piece = piece != 0
+            else:
+                piece = piece.astype(dtype)
+            out.append(piece.reshape((full.shape[0],) + tuple(shape[1:])))
+        return jax.tree.unflatten(tdef, out)
+
+    def lo_const(self, x):
+        """lo() for compile-time per-tile constants: ints and None pass
+        through, [T]-shaped tables are sliced (e.g. heterogeneous cache
+        set moduli)."""
+        if x is None or isinstance(x, int) or not hasattr(x, "shape"):
+            return x
+        if len(getattr(x, "shape", ())) == 0:
+            return x
+        return self.lo(jnp.asarray(x))
+
+    # -- local per-lane writes (operands already block-local) ------------
+
+    def lane_col_add(self, arr, col, delta):
+        """``arr[t, col[t]] += delta[t]`` on this device's rows; arr is
+        block-local [Tl, K] and col/delta are block-local [Tl] (callers
+        px.lo replicated operands first)."""
+        lt = jnp.arange(arr.shape[0], dtype=jnp.int32)
+        return arr.at[lt, col].add(delta.astype(arr.dtype))
+
+    def entry_set(self, arr, sets, way, mask, value):
+        """``arr[t, sets[t], way[t]] = value[t] where mask[t]`` on this
+        device's rows; arr is block-local [Tl, S, W] and every operand is
+        block-local [Tl] (callers px.lo replicated operands first; value
+        may be a scalar).  Written add-a-delta so the scatter aliases in
+        place (per-lane rows are unique)."""
+        lt = jnp.arange(arr.shape[0], dtype=jnp.int32)
+        cur = arr[lt, sets, way]
+        value = jnp.broadcast_to(jnp.asarray(value, arr.dtype), cur.shape)
+        return arr.at[lt, sets, way].add(
+            jnp.where(mask, value - cur, jnp.zeros_like(cur)),
+            unique_indices=True, indices_are_sorted=True)
+
+
+IDENT = ParallelCtx()

@@ -72,7 +72,7 @@ def run_config(n: int, small: bool):
         mesh = make_tile_mesh()
         label = (f"{tiles}-tile sharded blackscholes "
                  f"({mesh.devices.size}-device mesh)")
-        return label, Simulator(sc, batch, mailbox_depth=8, mesh=mesh)
+        return label, Simulator(sc, batch, mesh=mesh)
     elif n == 5:
         tiles = 1024 // scale
         text = _cfg(tiles, shared_mem=True, dvfs=True)
@@ -87,12 +87,10 @@ def run_config(n: int, small: bool):
         batch = canneal_trace(tiles, footprint_lines=4096,
                               swaps_per_tile=8 if small else 16)
         label = f"{tiles}-tile +DVFS+power canneal"
-        # canneal sends no CAPI messages: depth-2 user-net rings keep the
-        # [T,T,depth] arrays small next to the 2GB directory at 1024 tiles
-        return label, Simulator(sc, batch, mailbox_depth=2)
+        return label, Simulator(sc, batch)
     else:
         raise SystemExit(f"no config {n}")
-    return label, Simulator(sc, batch, mailbox_depth=8)
+    return label, Simulator(sc, batch)
 
 
 def main() -> int:

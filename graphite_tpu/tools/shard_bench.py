@@ -24,13 +24,13 @@ import jax
 import numpy as np
 
 
-def _timed(sc, batch, mesh, repeats=3):
+def _timed(sc, batch, mesh, repeats=3, spmd=None):
     """Best-of-N wall-clock of the compiled run, compile excluded: warm up
     and time the SAME Simulator instance (each instance owns its own jitted
     runner), restoring the initial state between repeats."""
     from graphite_tpu.engine.simulator import Simulator
 
-    sim = Simulator(sc, batch, mesh=mesh)
+    sim = Simulator(sc, batch, mesh=mesh, spmd=spmd)
     init_state = sim.state
     sim.warmup()
     best = float("inf")
@@ -57,26 +57,31 @@ def main():
     from graphite_tpu.trace import synthetic
 
     n_dev = len(jax.devices())
+    mesh = make_tile_mesh(n_dev)
     results = []
 
     # workload 1: full-MSI coherence stress (the [T, T] mailbox path)
     sc, batch = coherence_stress_workload(64, n_accesses=200)
     t1, r1 = _timed(sc, batch, None)
-    t8, r8 = _timed(sc, batch, make_tile_mesh(n_dev))
-    np.testing.assert_array_equal(r1.clock_ps, r8.clock_ps)
-    results.append(("msi_stress_64t", t1, t8))
+    tsm, rsm = _timed(sc, batch, mesh)  # shard_map (default)
+    np.testing.assert_array_equal(r1.clock_ps, rsm.clock_ps)
+    tg, rg = _timed(sc, batch, mesh, spmd="gspmd")
+    np.testing.assert_array_equal(r1.clock_ps, rg.clock_ps)
+    results.append(("msi_stress_64t", t1, tsm, tg))
 
     # workload 2: memoryless message ring (the USER-net mailbox path)
     sc2 = SimConfig(ConfigFile.from_string(config_text(64)))
     batch2 = synthetic.message_ring_batch(64, n_rounds=64,
                                           compute_per_round=8)
     t1b, _ = _timed(sc2, batch2, None)
-    t8b, _ = _timed(sc2, batch2, make_tile_mesh(n_dev))
-    results.append(("ring_64t", t1b, t8b))
+    tsmb, _ = _timed(sc2, batch2, mesh)
+    tgb, _ = _timed(sc2, batch2, mesh, spmd="gspmd")
+    results.append(("ring_64t", t1b, tsmb, tgb))
 
-    for name, a, b in results:
-        print(f"{name}: single={a*1e3:.0f} ms  {n_dev}dev={b*1e3:.0f} ms  "
-              f"ratio={b/a:.2f}x")
+    for name, a, b, c in results:
+        print(f"{name}: single={a*1e3:.0f} ms  "
+              f"{n_dev}dev shard_map={b*1e3:.0f} ms ({b/a:.2f}x)  "
+              f"{n_dev}dev gspmd={c*1e3:.0f} ms ({c/a:.2f}x)")
     return results
 
 

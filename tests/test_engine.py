@@ -563,3 +563,37 @@ def test_summary_renders():
     assert "Tile 0 Summary" in text
     assert "Total Instructions" in text
     assert "Average Packet Latency" in text
+
+
+class TestAutoMailboxDepth:
+    """Trace-derived [T, T, depth] ring sizing (simulator.py
+    auto_mailbox_depth): barrier-phased workloads get their exact
+    in-flight bound, unphased streams hit the documented cap, and an
+    auto-sized run is bit-identical to a generously-sized one."""
+
+    def test_barrier_phased_traces_size_minimal(self):
+        from graphite_tpu.engine.simulator import auto_mailbox_depth
+        from graphite_tpu.trace.benchmarks import fft_trace
+
+        assert auto_mailbox_depth(fft_trace(16, points_per_tile=64)) == 2
+        assert auto_mailbox_depth(
+            synthetic.memory_stress_trace(
+                16, n_accesses=10, working_set_bytes=1 << 12,
+                write_fraction=0.4, shared_fraction=0.5, seed=3)) == 2
+
+    def test_unphased_stream_capped(self):
+        from graphite_tpu.engine.simulator import auto_mailbox_depth
+
+        b = synthetic.message_ring_batch(8, n_rounds=200,
+                                         compute_per_round=1)
+        assert auto_mailbox_depth(b) == 64
+
+    def test_auto_depth_run_matches_explicit(self):
+        sc = make_config(n_tiles=8, scheme="lax")
+        tb = synthetic.message_ring_batch(8, n_rounds=4,
+                                          compute_per_round=2)
+        ra = Simulator(sc, tb).run()          # auto-sized
+        rb = Simulator(sc, tb, mailbox_depth=32).run()
+        assert ra.clock_ps.tolist() == rb.clock_ps.tolist()
+        assert (ra.instruction_count.tolist()
+                == rb.instruction_count.tolist())

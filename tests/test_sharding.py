@@ -73,15 +73,18 @@ SHL2_MSI = "pr_l1_sh_l2_msi"
 SHL2_MESI = "pr_l1_sh_l2_mesi"
 
 
-def _make_mem_sim(n_tiles=64, proto=MSI, mesh=None):
+def _make_mem_sim(n_tiles=64, proto=MSI, mesh=None, spmd=None):
     from graphite_tpu.tools._template import coherence_stress_workload
 
     sc, batch = coherence_stress_workload(n_tiles, protocol=proto)
-    return Simulator(sc, batch, mesh=mesh)
+    return Simulator(sc, batch, mesh=mesh, spmd=spmd)
 
 
 @pytest.mark.parametrize("proto", [MSI, MOSI, SHL2_MSI, SHL2_MESI])
 def test_sharded_coherence_matches_single_device(proto):
+    # private-L2 protocols ride the packed shard_map exchange (the
+    # default); shared-L2 falls back to GSPMD specs — both must be
+    # bit-identical to the single-device run
     ra = _make_mem_sim(proto=proto).run()
     rb = _make_mem_sim(proto=proto, mesh=make_tile_mesh(8)).run()
 
@@ -97,6 +100,26 @@ def test_sharded_coherence_matches_single_device(proto):
     assert ra.func_errors == 0 and rb.func_errors == 0
     # vacuity guard: the equality above must be over real protocol traffic
     assert int(np.asarray(ra.mem_counters["l2_misses"]).sum()) > 0
+
+
+def test_default_mesh_program_selection():
+    # shard_map is the default multi-chip program for private-L2 /
+    # memoryless runs; shared-L2 auto-falls back to GSPMD until its
+    # engine takes the exchange context
+    mesh = make_tile_mesh(8)
+    assert _make_mem_sim(proto=MSI, mesh=mesh).spmd == "shard_map"
+    assert _make_mem_sim(proto=SHL2_MSI, mesh=mesh).spmd == "gspmd"
+    assert _make_sim(64, mesh=mesh).spmd == "shard_map"
+
+
+def test_gspmd_coherence_still_matches_single_device():
+    # the legacy whole-program-partitioning path stays available (and
+    # bit-identical) behind spmd="gspmd"
+    ra = _make_mem_sim(proto=MSI).run()
+    rb = _make_mem_sim(proto=MSI, mesh=make_tile_mesh(8),
+                       spmd="gspmd").run()
+    np.testing.assert_array_equal(ra.clock_ps, rb.clock_ps)
+    np.testing.assert_array_equal(ra.instruction_count, rb.instruction_count)
 
 
 def test_sharded_coherence_state_layout():
