@@ -300,12 +300,19 @@ class Simulator:
         mesh=None,
         stream: bool = False,
         spmd: str | None = None,
+        donate: bool = False,
     ):
         """`spmd` (mesh runs only): "shard_map" — the packed-exchange
         multi-chip program (parallel/px.py; the default where supported) —
         or "gspmd" — whole-program partitioning via sharding specs (the
         legacy path; also the automatic fallback for the shared-L2
-        protocols until their engine takes the exchange context)."""
+        protocols until their engine takes the exchange context).
+
+        `donate=True` gives the input state's device buffers to XLA each
+        run (halves big-state HBM residency — required for the 1024-tile
+        full-directory coherence runs, PERF.md); the pre-run state object
+        becomes unusable, so warmup()/state-restoring repeat patterns
+        must keep the default."""
         if isinstance(config, str):
             config = ConfigFile.from_file(config)
         if isinstance(config, ConfigFile):
@@ -546,6 +553,11 @@ class Simulator:
                     self.state, self.device_trace = shard_sim(
                         self.state, self.device_trace, mesh
                     )
+        self.donate = bool(donate)
+        # subquantum iterations executed by the last run (device loop
+        # observability: wall / iterations = the engine's per-iteration
+        # cost, the number PERF.md's floor analysis tracks)
+        self.last_n_iterations = 0
         self._runner = None
         self._runner_max_quanta = None
 
@@ -564,7 +576,7 @@ class Simulator:
 
                 self._runner = make_simulation_runner(
                     self.params, self.device_trace, self.quantum_ps,
-                    max_quanta)
+                    max_quanta, donate=self.donate)
             self._runner_max_quanta = max_quanta
         return self._runner
 
@@ -574,10 +586,11 @@ class Simulator:
         Returns (done, quanta_executed).  Unlike run(), hitting the bound
         is not an error — the caller samples/checkpoints and continues.
         """
-        state, n_quanta_dev, deadlock_dev = self._get_runner(n_quanta)(
-            self.state)
-        nq, deadlock, overflow, done = jax.device_get((
-            n_quanta_dev, deadlock_dev, state.net.overflow, state.done))
+        state, n_quanta_dev, deadlock_dev, n_iters = self._get_runner(
+            n_quanta)(self.state)
+        nq, deadlock, overflow, done, self.last_n_iterations = (
+            jax.device_get((n_quanta_dev, deadlock_dev, state.net.overflow,
+                            state.done, n_iters)))
         if bool(overflow):
             raise MailboxOverflowError(
                 "a (dst,src) mailbox ring overflowed; re-run with a "
@@ -717,7 +730,7 @@ class Simulator:
                 prefetch = place(DeviceTrace.window(batch, guess, W), guess)
             else:
                 prefetch_bases = None
-            state, nq_dev, deadlock_dev = out
+            state, nq_dev, deadlock_dev, n_iters_dev = out
             done, idx, deadlock, overflow = jax.device_get(
                 (state.done, state.core.idx, deadlock_dev,
                  state.net.overflow))
@@ -774,17 +787,17 @@ class Simulator:
         `lax_barrier_sync_server.h:12-36`).  A quantum with zero progress
         while some tile was eligible to run is a genuine deadlock.
         """
-        state, n_quanta_dev, deadlock_dev = self._get_runner(max_quanta)(
-            self.state)
+        state, n_quanta_dev, deadlock_dev, n_iters = self._get_runner(
+            max_quanta)(self.state)
         # ONE batched device→host fetch for control flags + all summary
         # counters (each separate read over a tunneled chip costs ~100 ms).
         net_part, mem_part, ioc_part = self._result_parts(state)
         host = jax.device_get((
             n_quanta_dev, deadlock_dev, state.net.overflow, state.done,
-            state.core, net_part, mem_part, ioc_part,
+            state.core, net_part, mem_part, ioc_part, n_iters,
         ))
         (n_quanta, deadlock, overflow, done, core_h, net_h, mem_h,
-         ioc_h) = host
+         ioc_h, self.last_n_iterations) = host
         if bool(overflow):
             raise MailboxOverflowError(
                 "a (dst,src) mailbox ring overflowed; re-run with a "

@@ -52,12 +52,15 @@ class UserNetState:
 
     Replaces the reference's per-tile `_netQueue` + condition variable
     (`network.cc:358-460`) and the TCP transport underneath: slot
-    [dst, src, k] holds the k-th in-flight packet from src to dst.  Each
+    [dst, k, src] holds the k-th in-flight packet from src to dst.  Each
     sender lane writes only its own src column, so scatters never collide.
+    The slot axis sits OUTSIDE the src axis so the minor dimension is the
+    tile count: a [T, T, D] layout pads D up to the 128-lane tile on TPU
+    (64x physical blowup at depth 2 — PERF.md "array padding").
     """
 
-    time_ps: jax.Array     # int64[T, T, D] — arrival time at receiver
-    lat_ps: jax.Array      # int32[T, T, D] — zero-load delay (for stats)
+    time_ps: jax.Array     # int64[T, D, T] — arrival time at receiver
+    lat_ps: jax.Array      # int32[T, D, T] — zero-load delay (for stats)
     head: jax.Array        # int32[T, T] — total pushes (mod D write slot)
     count: jax.Array       # int32[T, T] — in-flight entries
     overflow: jax.Array    # bool[]     — any ring exceeded D (sim invalid)
@@ -235,8 +238,8 @@ def init_state(
         bp_incorrect=jnp.zeros(T, i64),
     )
     net = UserNetState(
-        time_ps=jnp.zeros((T, T, D), i64),
-        lat_ps=jnp.zeros((T, T, D), jnp.int32),
+        time_ps=jnp.zeros((T, D, T), i64),
+        lat_ps=jnp.zeros((T, D, T), jnp.int32),
         head=jnp.zeros((T, T), jnp.int32),
         count=jnp.zeros((T, T), jnp.int32),
         overflow=jnp.zeros((), jnp.bool_),

@@ -94,6 +94,13 @@ class EngineParams:
     # gate when the memory state (directory sharer maps dominate at large
     # tile counts) is too big to duplicate in HBM.
     mem_gate: bool = True
+    # Run the net/barrier/mutex/pub/join machinery unconditionally
+    # instead of behind their any-lane-active lax.conds.  The conds are a
+    # pure wall-clock optimization (skip scatter kernels on quiet
+    # iterations); disabling them works around an XLA TPU kernel fault
+    # observed at 1024 tiles x full directory on send-heavy traces
+    # (PERF.md "Known limitation").
+    block_gates: bool = True
     # lax_p2p clock-skew scheme (`lax_p2p_sync_client.h:13-83`): when set,
     # each iteration every tile draws a pseudorandom partner and advances
     # only if its clock is within `slack` of the partner's — the
@@ -227,6 +234,11 @@ def subquantum_iteration(
         p2p_round = state.p2p_round + 1
     else:
         p2p_round = state.p2p_round
+
+    def _gate(pred):
+        # block_gates=False forces every machinery cond down its live
+        # branch (constant predicate folds the cond away entirely)
+        return pred if params.block_gates else jnp.asarray(True)
 
     # --- memory subsystem (caches + coherence protocol) ------------------
     # Runs every iteration: requester lanes start/advance their record's
@@ -376,12 +388,12 @@ def subquantum_iteration(
         # updates the loop-carried mailbox buffers in place instead of
         # copying ~100MB per iteration.
         w_dst = jnp.where(send_now, dst, tiles)
-        old_time = net.time_ps[w_dst, tiles, slot]
-        old_lat = net.lat_ps[w_dst, tiles, slot]
-        time_ps_new = net.time_ps.at[w_dst, tiles, slot].add(
+        old_time = net.time_ps[w_dst, slot, tiles]
+        old_lat = net.lat_ps[w_dst, slot, tiles]
+        time_ps_new = net.time_ps.at[w_dst, slot, tiles].add(
             jnp.where(send_now, arrival_ps - old_time, 0)
         )
-        lat_arr_new = net.lat_ps.at[w_dst, tiles, slot].add(
+        lat_arr_new = net.lat_ps.at[w_dst, slot, tiles].add(
             jnp.where(send_now, lat_ps.astype(jnp.int32) - old_lat, 0)
         )
         head_new = net.head.at[w_dst, tiles].add(jnp.where(send_now, 1, 0))
@@ -400,7 +412,7 @@ def subquantum_iteration(
         def _any_src(_):
             tail = ((head_new - count_sent) % D).astype(jnp.int32)  # [T, T]
             tail_times = jnp.take_along_axis(
-                time_ps_new, tail[:, :, None], axis=2)[:, :, 0]
+                time_ps_new, tail[:, None, :], axis=1)[:, 0, :]
             masked_times = jnp.where(
                 count_sent > 0, tail_times, FAR_FUTURE_PS)
             return jnp.argmin(masked_times, axis=1).astype(jnp.int32)
@@ -414,8 +426,8 @@ def subquantum_iteration(
             jnp.int32)
         matched = sel_count > 0
         recv_time = jnp.where(
-            matched, time_ps_new[tiles, want_src, sel_tail], FAR_FUTURE_PS)
-        recv_lat = lat_arr_new[tiles, want_src, sel_tail]
+            matched, time_ps_new[tiles, sel_tail, want_src], FAR_FUTURE_PS)
+        recv_lat = lat_arr_new[tiles, sel_tail, want_src]
         recv_now = active & is_recv & matched
         # pop (count -1)
         count_new = count_sent.at[tiles, want_src].add(
@@ -433,7 +445,8 @@ def subquantum_iteration(
 
     (time_ps_new, lat_arr_new, head_new, count_new, overflow, noc_user,
      recv_now, recv_time, recv_lat) = lax.cond(
-        jnp.any(send_now | (active & is_recv)), _net_block, _net_skip, None)
+        _gate(jnp.any(send_now | (active & is_recv))), _net_block, _net_skip,
+        None)
     recv_wait_ps = jnp.maximum(recv_time - core.clock_ps, 0)
     recv_wait_ps = jnp.where(recv_now, recv_wait_ps, 0)
 
@@ -504,7 +517,7 @@ def subquantum_iteration(
     (barrier_count, barrier_arrived, barrier_time, barrier_waiting,
      released, release_time, barrier_gen, barrier_release_ps,
      barrive_now, bsync_now, bsync_time) = lax.cond(
-        jnp.any(active & (is_binit | is_bwait | is_barrive | is_bsync)),
+        _gate(jnp.any(active & (is_binit | is_bwait | is_barrive | is_bsync))),
         _barrier_block, _barrier_skip, None)
     barrier_wait_ps = jnp.maximum(release_time - core.clock_ps, 0)
     barrier_wait_ps = jnp.where(released, barrier_wait_ps, 0)
@@ -706,10 +719,10 @@ def subquantum_iteration(
      mutex_wait_ps, cond_waiting, cond_signaled, cond_arrival_ps,
      cond_wake_ps, cond_sig_time_ps, cond_bcast_time_ps,
      cond_post_commit) = lax.cond(
-        jnp.any((active & (is_minit | is_munlock | is_csig | is_cbcast
-                           | is_cinit))
-                | (is_mlock & ~done & (sync.mutex_waiting | active))
-                | (is_cwait & ~done)),
+        _gate(jnp.any((active & (is_minit | is_munlock | is_csig
+                               | is_cbcast | is_cinit))
+                      | (is_mlock & ~done & (sync.mutex_waiting | active))
+                      | (is_cwait & ~done))),
         _mutex_cond_block, _mutex_cond_skip, None)
 
     # --- published cond signals + COND_JOIN (co-located split form) ------
@@ -747,7 +760,7 @@ def subquantum_iteration(
         return seq, seq_ps, cjoin_now, cjoin_t
 
     (cond_sig_seq, cond_sig_seq_ps, cjoin_now, cjoin_time) = lax.cond(
-        jnp.any(pub_now | (active & is_cjoin)),
+        _gate(jnp.any(pub_now | (active & is_cjoin))),
         _pub_block,
         lambda _: (sync.cond_sig_seq, sync.cond_sig_seq_ps,
                    jnp.zeros((T,), jnp.bool_), jnp.zeros((T,), I64)),
@@ -772,7 +785,7 @@ def subquantum_iteration(
         return join_now, join_time
 
     join_now, join_time = lax.cond(
-        jnp.any(active & is_join), _join_block,
+        _gate(jnp.any(active & is_join)), _join_block,
         lambda _: (jnp.zeros((T,), jnp.bool_), core.clock_ps), None)
 
     # --- commit: advance mask, clocks, counters --------------------------
@@ -1018,7 +1031,7 @@ def subquantum_iteration(
 
 def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT):
     """Blocks of `inner_block` iterations until no tile makes progress.
-    Returns (state, total_progress)."""
+    Returns (state, total_progress, n_iterations)."""
 
     def block(state, progress):
         def body(carry, _):
@@ -1033,18 +1046,19 @@ def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT):
         return state, progress
 
     def cond(carry):
-        _, _, blk_prog = carry
+        _, _, blk_prog, _ = carry
         return blk_prog > 0
 
     def body(carry):
-        st, total, _ = carry
+        st, total, _, iters = carry
         st, blk = block(st, jnp.asarray(0, jnp.int32))
-        return st, total + blk, blk
+        return st, total + blk, blk, iters + params.inner_block
 
-    state, total, _ = lax.while_loop(
+    state, total, _, iters = lax.while_loop(
         cond, body,
-        (state, jnp.asarray(0, jnp.int32), jnp.asarray(1, jnp.int32)))
-    return state, total
+        (state, jnp.asarray(0, jnp.int32), jnp.asarray(1, jnp.int32),
+         jnp.asarray(0, jnp.int64)))
+    return state, total, iters
 
 
 def run_quantum(
@@ -1061,7 +1075,7 @@ def run_quantum(
     jax-0.9 dispatch bug (constant-buffer miscount after topology changes);
     callers jit a closure instead (see `make_simulation_runner`).
     """
-    state, _ = _quantum_loop(params, trace, state, qend)
+    state, _, _ = _quantum_loop(params, trace, state, qend)
     return state
 
 
@@ -1095,7 +1109,7 @@ def run_simulation(
         return (clock // qps + 1) * qps
 
     def cond(carry):
-        st, qend, n, deadlock, stalled = carry
+        st, qend, n, deadlock, stalled, _ = carry
         return (
             ~jnp.all(st.done)
             & ~st.net.overflow
@@ -1105,7 +1119,7 @@ def run_simulation(
         )
 
     def body(carry):
-        st, prev_qend, n, deadlock, stalled = carry
+        st, prev_qend, n, deadlock, stalled, iters = carry
         clocks = st.core.clock_ps
         not_done = ~st.done
         min_pending = jnp.min(jnp.where(not_done, clocks, jnp.asarray(2**62, I64)))
@@ -1113,8 +1127,8 @@ def run_simulation(
             qend = INF_QEND
         else:
             qend = jnp.maximum(prev_qend + qps, next_boundary(min_pending))
-        st2, progress = _quantum_loop(params, trace, st, qend, trace_base,
-                                      px=px)
+        st2, progress, blk_iters = _quantum_loop(params, trace, st, qend,
+                                                 trace_base, px=px)
         # Zero progress: if some non-done tile sits beyond qend (it crossed
         # the boundary executing one long record), jump the window up to it
         # — blocked peers may wait on its future sends.  Only when every
@@ -1142,19 +1156,23 @@ def run_simulation(
             qend_next = qend
             deadlock = zero & ~paused
             stalled = zero & paused
-        return st2, qend_next, n + 1, deadlock, stalled
+        return st2, qend_next, n + 1, deadlock, stalled, iters + blk_iters
 
-    state, _, n_quanta, deadlock, _ = lax.while_loop(
+    state, _, n_quanta, deadlock, _, n_iters = lax.while_loop(
         cond, body,
         (state, jnp.asarray(0, I64), jnp.asarray(0, jnp.int32),
-         jnp.asarray(False), jnp.asarray(False)))
-    return state, n_quanta, deadlock
+         jnp.asarray(False), jnp.asarray(False), jnp.asarray(0, jnp.int64)))
+    return state, n_quanta, deadlock, n_iters
 
 
 def make_simulation_runner(params: EngineParams, trace: DeviceTrace,
-                           quantum_ps: int | None, max_quanta: int):
-    @jax.jit
+                           quantum_ps: int | None, max_quanta: int,
+                           donate: bool = False):
+    """`donate=True` hands the input state's buffers to XLA (halves the
+    protocol state's HBM residency — the 1024-tile directory is 2.4 GB,
+    and without donation input + output + scatter staging exceeds the
+    chip; see PERF.md).  The caller's old state object is consumed."""
     def run(state: SimState):
         return run_simulation(params, trace, state, quantum_ps, max_quanta)
 
-    return run
+    return jax.jit(run, donate_argnums=(0,) if donate else ())

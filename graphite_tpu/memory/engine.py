@@ -338,6 +338,7 @@ class _DirSetView:
         self.sets = (line % mp.dir_sets).astype(jnp.int32)
         self._line = line
         self._sharded = px.sharded
+        self._dw = d.tags.shape[2]
         if px.sharded:
             line_l = px.lo(line)
             Tl = d.tags.shape[0]
@@ -353,6 +354,7 @@ class _DirSetView:
             T = d.tags.shape[0]
             self._tiles = jnp.arange(T, dtype=jnp.int32)
             self._tags_r = None
+            self._sharers_r = None
 
     def rows(self):
         """(tag_row, nsharers_row) — the [T, DW] set rows the allocation
@@ -371,21 +373,26 @@ class _DirSetView:
         way = jnp.argmax(way_hits, axis=1).astype(jnp.int32)
         return found, way
 
+    def _sharers_row(self):
+        """The set's sharer words, [T, DW*SW] (stored set-row-major)."""
+        if self._sharers_r is None:
+            self._sharers_r = self._d.sharers[self._tiles, self.sets]
+        return self._sharers_r
+
     def entry(self, way):
         """(tags, dstate, owner, sharers, nsh) at `way`."""
+        row = self._sharers_row()
+        row3 = row.reshape(row.shape[0], self._dw, -1)
+        sharers = jnp.take_along_axis(row3, way[:, None, None], axis=1)[:, 0]
         if self._sharded:
             def sel(r):
-                if r.ndim == 3:
-                    return jnp.take_along_axis(
-                        r, way[:, None, None], axis=1)[:, 0]
                 return jnp.take_along_axis(r, way[:, None], axis=1)[:, 0]
 
             return (sel(self._tags_r), sel(self._dstate_r),
-                    sel(self._owner_r), sel(self._sharers_r),
-                    sel(self._nsh_r))
+                    sel(self._owner_r), sharers, sel(self._nsh_r))
         d, t, s = self._d, self._tiles, self.sets
         return (d.tags[t, s, way], d.dstate[t, s, way], d.owner[t, s, way],
-                d.sharers[t, s, way], d.nsharers[t, s, way])
+                sharers, d.nsharers[t, s, way])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -504,7 +511,19 @@ def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
     if owner is not None:
         out = out.replace(owner=delta(out.owner, owner, mask))
     if sharers is not None:
-        out = out.replace(sharers=delta(out.sharers, sharers, mask[:, None]))
+        # sharers store set-row-major [T, DS, DW*SW]: RMW the lane's set
+        # row, placing the entry's [SW] words at its way's slot (per-lane
+        # rows unique, so the 2D-indexed add aliases in place)
+        new_sh = px.lo(sharers)                       # [Tl, SW]
+        DW = out.tags.shape[2]
+        row = out.sharers[tiles, sets]                # [Tl, DW*SW]
+        row3 = row.reshape(row.shape[0], DW, -1)
+        onehot = (jnp.arange(DW, dtype=jnp.int32)[None, :, None]
+                  == way[:, None, None]) & mask[:, None, None]
+        new3 = jnp.where(onehot, new_sh[:, None, :], row3)
+        out = out.replace(sharers=out.sharers.at[tiles, sets].add(
+            (new3 - row3).reshape(row.shape),
+            unique_indices=True, indices_are_sorted=True))
     if nsharers is not None:
         out = out.replace(nsharers=delta(out.nsharers, nsharers, mask))
     return out

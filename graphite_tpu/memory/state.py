@@ -80,7 +80,12 @@ class DirectoryArrays:
     tags: jax.Array      # int32[T, DS, DW] line address, -1 = free
     dstate: jax.Array    # uint8[T, DS, DW]
     owner: jax.Array     # int32[T, DS, DW]
-    sharers: jax.Array   # uint32[T, DS, DW, SW] full-map bitvector
+    # full-map bitvector, stored set-row-major [T, DS, DW*SW] (way w's
+    # words at [.., w*SW:(w+1)*SW]): a [T, DS, DW, SW] layout pads SW up
+    # to the 128-lane tile on TPU (4x physical at 1024 tiles — PERF.md
+    # "array padding"), and the set-row form matches how every phase
+    # reads it anyway
+    sharers: jax.Array   # uint32[T, DS, DW*SW]
     nsharers: jax.Array  # int32[T, DS, DW] cached popcount
 
 
@@ -279,7 +284,7 @@ def init_mem_state(mp: MemParams) -> MemState:
         tags=jnp.full((T, DS, DW), -1, jnp.int32),
         dstate=jnp.zeros((T, DS, DW), jnp.uint8),
         owner=jnp.full((T, DS, DW), -1, jnp.int32),
-        sharers=jnp.zeros((T, DS, DW, SW), jnp.uint32),
+        sharers=jnp.zeros((T, DS, DW * SW), jnp.uint32),
         nsharers=jnp.zeros((T, DS, DW), jnp.int32),
     )
     txn = TxnState(

@@ -212,7 +212,7 @@ def make_shard_map_runner(params, quantum_ps, max_quanta: int, mesh: Mesh,
         sm = jax.shard_map(
             body, mesh=mesh,
             in_specs=(state_specs, trace_specs, P()),
-            out_specs=(state_specs, P(), P()),
+            out_specs=(state_specs, P(), P(), P()),
             check_vma=False)
         return jax.jit(sm)
 
@@ -222,7 +222,7 @@ def make_shard_map_runner(params, quantum_ps, max_quanta: int, mesh: Mesh,
     sm = jax.shard_map(
         body, mesh=mesh,
         in_specs=(state_specs, trace_specs),
-        out_specs=(state_specs, P(), P()),
+        out_specs=(state_specs, P(), P(), P()),
         check_vma=False)
     return jax.jit(sm)
 

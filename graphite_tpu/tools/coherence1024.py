@@ -1,0 +1,93 @@
+"""The north-star-shaped coherence measurement: 1024-tile SPLASH-2 FFT
+with the FULL memory engine (MSI, per-line true addresses) — the honest
+companion VERDICT round 3 asked for (`BENCH_r{N}.json` field
+`coherence_1024_instr_per_s`).
+
+Run as a subprocess (bench.py does) because the largest configs can kill
+the TPU worker: the full auto-sized directory is 2.4 GB at 1024 tiles and
+XLA's scatter-staging copies of it exhaust HBM mid-run, and the tunnel's
+remote-compile helper intermittently dies on programs this size (PERF.md
+"Known limitation").  bench.py walks a fidelity ladder — full directory +
+hop-by-hop memory NoC, then full directory + hop-counter, then a reduced
+directory — and records the first rung that completes, tagged with its
+fidelity, so the recorded number is always real.
+
+A deterministic TPU kernel fault (not OOM — 3 GB allocated of 16) kills
+send-carrying traces (FFT) at 1024 tiles x full directory while canneal /
+memory-stress run the same shapes, so the ladder includes a
+memory-stress-at-full-directory rung: full coherence at the north-star
+scale, minus the CAPI messaging the faulting kernel needs.
+
+Usage: python -m graphite_tpu.tools.coherence1024 [--net hbh|hopctr]
+       [--dir full|small] [--workload fft|memstress] [--points N]
+Prints ONE JSON line: {"config": ..., "instr": N, "wall_s": S, "rate": R}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def run_one(net: str, dir_size: str, points: int,
+            workload: str = "fft") -> dict:
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.engine.simulator import Simulator
+    from graphite_tpu.tools._template import config_text
+    from graphite_tpu.trace.benchmarks import fft_trace
+
+    # lax scheme: the lax_barrier variant at 1024 tiles + memory engine
+    # still crashes the remote-compile helper (PERF.md)
+    text = config_text(
+        1024, shared_mem=True, clock_scheme="lax",
+        network="emesh_hop_by_hop" if net == "hbh" else "emesh_hop_counter")
+    if dir_size == "small":
+        # quarter-size directory: 0.73 GB of sharer state instead of the
+        # auto-sized 2.4 GB — the rung that fits alongside XLA's
+        # scatter-staging copies today
+        text += "\n[dram_directory]\ntotal_entries = 4096\n" \
+                "associativity = 16\n"
+    sc = SimConfig(ConfigFile.from_string(text))
+    if workload == "memstress":
+        from graphite_tpu.trace import synthetic
+
+        batch = synthetic.memory_stress_trace(
+            1024, n_accesses=4 * points, working_set_bytes=1 << 15,
+            write_fraction=0.4, shared_fraction=0.5, seed=7)
+    else:
+        batch = fft_trace(1024, points_per_tile=points, use_memory=True)
+    # donate the input state: halves the big-state HBM residency
+    sim = Simulator(sc, batch, donate=True)
+    t0 = time.perf_counter()
+    res = sim.run()
+    wall = time.perf_counter() - t0
+    # warm second instance for the honest steady rate (compile cached)
+    sim2 = Simulator(sc, batch, donate=True)
+    t1 = time.perf_counter()
+    res = sim2.run()
+    wall = time.perf_counter() - t1
+    return {
+        "config": f"1024t_{workload}_msi_{net}_{dir_size}dir",
+        "instr": res.total_instructions,
+        "wall_s": round(wall, 2),
+        "rate": round(res.total_instructions / wall),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--net", choices=("hbh", "hopctr"), default="hbh")
+    ap.add_argument("--dir", dest="dir_size", choices=("full", "small"),
+                    default="full")
+    ap.add_argument("--points", type=int, default=16)
+    ap.add_argument("--workload", choices=("fft", "memstress"),
+                    default="fft")
+    args = ap.parse_args()
+    out = run_one(args.net, args.dir_size, args.points, args.workload)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
