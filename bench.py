@@ -204,8 +204,19 @@ scheme = lax
                 except subprocess.TimeoutExpired:
                     continue
                 if proc.returncode == 0 and proc.stdout.strip():
-                    rung = json.loads(
-                        proc.stdout.strip().splitlines()[-1])
+                    # scan backwards for the result line: runtime/absl
+                    # warnings can land on stdout after it
+                    rung = None
+                    for line in reversed(proc.stdout.strip().splitlines()):
+                        try:
+                            cand = json.loads(line)
+                        except ValueError:
+                            continue
+                        if isinstance(cand, dict) and "rate" in cand:
+                            rung = cand
+                            break
+                    if rung is None:
+                        continue
                     companions["coherence_1024_instr_per_s"] = rung["rate"]
                     companions["coherence_1024_config"] = rung["config"]
                     break

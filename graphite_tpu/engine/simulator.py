@@ -256,6 +256,10 @@ def auto_mailbox_depth(batch: "TraceBatch") -> int:
 
 
 _STREAM_RUNNERS: dict = {}
+# Each cached wrapper pins a compiled executable (tens of MB of device
+# program + host tracing caches); long-lived processes sweeping many
+# configs would otherwise grow without bound.
+_STREAM_RUNNERS_MAX = 8
 
 
 def _streamed_runner(params: EngineParams, quantum_ps, max_quanta: int,
@@ -263,9 +267,13 @@ def _streamed_runner(params: EngineParams, quantum_ps, max_quanta: int,
     """One jitted streamed-run wrapper per (params, quantum, max_quanta,
     mesh program): identical configs share a wrapper, so a warmup run on
     one Simulator instance warms the executable every other instance
-    uses."""
+    uses.  LRU-bounded at _STREAM_RUNNERS_MAX entries."""
     key = (params, quantum_ps, int(max_quanta), mesh, spmd)
     fn = _STREAM_RUNNERS.get(key)
+    if fn is not None:
+        # LRU refresh (dicts preserve insertion order)
+        del _STREAM_RUNNERS[key]
+        _STREAM_RUNNERS[key] = fn
     if fn is None:
         if spmd == "shard_map":
             from graphite_tpu.parallel.mesh import make_shard_map_runner
@@ -279,6 +287,8 @@ def _streamed_runner(params: EngineParams, quantum_ps, max_quanta: int,
             fn = jax.jit(
                 lambda st, tr, base: run_simulation(
                     params, tr, st, quantum_ps, max_quanta, trace_base=base))
+        while len(_STREAM_RUNNERS) >= _STREAM_RUNNERS_MAX:
+            _STREAM_RUNNERS.pop(next(iter(_STREAM_RUNNERS)))
         _STREAM_RUNNERS[key] = fn
     return fn
 
@@ -524,9 +534,15 @@ class Simulator:
         if spmd not in (None, "shard_map", "gspmd"):
             raise ValueError(f"unknown spmd program {spmd!r} "
                              "(expected 'shard_map' or 'gspmd')")
+        shl2 = (mem_params is not None
+                and mem_params.protocol.startswith("pr_l1_sh_l2"))
+        if mesh is not None and spmd == "shard_map" and shl2:
+            # fail at the misconfiguration site, not as a
+            # NotImplementedError from shl2_engine_step mid-trace
+            raise ValueError(
+                "the shared-L2 protocols do not take the shard_map "
+                "exchange yet; use spmd='gspmd' (the default for them)")
         if mesh is not None and spmd is None:
-            shl2 = (mem_params is not None
-                    and mem_params.protocol.startswith("pr_l1_sh_l2"))
             spmd = "gspmd" if shl2 else "shard_map"
         self.spmd = spmd if mesh is not None else None
         self.device_trace = None if stream else DeviceTrace.from_batch(trace)
@@ -769,8 +785,44 @@ class Simulator:
     def warmup(self, max_quanta: int = 1_000_000) -> None:
         """Compile (and execute once, discarding results) the full runner —
         for benchmarking so timed runs exclude compilation."""
+        if self.donate:
+            # the donated run would delete self.state's buffers and the
+            # discarded output is the only live copy — a later run() would
+            # fail with an opaque "array has been deleted"
+            raise RuntimeError(
+                "warmup() is incompatible with donate=True (the warmup "
+                "run would consume self.state); warm a separate "
+                "non-donating instance and adopt_runner() from it")
         out = self._get_runner(max_quanta)(self.state)
         jax.block_until_ready(out)
+
+    def adopt_runner(self, other: "Simulator") -> None:
+        """Reuse another instance's compiled runner.
+
+        For timed repeat runs with donate=True (which consumes the ran
+        instance's state): build a fresh instance over the SAME config and
+        trace batch, adopt the first instance's runner, and the timed run
+        excludes retrace/recompile.  The runner closes over the other
+        instance's device trace, so both instances must be built from the
+        SAME trace batch object and identical config/donation."""
+        if other._runner is None:
+            raise ValueError(
+                "adopt_runner: the donor has no compiled runner (run it "
+                "first) — adopting nothing would silently time a "
+                "retrace+recompile")
+        if (other.params != self.params or other.spmd != self.spmd
+                or other.quantum_ps != self.quantum_ps
+                or other.mesh != self.mesh
+                or other.donate != self.donate
+                or other.trace_batch is not self.trace_batch):
+            raise ValueError(
+                "adopt_runner needs the same trace batch and identical "
+                "config/program/quantum/mesh/donation")
+        # the adopted runner closes over the donor's device trace — drop
+        # this instance's duplicate upload (matters at 1024-tile scale)
+        self.device_trace = other.device_trace
+        self._runner = other._runner
+        self._runner_max_quanta = other._runner_max_quanta
 
     def run(self, max_quanta: int = 1_000_000) -> SimResults:
         """Drive quanta until every tile's trace is exhausted.

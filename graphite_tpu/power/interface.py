@@ -93,10 +93,15 @@ class McPATCacheInterface:
                  line_bytes: int = 64, ports: int = 1, num_banks: int = 1):
         # num_banks mirrors the reference's only use of the knob — the
         # McPAT cache config (`mcpat_cache_interface.cc:226`): banked
-        # arrays split the bitline/wordline energy per access
-        self._args = (node_nm, max(size_bytes // max(num_banks, 1), 1024),
+        # arrays split the bitline/wordline energy per access.  Clamp the
+        # bank count so each bank holds >= 1 KB (a physical SRAM macro
+        # floor) instead of flooring the per-bank size: a small cache
+        # configured with many banks would otherwise charge the 1 KB-array
+        # energy num_banks times over and overestimate several-fold.
+        num_banks = max(1, min(num_banks, size_bytes // 1024))
+        self._args = (node_nm, max(size_bytes // num_banks, 1024),
                       associativity, line_bytes, ports)
-        self._num_banks = max(num_banks, 1)
+        self._num_banks = num_banks
         self._cache: dict = {}   # per-voltage operating points
 
     def at_voltage(self, voltage: float) -> _SramOut:

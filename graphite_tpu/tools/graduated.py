@@ -82,6 +82,9 @@ def run_config(n: int, small: bool):
             # tiles with the full memory engine; the lax scheme (identical
             # code, unbounded quantum) compiles and runs.  canneal has no
             # mid-run barriers, so only the skew bound differs.
+            print("WARNING: config 5 substitutes clock scheme lax for "
+                  "lax_barrier (1024-tile remote-compile helper crash, "
+                  "PERF.md)", file=sys.stderr, flush=True)
             text = text.replace("scheme = lax_barrier", "scheme = lax")
         sc = SimConfig(ConfigFile.from_string(text))
         batch = canneal_trace(tiles, footprint_lines=4096,

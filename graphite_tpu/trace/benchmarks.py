@@ -427,8 +427,10 @@ def volrend_trace(n_tiles: int, rays_per_tile: int = 128,
                   frames: int = 2, seed: int = 21,
                   use_memory: bool = False) -> TraceBatch:
     """Volume rendering (SPLASH-2 `apps/volrend`): per frame each tile
-    ray-casts its image block — ~30 fp ops per sample, ~16 samples per
-    ray with early termination (adaptive ray lengths drawn per ray), and
+    ray-casts its image block — ~30 fp ops per sample, with early
+    termination modeled by drawing an adaptive length (4–16 samples) for
+    each of the first 16 rays; the remaining rays are lumped into one
+    block at the 10-sample average (keeps trace records bounded), and
     irregular loads over the shared volume when use_memory; frames end
     at a barrier after a mutex-protected image merge (volrend's
     render/ray loops + the task-queue lock)."""
@@ -459,10 +461,13 @@ def raytrace_trace(n_tiles: int, rays_per_tile: int = 128,
                    seed: int = 33, use_memory: bool = False) -> TraceBatch:
     """Ray tracing (SPLASH-2 `apps/raytrace`): a single frame of primary
     rays over image tiles — per ray a BSP-tree walk (~log-depth cell
-    visits x ~40 fp intersection ops, depth drawn per ray for the
-    irregular secondary-ray fan-out) with irregular shared-geometry
-    loads; work stealing is modeled as a mutex-protected queue touch
-    every 32 rays (raytrace's GetJobs/PutJobs)."""
+    visits x ~40 fp intersection ops); tree depth (2–8) is drawn for
+    each of the first 16 rays to model the irregular secondary-ray
+    fan-out, the remaining rays lumped into one block at the depth-5
+    average (keeps trace records bounded), with irregular
+    shared-geometry loads; work stealing is modeled as a
+    mutex-protected queue touch every 32 rays (raytrace's
+    GetJobs/PutJobs)."""
     rng = np.random.default_rng(seed)
     builders = [TraceBuilder() for _ in range(n_tiles)]
     builders[0].barrier_init(_BAR, n_tiles)

@@ -219,3 +219,36 @@ def test_ackwise_broadcast_fanout_exact():
     bs[0].mutex_unlock(0)
     res, gold = assert_exact(sc, TraceBatch.from_builders(bs))
     assert int(gold.mem_counters["dir_broadcasts"].sum()) > 0
+
+
+def test_fanout_single_target_matches_unicast():
+    """Formula self-consistency: a fan-out with exactly ONE target on an
+    idle NoC must charge the same arrival time as the unicast path for
+    that (src, dst) pair — the inject+rank approximation only diverges
+    from per-hop routing when queues are occupied or k > 1.  Checked for
+    both the hop-counter (zero-load closed form) and hop_by_hop nets."""
+    import jax.numpy as jnp
+
+    from graphite_tpu.memory.engine import mem_net_fanout, mem_net_send
+    from graphite_tpu.models.network_hop_by_hop import init_noc_state
+
+    batch = disjoint_stream(9, accesses=4)
+    for net in ("emesh_hop_counter", "emesh_hop_by_hop"):
+        sim = Simulator(make_config(9, net=net), batch)
+        mp = sim.params.mem
+        T = mp.n_tiles
+        t0 = jnp.full((T,), 1_000_000, jnp.int64)
+        for src, dst in ((0, 5), (4, 4), (8, 1)):
+            noc = (None if mp.net_hbh is None
+                   else init_noc_state(mp.net_hbh))
+            send_hs = jnp.zeros((T, T), bool).at[src, dst].set(True)
+            _, arr_fan = mem_net_fanout(mp, noc, send_hs, 128, t0, True)
+            noc = (None if mp.net_hbh is None
+                   else init_noc_state(mp.net_hbh))
+            srcs = jnp.full((T,), src, jnp.int32)
+            dsts = jnp.full((T,), dst, jnp.int32)
+            mask = jnp.zeros((T,), bool).at[src].set(True)
+            _, arr_uni = mem_net_send(
+                mp, noc, srcs, dsts, 128, t0, mask, True)
+            assert int(arr_fan[src, dst]) == int(arr_uni[src]), (
+                net, src, dst)
