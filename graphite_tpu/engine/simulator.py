@@ -311,8 +311,14 @@ class Simulator:
         stream: bool = False,
         spmd: str | None = None,
         donate: bool = False,
+        dir_stage: bool | None = None,
     ):
-        """`spmd` (mesh runs only): "shard_map" — the packed-exchange
+        """`dir_stage`: force the directory write-staging path on/off
+        (None = auto: on for single-device private-L2 runs whose sharers
+        store is >= 64 MB — the regime where XLA's dense scatter lowering
+        dominates; see MemParams.dir_stage_cap).
+
+        `spmd` (mesh runs only): "shard_map" — the packed-exchange
         multi-chip program (parallel/px.py; the default where supported) —
         or "gspmd" — whole-program partitioning via sharding specs (the
         legacy path; also the automatic fallback for the shared-L2
@@ -366,6 +372,10 @@ class Simulator:
             raise ValueError(
                 "dynamic trace records (ops 15-19) must not carry "
                 "FLAG_MEM*_VALID memory operands")
+        if dir_stage and not (config.enable_shared_mem and has_mem):
+            raise ValueError(
+                "dir_stage=True needs the memory subsystem (shared mem "
+                "enabled and a memory-carrying trace)")
         mem_params = None
         if config.enable_shared_mem and has_mem:
             from graphite_tpu.memory import MemParams
@@ -379,6 +389,29 @@ class Simulator:
                     f"caching protocol {mem_params.protocol!r} pending "
                     f"(available: {', '.join(supported)})"
                 )
+            # Directory write-staging (MemParams.dir_stage_cap): lifts
+            # the coherence-storm floor — XLA lowers per-lane scatters on
+            # the big sharers store as full-array dense passes, so big
+            # directories stage writes and flush once per inner block
+            # (PERF.md round-5).  Auto-on when the sharers store alone
+            # is >= 64 MB; single-device private-L2 programs only.
+            private_l2 = mem_params.protocol.startswith("pr_l1_pr_l2")
+            sharers_bytes = (4 * n_tiles * mem_params.dir_sets
+                             * mem_params.dir_ways
+                             * mem_params.sharer_words)
+            if dir_stage is None:
+                dir_stage = (private_l2 and mesh is None
+                             and sharers_bytes >= 64 << 20)
+            if dir_stage:
+                if not private_l2 or mesh is not None:
+                    raise ValueError(
+                        "dir_stage requires a private-L2 protocol on a "
+                        "single device")
+                wpi = (5 if mem_params.dir_type == "limited_no_broadcast"
+                       else 3)
+                mem_params = dataclasses.replace(
+                    mem_params,
+                    dir_stage_cap=wpi * n_tiles * inner_block)
         # Full hop-by-hop USER NoC with per-port contention
         user_hbh = None
         user_atac = None

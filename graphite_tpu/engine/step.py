@@ -1043,6 +1043,22 @@ def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT):
         (state, progress), _ = lax.scan(
             body, (state, progress), None, length=params.inner_block,
         )
+        if (params.mem is not None
+                and getattr(params.mem, "dir_stage_cap", 0)):
+            # One amortized dense pass applies the block's staged
+            # directory writes (memory/engine.dir_stage_flush); capacity
+            # covers a full block, so flushing here is always in time.
+            # Deliberately UNCONDITIONAL (no lax.cond on sn > 0): a cond
+            # would double-buffer the multi-GB sharers store in HBM —
+            # the same pathology that disables mem_gate at this scale —
+            # and in the big configs where staging auto-enables, the
+            # direct path paid its three full-array dense passes every
+            # iteration even with all-false write masks, so an empty
+            # flush per block is already the cheap case.
+            from graphite_tpu.memory.engine import dir_stage_flush
+
+            state = state.replace(mem=state.mem.replace(
+                directory=dir_stage_flush(state.mem.directory)))
         return state, progress
 
     def cond(carry):

@@ -391,6 +391,9 @@ class _DirSetView:
             return (sel(self._tags_r), sel(self._dstate_r),
                     sel(self._owner_r), sharers, sel(self._nsh_r))
         d, t, s = self._d, self._tiles, self.sets
+        if d.skey is not None:
+            # staged writes since the last flush supersede the big store
+            sharers = _stage_overlay(d, s, way, sharers)
         return (d.tags[t, s, way], d.dstate[t, s, way], d.owner[t, s, way],
                 sharers, d.nsharers[t, s, way])
 
@@ -480,6 +483,92 @@ def mem_idle_out(mp: MemParams, ms, rec: "RecView", enabled) -> MemStepOut:
 # directory-entry helpers (structured [T, DS, DW(, SW)] arrays — a flat
 # entry-major repack was built and measured 1.6x slower; see PERF.md
 # round-3 findings and the DirectoryArrays docstring).
+#
+# Sharers write-staging (dir_stage_cap > 0): XLA TPU lowers every
+# per-lane scatter on the big [T, DS, DW*SW] sharers store as a
+# FULL-ARRAY dense pass (measured ~8 ms each at 1024 tiles, three per
+# iteration — the coherence-storm floor, PERF.md round-4 findings; the
+# same writes on the small [T, DS, DW] entry arrays cost little and stay
+# direct).  Staged mode: writes land in the small unique-key
+# (skey, sval) table (`_stage_put`); the engine's only sharers reads —
+# `_DirSetView.entry()` — overlay it; `dir_stage_flush` applies the
+# table to the big store once per inner_block iterations
+# (engine/step._quantum_loop), one amortized dense pass instead of
+# 3*inner_block.  Capacity = writes_per_iter * T * inner_block makes
+# mid-block overflow impossible.  Reference hot path this lifts:
+# `dram_directory_cntlr.cc:44-559` per-message directory updates.
+
+
+def _stage_key(d, sets, way):
+    T, DS, DW = d.tags.shape
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    return (tiles * DS + sets) * DW + way
+
+
+def _stage_put(d, sets, way, mask, new_sh):
+    """Stage a masked per-lane sharers write.  Overwrites the entry's
+    existing slot if staged (unique-key invariant), else appends at the
+    next free slots (rank-compacted, so capacity tracks real writes)."""
+    C = d.skey.shape[0]
+    key = _stage_key(d, sets, way)
+    m = d.skey[None, :] == key[:, None]            # [T, C]
+    found = m.any(axis=1)
+    c_found = jnp.argmax(m, axis=1).astype(jnp.int32)
+    app = mask & ~found
+    rank = jnp.cumsum(app.astype(jnp.int32)) - 1
+    # masked-off lanes target slot C: out of bounds, dropped.  In-bounds
+    # positions are unique (unique keys; distinct append ranks).
+    pos = jnp.where(mask, jnp.where(found, c_found, d.sn + rank), C)
+    return d.replace(
+        skey=d.skey.at[pos].set(key, mode="drop", unique_indices=True),
+        sval=d.sval.at[pos].set(new_sh, mode="drop", unique_indices=True),
+        sn=d.sn + jnp.sum(app, dtype=jnp.int32))
+
+
+def _stage_overlay(d, sets, way, sharers):
+    """The staged value of each lane's (set, way) entry, if any, else the
+    given big-store value ([T, SW])."""
+    key = _stage_key(d, sets, way)
+    m = d.skey[None, :] == key[:, None]            # [T, C]
+    found = m.any(axis=1)
+    c = jnp.argmax(m, axis=1)
+    return jnp.where(found[:, None], d.sval[c], sharers)
+
+
+def dir_stage_flush(d):
+    """Apply the staging table to the big sharers store and reset it.
+
+    ROW-form add-a-delta: gather each staged entry's whole [DW*SW] set
+    row (structured [t, s] row indexing — the fast TPU gather path; the
+    3D element-index form measured 90 ms/flush, PERF.md round-5), expand
+    the entry's delta into its way's slot, and scatter-add rows back.
+    Two staged entries in the same set touch disjoint way columns, so
+    duplicate (t, s) row adds stay exact; empty slots add zero out of
+    bounds (dropped).  The add aliases the loop-carried buffer in
+    place."""
+    if d.skey is None:
+        return d
+    T, DS, DW = d.tags.shape
+    SW = d.sval.shape[1]
+    C = d.skey.shape[0]
+    valid = d.skey >= 0
+    key = jnp.where(valid, d.skey, 0)
+    w = key % DW
+    s = (key // DW) % DS
+    t = key // (DS * DW)
+    row = d.sharers[t, s]                          # [C, DW*SW]
+    row3 = row.reshape(C, DW, SW)
+    cur = jnp.take_along_axis(row3, w[:, None, None], axis=1)[:, 0]
+    delta = jnp.where(valid[:, None], d.sval - cur, jnp.uint32(0))
+    onehot = (jnp.arange(DW, dtype=jnp.int32)[None, :, None]
+              == w[:, None, None])
+    row_delta = jnp.where(onehot, delta[:, None, :],
+                          jnp.uint32(0)).reshape(C, DW * SW)
+    t_oob = jnp.where(valid, t, T)                 # dropped when invalid
+    return d.replace(
+        sharers=d.sharers.at[t_oob, s].add(row_delta, mode="drop"),
+        skey=jnp.full_like(d.skey, -1),
+        sn=jnp.zeros_like(d.sn))
 
 
 def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
@@ -511,19 +600,26 @@ def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
     if owner is not None:
         out = out.replace(owner=delta(out.owner, owner, mask))
     if sharers is not None:
-        # sharers store set-row-major [T, DS, DW*SW]: RMW the lane's set
-        # row, placing the entry's [SW] words at its way's slot (per-lane
-        # rows unique, so the 2D-indexed add aliases in place)
         new_sh = px.lo(sharers)                       # [Tl, SW]
-        DW = out.tags.shape[2]
-        row = out.sharers[tiles, sets]                # [Tl, DW*SW]
-        row3 = row.reshape(row.shape[0], DW, -1)
-        onehot = (jnp.arange(DW, dtype=jnp.int32)[None, :, None]
-                  == way[:, None, None]) & mask[:, None, None]
-        new3 = jnp.where(onehot, new_sh[:, None, :], row3)
-        out = out.replace(sharers=out.sharers.at[tiles, sets].add(
-            (new3 - row3).reshape(row.shape),
-            unique_indices=True, indices_are_sorted=True))
+        if out.skey is not None:
+            # staged mode (single-device programs only — the Simulator
+            # never enables staging under a mesh)
+            assert not px.sharded
+            out = _stage_put(out, sets, way, mask, new_sh)
+        else:
+            # sharers store set-row-major [T, DS, DW*SW]: RMW the lane's
+            # set row, placing the entry's [SW] words at its way's slot
+            # (per-lane rows unique, so the 2D-indexed add aliases in
+            # place)
+            DW = out.tags.shape[2]
+            row = out.sharers[tiles, sets]            # [Tl, DW*SW]
+            row3 = row.reshape(row.shape[0], DW, -1)
+            onehot = (jnp.arange(DW, dtype=jnp.int32)[None, :, None]
+                      == way[:, None, None]) & mask[:, None, None]
+            new3 = jnp.where(onehot, new_sh[:, None, :], row3)
+            out = out.replace(sharers=out.sharers.at[tiles, sets].add(
+                (new3 - row3).reshape(row.shape),
+                unique_indices=True, indices_are_sorted=True))
     if nsharers is not None:
         out = out.replace(nsharers=delta(out.nsharers, nsharers, mask))
     return out

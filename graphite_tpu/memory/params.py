@@ -237,6 +237,17 @@ class MemParams:
     # shared-L2 engine's requester phase does not read it (its L1-only
     # hit path is already a single cheap lookup per iteration)
     requester_unroll: int = 1
+    # Directory write-staging capacity (0 = disabled).  XLA TPU lowers a
+    # per-lane scatter on the big [T, DS, DW*SW] sharers store as a
+    # FULL-ARRAY dense pass (~8 ms each at 1024 tiles, three per engine
+    # iteration — the coherence-storm floor, PERF.md round-4 findings).
+    # When enabled, sharers writes accumulate in a small unique-key
+    # [cap, SW] staging table (reads overlay it) and flush to the big
+    # store ONCE per inner_block iterations — one amortized dense pass
+    # instead of 3*inner_block.  The Simulator sizes cap =
+    # writes_per_iter * T * inner_block (overflow-impossible) and
+    # auto-enables on big directories; single-device programs only.
+    dir_stage_cap: int = 0
 
     @property
     def req_bits(self) -> int:

@@ -87,6 +87,13 @@ class DirectoryArrays:
     # reads it anyway
     sharers: jax.Array   # uint32[T, DS, DW*SW]
     nsharers: jax.Array  # int32[T, DS, DW] cached popcount
+    # sharers write-staging table (MemParams.dir_stage_cap > 0; see
+    # engine._stage_put / dir_stage_flush).  Unique-key invariant: at
+    # most one live slot per directory entry — writes overwrite their
+    # existing slot.  None when staging is disabled.
+    skey: "object" = None  # int32[C] (t*DS + set)*DW + way, -1 = empty
+    sval: "object" = None  # uint32[C, SW] staged sharer words
+    sn: "object" = None    # int32[] slots appended since last flush
 
 
 @struct.dataclass
@@ -286,6 +293,11 @@ def init_mem_state(mp: MemParams) -> MemState:
         owner=jnp.full((T, DS, DW), -1, jnp.int32),
         sharers=jnp.zeros((T, DS, DW * SW), jnp.uint32),
         nsharers=jnp.zeros((T, DS, DW), jnp.int32),
+        skey=(jnp.full((mp.dir_stage_cap,), -1, jnp.int32)
+              if mp.dir_stage_cap else None),
+        sval=(jnp.zeros((mp.dir_stage_cap, SW), jnp.uint32)
+              if mp.dir_stage_cap else None),
+        sn=(jnp.zeros((), jnp.int32) if mp.dir_stage_cap else None),
     )
     txn = TxnState(
         active=jnp.zeros(T, jnp.bool_),

@@ -465,9 +465,10 @@ def raytrace_trace(n_tiles: int, rays_per_tile: int = 128,
     each of the first 16 rays to model the irregular secondary-ray
     fan-out, the remaining rays lumped into one block at the depth-5
     average (keeps trace records bounded), with irregular
-    shared-geometry loads; work stealing is modeled as a
-    mutex-protected queue touch every 32 rays (raytrace's
-    GetJobs/PutJobs)."""
+    shared-geometry loads; work stealing (raytrace's GetJobs/PutJobs)
+    is modeled as a mutex-protected queue touch every 32 modeled rays —
+    with the 16-ray cap that is one touch per tile, the lumped
+    remainder carrying none."""
     rng = np.random.default_rng(seed)
     builders = [TraceBuilder() for _ in range(n_tiles)]
     builders[0].barrier_init(_BAR, n_tiles)

@@ -453,3 +453,63 @@ def test_requester_unroll_bit_exact():
     extra = "[general]\nrequester_unroll = 3\n"
     sc = make_config(4, MSI, extra=extra)
     assert_exact(sc, mutex_rmw(4, rounds=5, lines=2))
+
+
+# ---- directory write-staging (MemParams.dir_stage_cap) ---------------------
+# The staged path accumulates sharers writes in the small unique-key table
+# and flushes once per inner block (engine._stage_put / dir_stage_flush);
+# these pin bit-exactness vs both the oracle and the direct-scatter path,
+# with inner_block=4 so runs cross MANY flush boundaries and reads hit
+# staged-but-unflushed entries.
+
+
+def assert_exact_staged(sc, batch):
+    res = Simulator(sc, batch, dir_stage=True, inner_block=4).run()
+    gold = run_golden(sc, batch)
+    np.testing.assert_array_equal(res.clock_ps, gold.clock_ps,
+                                  err_msg="clock")
+    for k, g in gold.mem_counters.items():
+        np.testing.assert_array_equal(np.asarray(res.mem_counters[k]), g,
+                                      err_msg=k)
+    return res, gold
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_staged_serialized_exact(proto):
+    assert_exact_staged(make_config(6, proto), mutex_rmw(6, rounds=4))
+
+
+def test_staged_limited_no_broadcast_exact():
+    """5 staged writes/iteration (the two extra capacity-displacement
+    updates) + overwrite-in-place dedup on the same entry."""
+    extra = ("[dram_directory]\ndirectory_type = limited_no_broadcast\n"
+             "max_hw_sharers = 2\n")
+    assert_exact_staged(make_config(6, MSI, extra=extra),
+                        mutex_rmw(6, rounds=4))
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+def test_staged_nullify_tiny_directory(proto):
+    """Directory capacity pressure: NULLIFY victim reads must see staged
+    entries (the victim may have been written this block)."""
+    extra = "[dram_directory]\ntotal_entries = 16\nassociativity = 2\n"
+    assert_exact_staged(make_config(4, proto, extra=extra),
+                        mutex_rmw(4, rounds=4, lines=3))
+
+
+def test_staged_matches_direct_racy():
+    """On free-running racy traffic the engine diverges from the oracle
+    (documented envelope) but the staged and direct programs must stay
+    BIT-IDENTICAL to each other: staging is pure mechanism, not policy."""
+    batch = synthetic.memory_stress_trace(
+        8, n_accesses=80, working_set_bytes=1 << 12,
+        write_fraction=0.4, shared_fraction=0.6, seed=11)
+    sc = make_config(8)
+    r0 = Simulator(sc, batch, dir_stage=False).run()
+    r1 = Simulator(sc, batch, dir_stage=True, inner_block=4).run()
+    np.testing.assert_array_equal(np.asarray(r0.clock_ps),
+                                  np.asarray(r1.clock_ps))
+    for k in r0.mem_counters:
+        np.testing.assert_array_equal(np.asarray(r0.mem_counters[k]),
+                                      np.asarray(r1.mem_counters[k]),
+                                      err_msg=k)
