@@ -148,7 +148,7 @@ def _mem_state_bytes(mp) -> int:
     """Rough HBM footprint of the protocol state: directory (dominant),
     cache meta words, and the [T, T] mailbox matrices."""
     T = mp.n_tiles
-    dir_entry = mp.sharer_words * 4 + 13
+    dir_entry = mp.sharer_words * 4 + 8  # sharers words + packed word
     dir_bytes = T * mp.dir_sets * mp.dir_ways * dir_entry
     cache_bytes = 8 * T * (
         mp.l1i.num_sets * mp.l1i.num_ways

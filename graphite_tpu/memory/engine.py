@@ -60,7 +60,8 @@ from graphite_tpu.memory.cache_array import (
 )
 from graphite_tpu.memory.params import MemParams
 from graphite_tpu.memory.state import (
-    DIR_MODIFIED, DIR_OWNED, DIR_SHARED, DIR_UNCACHED,
+    DIR_ID_BITS, DIR_MODIFIED, DIR_NSH_SHIFT, DIR_OWNED, DIR_OWNER_SHIFT,
+    DIR_SHARED, DIR_STATE_SHIFT, DIR_TAG_BITS, DIR_UNCACHED,
     MOD_CORE, MOD_DIR, MOD_L1D, MOD_L1I, MOD_L2, MOD_NET_MEM,
     MSG_EX_REP, MSG_EX_REQ, MSG_FLUSH_REP, MSG_FLUSH_REQ, MSG_INV_REP,
     MSG_INV_REQ, MSG_NONE, MSG_NULLIFY, MSG_SH_REP, MSG_SH_REQ, MSG_WB_REP,
@@ -133,6 +134,32 @@ def lowest_sharer(words: jax.Array) -> jax.Array:
     low = w & (~w + jnp.uint32(1))
     bit = jax.lax.population_count(low - jnp.uint32(1)).astype(jnp.int32)
     return jnp.where(any_bit, w_idx * 32 + bit, -1)
+
+
+# packed directory-entry word accessors (layout: memory/state.py).  All
+# pure bit math on int64 — unpacking is free ALU inside fusions.
+_TAG_MASK = (1 << DIR_TAG_BITS) - 1
+_ID_MASK = (1 << DIR_ID_BITS) - 1
+
+
+def dir_tag(word):
+    return (word & _TAG_MASK).astype(jnp.int32) - 1
+
+
+def dir_state(word):
+    return ((word >> DIR_STATE_SHIFT) & 7).astype(jnp.uint8)
+
+
+def dir_owner(word):
+    return ((word >> DIR_OWNER_SHIFT) & _ID_MASK).astype(jnp.int32) - 1
+
+
+def dir_nsh(word):
+    return ((word >> DIR_NSH_SHIFT) & _ID_MASK).astype(jnp.int32)
+
+
+def _dir_set_field(word, val, shift, mask):
+    return (word & ~(mask << shift)) | ((val.astype(I64) & mask) << shift)
 
 
 def unpack_sharers(words: jax.Array, n: int) -> jax.Array:
@@ -324,10 +351,10 @@ class _DirSetView:
     """Each home lane's directory SET at `line`, behind one interface for
     both programs:
 
-     - single-device (IDENT px): lazy way-level gathers — exactly the
-       access pattern the engine always had (a tags-row gather for the
-       lookup, one element gather per entry field), so the TPU kernel
-       count is unchanged;
+     - single-device (IDENT px): ONE lazy [T, DW] packed-word row gather
+       serves the lookup, the allocation rows, and every entry() field
+       (unpacked with free ALU bit math inside the consuming fusions),
+       plus the lazy sharers-row gather;
      - sharded px: the whole set's rows are gathered block-locally and
        exchanged in ONE collective up front; lookup/entry() are then
        replicated take_along_axis selections (a second exchange for the
@@ -338,36 +365,36 @@ class _DirSetView:
         self.sets = (line % mp.dir_sets).astype(jnp.int32)
         self._line = line
         self._sharded = px.sharded
-        self._dw = d.tags.shape[2]
+        self._dw = d.entry.shape[2]
         if px.sharded:
             line_l = px.lo(line)
-            Tl = d.tags.shape[0]
+            Tl = d.entry.shape[0]
             lt = jnp.arange(Tl, dtype=jnp.int32)
             sets_l = (line_l % mp.dir_sets).astype(jnp.int32)
-            (self._tags_r, self._dstate_r, self._owner_r, self._sharers_r,
-             self._nsh_r) = px.ag((
-                 d.tags[lt, sets_l], d.dstate[lt, sets_l],
-                 d.owner[lt, sets_l], d.sharers[lt, sets_l],
-                 d.nsharers[lt, sets_l]))
+            self._word_r, self._sharers_r = px.ag((
+                d.entry[lt, sets_l], d.sharers[lt, sets_l]))
         else:
             self._d = d
-            T = d.tags.shape[0]
+            T = d.entry.shape[0]
             self._tiles = jnp.arange(T, dtype=jnp.int32)
-            self._tags_r = None
+            self._word_r = None
             self._sharers_r = None
+
+    def _word_row(self):
+        """The set's packed entry words, [T, DW]."""
+        if self._word_r is None:
+            self._word_r = self._d.entry[self._tiles, self.sets]
+        return self._word_r
 
     def rows(self):
         """(tag_row, nsharers_row) — the [T, DW] set rows the allocation
         decisions (free way / min-sharer victim) need."""
-        if self._tags_r is None:
-            self._tags_r = self._d.tags[self._tiles, self.sets]
-        if self._sharded:
-            return self._tags_r, self._nsh_r
-        return self._tags_r, self._d.nsharers[self._tiles, self.sets]
+        row = self._word_row()
+        return dir_tag(row), dir_nsh(row)
 
     def lookup(self):
         """(found, way) of `line` within the set."""
-        tag_row = self.rows()[0] if self._tags_r is None else self._tags_r
+        tag_row = dir_tag(self._word_row())
         way_hits = tag_row == self._line[:, None]
         found = way_hits.any(axis=1)
         way = jnp.argmax(way_hits, axis=1).astype(jnp.int32)
@@ -384,18 +411,13 @@ class _DirSetView:
         row = self._sharers_row()
         row3 = row.reshape(row.shape[0], self._dw, -1)
         sharers = jnp.take_along_axis(row3, way[:, None, None], axis=1)[:, 0]
-        if self._sharded:
-            def sel(r):
-                return jnp.take_along_axis(r, way[:, None], axis=1)[:, 0]
-
-            return (sel(self._tags_r), sel(self._dstate_r),
-                    sel(self._owner_r), sharers, sel(self._nsh_r))
-        d, t, s = self._d, self._tiles, self.sets
-        if d.skey is not None:
+        word = jnp.take_along_axis(self._word_row(), way[:, None],
+                                   axis=1)[:, 0]
+        if not self._sharded and self._d.skey is not None:
             # staged writes since the last flush supersede the big store
-            sharers = _stage_overlay(d, s, way, sharers)
-        return (d.tags[t, s, way], d.dstate[t, s, way], d.owner[t, s, way],
-                sharers, d.nsharers[t, s, way])
+            sharers = _stage_overlay(self._d, self.sets, way, sharers)
+        return (dir_tag(word), dir_state(word), dir_owner(word),
+                sharers, dir_nsh(word))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -500,7 +522,7 @@ def mem_idle_out(mp: MemParams, ms, rec: "RecView", enabled) -> MemStepOut:
 
 
 def _stage_key(d, sets, way):
-    T, DS, DW = d.tags.shape
+    T, DS, DW = d.entry.shape
     tiles = jnp.arange(T, dtype=jnp.int32)
     return (tiles * DS + sets) * DW + way
 
@@ -548,7 +570,7 @@ def dir_stage_flush(d):
     place."""
     if d.skey is None:
         return d
-    T, DS, DW = d.tags.shape
+    T, DS, DW = d.entry.shape
     SW = d.sval.shape[1]
     C = d.skey.shape[0]
     valid = d.skey >= 0
@@ -581,24 +603,29 @@ def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
     replicated full-width; a sharded px applies only this device's home
     rows."""
     sets, way, mask = px.lo((sets, way, mask))
-    T = d.tags.shape[0]
+    T = d.entry.shape[0]
     tiles = jnp.arange(T, dtype=jnp.int32)
     out = d
 
-    def delta(arr, new, m):
-        new = px.lo(new)
-        cur = arr[tiles, sets, way]
-        return arr.at[tiles, sets, way].add(
-            jnp.where(m, new - cur, jnp.zeros_like(cur)),
-            unique_indices=True, indices_are_sorted=True)
-
+    # ONE packed RMW scatter updates every written word field together
+    # (four separate arrays cost four dense-lowered scatters plus their
+    # layout-conversion copies each phase)
+    cur = out.entry[tiles, sets, way]
+    new = cur
     if tags is not None:
-        out = out.replace(tags=delta(out.tags, tags, mask))
+        new = _dir_set_field(new, px.lo(tags).astype(I64) + 1, 0, _TAG_MASK)
     if dstate is not None:
-        out = out.replace(dstate=delta(
-            out.dstate, jnp.asarray(dstate, jnp.uint8), mask))
+        new = _dir_set_field(new, px.lo(jnp.asarray(dstate, jnp.uint8)),
+                             DIR_STATE_SHIFT, 7)
     if owner is not None:
-        out = out.replace(owner=delta(out.owner, owner, mask))
+        new = _dir_set_field(new, px.lo(owner).astype(I64) + 1,
+                             DIR_OWNER_SHIFT, _ID_MASK)
+    if nsharers is not None:
+        new = _dir_set_field(new, px.lo(nsharers), DIR_NSH_SHIFT, _ID_MASK)
+    if new is not cur:
+        out = out.replace(entry=out.entry.at[tiles, sets, way].add(
+            jnp.where(mask, new - cur, jnp.zeros_like(cur)),
+            unique_indices=True, indices_are_sorted=True))
     if sharers is not None:
         new_sh = px.lo(sharers)                       # [Tl, SW]
         if out.skey is not None:
@@ -611,7 +638,7 @@ def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
             # set row, placing the entry's [SW] words at its way's slot
             # (per-lane rows unique, so the 2D-indexed add aliases in
             # place)
-            DW = out.tags.shape[2]
+            DW = out.entry.shape[2]
             row = out.sharers[tiles, sets]            # [Tl, DW*SW]
             row3 = row.reshape(row.shape[0], DW, -1)
             onehot = (jnp.arange(DW, dtype=jnp.int32)[None, :, None]
@@ -620,8 +647,6 @@ def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
             out = out.replace(sharers=out.sharers.at[tiles, sets].add(
                 (new3 - row3).reshape(row.shape),
                 unique_indices=True, indices_are_sorted=True))
-    if nsharers is not None:
-        out = out.replace(nsharers=delta(out.nsharers, nsharers, mask))
     return out
 
 

@@ -272,6 +272,15 @@ class MemParams:
     def from_config(cls, sc: SimConfig) -> "MemParams":
         cfg = sc.cfg
         T = sc.application_tiles
+        if T > 8190 and cfg.get_string(
+                "caching_protocol/type",
+                "pr_l1_pr_l2_dram_directory_msi").startswith("pr_l1_pr_l2"):
+            # packed directory-entry words carry owner/nsharers in
+            # 13-bit fields (memory/state.py DIR_ID_BITS); the shared-L2
+            # engines keep plain int32 arrays and have no such limit
+            raise NotImplementedError(
+                "private-L2 directory protocols support at most 8190 "
+                "tiles")
         spec = sc.tile_spec(0)
         l1d_sec = f"l1_dcache/{spec.l1_dcache_type}"
         line = cfg.get_int(f"{l1d_sec}/cache_line_size", 64)

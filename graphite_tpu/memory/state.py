@@ -67,6 +67,19 @@ MOD_DIR = 4
 MOD_NET_MEM = 5
 
 
+# Packed directory-entry word layout (int64[T, DS, DW]): one scatter
+# per engine phase updates (tag, dstate, owner, nsharers) together —
+# four separate arrays cost four dense-lowered scatters plus their
+# layout-conversion copies each phase (PERF.md round-5).  The all-zero
+# word IS the free entry (tag+1 = 0, owner+1 = 0 -> -1, UNCACHED, 0
+# sharers), so init is plain zeros.
+DIR_TAG_BITS = 34        # bits 0..33: line + 1 (0 = free)
+DIR_STATE_SHIFT = 34     # bits 34..36: directory state
+DIR_OWNER_SHIFT = 37     # bits 37..49: owner tile + 1
+DIR_NSH_SHIFT = 50       # bits 50..62: sharer count
+DIR_ID_BITS = 13         # owner/nsharers field width (tiles <= 8190)
+
+
 @struct.dataclass
 class DirectoryArrays:
     """Per-home-slice directory cache (`cache/directory_cache.h:20-68`).
@@ -77,16 +90,14 @@ class DirectoryArrays:
     and the whole-array copies it targeted barely moved (PERF.md
     round-3 findings)."""
 
-    tags: jax.Array      # int32[T, DS, DW] line address, -1 = free
-    dstate: jax.Array    # uint8[T, DS, DW]
-    owner: jax.Array     # int32[T, DS, DW]
+    # packed (tag, dstate, owner, nsharers) word per entry — layout above
+    entry: jax.Array     # int64[T, DS, DW]
     # full-map bitvector, stored set-row-major [T, DS, DW*SW] (way w's
     # words at [.., w*SW:(w+1)*SW]): a [T, DS, DW, SW] layout pads SW up
     # to the 128-lane tile on TPU (4x physical at 1024 tiles — PERF.md
     # "array padding"), and the set-row form matches how every phase
     # reads it anyway
     sharers: jax.Array   # uint32[T, DS, DW*SW]
-    nsharers: jax.Array  # int32[T, DS, DW] cached popcount
     # sharers write-staging table (MemParams.dir_stage_cap > 0; see
     # engine._stage_put / dir_stage_flush).  Unique-key invariant: at
     # most one live slot per directory entry — writes overwrite their
@@ -288,11 +299,8 @@ def init_mem_state(mp: MemParams) -> MemState:
         return jnp.zeros(T, I64)
 
     directory = DirectoryArrays(
-        tags=jnp.full((T, DS, DW), -1, jnp.int32),
-        dstate=jnp.zeros((T, DS, DW), jnp.uint8),
-        owner=jnp.full((T, DS, DW), -1, jnp.int32),
+        entry=jnp.zeros((T, DS, DW), I64),
         sharers=jnp.zeros((T, DS, DW * SW), jnp.uint32),
-        nsharers=jnp.zeros((T, DS, DW), jnp.int32),
         skey=(jnp.full((mp.dir_stage_cap,), -1, jnp.int32)
               if mp.dir_stage_cap else None),
         sval=(jnp.zeros((mp.dir_stage_cap, SW), jnp.uint32)

@@ -124,8 +124,7 @@ _SHARD_MAP_LOCAL = {
     "core.bp_bits",
     "mem.l1i.meta", "mem.l1d.meta", "mem.l2.meta",
     "mem.l2_cloc", "mem.mt",
-    "mem.directory.tags", "mem.directory.dstate", "mem.directory.owner",
-    "mem.directory.sharers", "mem.directory.nsharers",
+    "mem.directory.entry", "mem.directory.sharers",
 }
 
 
