@@ -53,8 +53,7 @@ def run_fft_app(n_tiles: int = 4, n_points: int = 128, seed: int = 9):
     trace plus the program's actual numeric input/output for the
     numerical check."""
     from graphite_tpu.frontend import carbon_api as capi
-    from graphite_tpu.config import ConfigFile, SimConfig
-    from graphite_tpu.tools._template import config_text
+    from graphite_tpu.tools.capture import make_app, run_threads
 
     N = n_points
     stages = int(math.log2(N))
@@ -78,18 +77,6 @@ def run_fft_app(n_tiles: int = 4, n_points: int = 128, seed: int = 9):
     # once, like the reference FFT's twiddle array)
     wre = [_fx(math.cos(-2 * math.pi * k / N)) for k in range(N // 2)]
     wim = [_fx(math.sin(-2 * math.pi * k / N)) for k in range(N // 2)]
-
-    sc = SimConfig(ConfigFile.from_string(config_text(
-        n_tiles, shared_mem=True, clock_scheme="lax")))
-    app = capi.CarbonApp(sc)
-
-    def main_fn():
-        bar = capi.CarbonBarrier(n_tiles)
-        tids = [capi.carbon_spawn_thread(worker, t, bar)
-                for t in range(1, n_tiles)]
-        worker(0, bar)
-        for tid in tids:
-            capi.carbon_join_thread(tid)
 
     def worker(tile, bar):
         # stage -1: bit-reverse permuted input, tile-partitioned writes
@@ -135,7 +122,8 @@ def run_fft_app(n_tiles: int = 4, n_points: int = 128, seed: int = 9):
                     bidx += 1
             bar.wait()
 
-    batch = app.start(main_fn)
+    app = make_app(n_tiles)
+    batch = run_threads(app, worker, n_tiles)
 
     # the program's actual output, from the functional store
     out = np.empty(N, np.complex128)
@@ -153,55 +141,27 @@ def verify_numerics(x_c, out, n_points) -> float:
     return float(np.abs(out - ref).max() / scale)
 
 
-def measured_mix(batch) -> dict:
-    """Instruction/memory mix of the captured trace, by record type."""
-    from graphite_tpu.trace.schema import (
-        FLAG_MEM0_VALID, FLAG_MEM0_WRITE, Op,
-    )
-
-    op = batch.op
-    flags = batch.flags
-    mem = (flags & FLAG_MEM0_VALID) != 0
-    return {
-        "records": int((op != int(Op.NOP)).sum()),
-        "fmul": int((op == int(Op.FMUL)).sum()),
-        "falu": int((op == int(Op.FALU)).sum()),
-        "ialu": int((op == int(Op.IALU)).sum()),
-        "loads": int((mem & ((flags & FLAG_MEM0_WRITE) == 0)).sum()),
-        "stores": int((mem & ((flags & FLAG_MEM0_WRITE) != 0)).sum()),
-    }
+# shared with the generalized harness (tools/capture.py) — re-exported
+# so existing callers keep working
+from graphite_tpu.tools.capture import measured_mix  # noqa: E402
 
 
 def main(out_path: str = "fft_captured.npz",
          n_tiles: int = 4, n_points: int = 128) -> dict:
-    from graphite_tpu.config import ConfigFile, SimConfig
-    from graphite_tpu.engine.simulator import Simulator
-    from graphite_tpu.tools._template import config_text
-    from graphite_tpu.trace.io import load_trace_npz, save_trace_npz
+    from graphite_tpu.tools.capture import replay_report
 
     batch, x_c, out = run_fft_app(n_tiles, n_points)
     err = verify_numerics(x_c, out, n_points)
-    save_trace_npz(out_path, batch)
-    batch2 = load_trace_npz(out_path)
-
-    sc = SimConfig(ConfigFile.from_string(config_text(
-        n_tiles, shared_mem=True, clock_scheme="lax")))
-    res = Simulator(sc, batch2).run()
-    mix = measured_mix(batch2)
+    report = replay_report(batch, n_tiles, out_path)
+    mix = report["mix"]
     stages = int(math.log2(n_points))
     butterflies = (n_points // 2) * stages
-    report = {
-        "npz": out_path,
-        "numeric_max_rel_err": err,
-        "func_errors": res.func_errors,
-        "completion_ns": res.completion_time_ps // 1000,
-        "instructions": res.total_instructions,
-        "l2_misses": int(np.asarray(res.mem_counters["l2_misses"]).sum()),
-        "mix": mix,
-        "fp_per_butterfly": (mix["fmul"] + mix["falu"]) / butterflies,
-        "mem_refs_per_butterfly": (mix["loads"] + mix["stores"])
+    report.update(
+        numeric_max_rel_err=err,
+        fp_per_butterfly=(mix["fmul"] + mix["falu"]) / butterflies,
+        mem_refs_per_butterfly=(mix["loads"] + mix["stores"])
         / butterflies,
-    }
+    )
     return report
 
 
