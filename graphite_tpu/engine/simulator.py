@@ -135,6 +135,20 @@ class SimResults:
                                f"{int(mc['l2_capacity_misses'][t])}")
                     out.append("      Sharing Misses: "
                                f"{int(mc['l2_sharing_misses'][t])}")
+                # cache-line utilization (cache_line_utilization.h; under
+                # `[l2_cache/<type>] track_cache_line_utilization`)
+                if ("line_util_hist" in mc
+                        and int(np.asarray(mc["line_util_hist"][t]).sum())):
+                    hist = np.asarray(mc["line_util_hist"][t])
+                    out.append("    Cache Line Utilization (L2):")
+                    out.append("      Total Reads: "
+                               f"{int(mc['line_util_reads'][t])}")
+                    out.append("      Total Writes: "
+                               f"{int(mc['line_util_writes'][t])}")
+                    labels = ("0", "1", "2-3", "4-7", "8-15", "16-31",
+                              "32-63", ">=64")
+                    for lb, n in zip(labels, hist):
+                        out.append(f"      Accesses {lb}: {int(n)}")
             out.append("  Network Summary (USER):")
             out.append(f"    Packets Sent: {int(self.packets_sent[t])}")
             out.append(f"    Packets Received: {int(self.packets_received[t])}")
@@ -319,10 +333,9 @@ class Simulator:
         dominates; see MemParams.dir_stage_cap).
 
         `spmd` (mesh runs only): "shard_map" — the packed-exchange
-        multi-chip program (parallel/px.py; the default where supported) —
-        or "gspmd" — whole-program partitioning via sharding specs (the
-        legacy path; also the automatic fallback for the shared-L2
-        protocols until their engine takes the exchange context).
+        multi-chip program (parallel/px.py; the default for every
+        protocol) — or "gspmd" — whole-program partitioning via
+        sharding specs (the legacy path).
 
         `donate=True` gives the input state's device buffers to XLA each
         run (halves big-state HBM residency — required for the 1024-tile
@@ -561,22 +574,15 @@ class Simulator:
         self.stream = bool(stream)
         self.mesh = mesh
         # Multi-chip program selection: the packed shard_map exchange is
-        # the default (one collective per engine phase; PERF.md); the
-        # shared-L2 engines still ride GSPMD specs until they take the
-        # exchange context.
+        # the default for EVERY protocol (one collective per engine
+        # phase; PERF.md) — the reference's process striping serves
+        # every protocol equally.  spmd='gspmd' keeps the legacy
+        # whole-program-partitioning path.
         if spmd not in (None, "shard_map", "gspmd"):
             raise ValueError(f"unknown spmd program {spmd!r} "
                              "(expected 'shard_map' or 'gspmd')")
-        shl2 = (mem_params is not None
-                and mem_params.protocol.startswith("pr_l1_sh_l2"))
-        if mesh is not None and spmd == "shard_map" and shl2:
-            # fail at the misconfiguration site, not as a
-            # NotImplementedError from shl2_engine_step mid-trace
-            raise ValueError(
-                "the shared-L2 protocols do not take the shard_map "
-                "exchange yet; use spmd='gspmd' (the default for them)")
         if mesh is not None and spmd is None:
-            spmd = "gspmd" if shl2 else "shard_map"
+            spmd = "shard_map"
         self.spmd = spmd if mesh is not None else None
         self.device_trace = None if stream else DeviceTrace.from_batch(trace)
         if mesh is not None:

@@ -223,8 +223,42 @@ class GoldenMemory:
                       "evictions", "invalidations", "dir_accesses",
                       "dir_broadcasts", "dram_reads", "dram_writes",
                       "dram_total_lat_ps", "l2_cold_misses",
-                      "l2_capacity_misses", "l2_sharing_misses")
+                      "l2_capacity_misses", "l2_sharing_misses",
+                      "line_util_reads", "line_util_writes")
         }
+        # L2 cache-line utilization (`cache_line_utilization.h`): per-line
+        # [reads, writes] while resident, keyed (set, way) like the
+        # engine's packed counter cell; histogram of totals on departure
+        self.counters["line_util_hist"] = [[0] * 8 for _ in range(T)]
+        self.l2_util = [dict() for _ in range(T)]
+
+    # -- L2 cache-line utilization (engine's _util_* counterparts) --------
+
+    def _util_touch(self, t, line, way, write, enabled):
+        if not (self.mp.l2.track_line_utilization and enabled):
+            return
+        u = self.l2_util[t].setdefault((line % self.l2[t].sets, way),
+                                       [0, 0])
+        if u[write] < 0xFFFF:
+            u[write] += 1
+
+    def _util_depart(self, t, line, way, enabled):
+        """Classify + drop the counter of a line leaving (set, way)."""
+        if not (self.mp.l2.track_line_utilization and enabled):
+            return
+        key = (line % self.l2[t].sets, way)
+        rd, wr = self.l2_util[t].pop(key, (0, 0))
+        total = rd + wr
+        self.counters["line_util_hist"][t][min(7, total.bit_length())] += 1
+        self.counters["line_util_reads"][t] += rd
+        self.counters["line_util_writes"][t] += wr
+
+    def _util_init(self, t, line, way, write, enabled):
+        """A filled line's counter restarts with the miss access itself."""
+        if not (self.mp.l2.track_line_utilization and enabled):
+            return
+        self.l2_util[t][(line % self.l2[t].sets, way)] = (
+            [0, 1] if write else [1, 0])
 
     # -- L2 miss-type tracking (`cache.h:45-49`, hashed-bucket model) ------
 
@@ -379,6 +413,7 @@ class GoldenMemory:
                 self.l1i[s].invalidate(line)
             elif cloc == MOD_L1D:
                 self.l1d[s].invalidate(line)
+            self._util_depart(s, line, way, enabled)
             self.l2[s].set_state(line, way, INVALID)
             self._mt_invalidate(s, line)
             self.l2_cloc[s].pop((line % self.l2[s].sets, way), None)
@@ -674,6 +709,7 @@ class GoldenMemory:
         if l2_hit and (_writable(l2_st) if write else _readable(l2_st)):
             if enabled:
                 c["l2_hits"][t] += 1
+            self._util_touch(t, line, l2_way, write, enabled)
             done = (sclock + l1_tag + self._sync(t, comp, MOD_L2, enabled)
                     + self._cc(t, mp.l2.data_and_tags_cycles, enabled)
                     + l1_dat)
@@ -693,6 +729,7 @@ class GoldenMemory:
         self._mt_classify(t, line, enabled)
         if l2_hit and write and l2_st in (SHARED, OWNED):
             dirty = l2_st == OWNED
+            self._util_depart(t, line, l2_way, enabled)
             l2.set_state(line, l2_way, INVALID)
             self._mt_invalidate(t, line)
             self.l2_cloc[t].pop((line % self.l2[t].sets, l2_way), None)
@@ -720,8 +757,10 @@ class GoldenMemory:
                 fill_l2, enabled)
             self.l2_cloc[t].pop((v_line % self.l2[t].sets, v_way), None)
             self._apply_eviction(t, v_line, v_dirty, e_arr, enabled)
+            self._util_depart(t, v_line, v_way, enabled)
         self._mt_insert(t, line)
         l2.insert_at(line, v_way, new_state)
+        self._util_init(t, line, v_way, write, enabled)
         self._fill_l1(t, is_icache, line, new_state, v_way)
         done = fill_l2 + l1_dat
         return done - clock_ps

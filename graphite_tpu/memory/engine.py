@@ -284,6 +284,57 @@ def mem_net_fanout(mp: MemParams, noc, send_hs, bits: int, t0_ps, enabled):
     return noc, arrival
 
 
+# --------------------------------------------------------------------------
+# L2 cache-line utilization (`cache/cache_line_utilization.h`: per-line
+# read/write access counters; harvested at the MOSI L2 controller's
+# eviction/invalidation hook points, `mosi/l2_cache_cntlr.cc:120`).
+# Packed uint32 per line: low 16 bits = reads, high 16 = writes
+# (saturating).  Classified into a log2 histogram (0, 1, 2-3, ..., >=64)
+# when the line leaves the L2.
+
+
+def _util_inc(cur, is_write, mask):
+    """Saturating read/write increment of packed util counters [T]."""
+    inc = jnp.where(is_write, jnp.uint32(1) << 16, jnp.uint32(1))
+    fld = jnp.where(is_write, cur >> 16, cur & jnp.uint32(0xFFFF))
+    return jnp.where(mask & (fld < 0xFFFF), cur + inc, cur)
+
+
+def _util_classify(counters, util_val, mask, enabled):
+    """Histogram a departing line's packed util counter."""
+    rd = (util_val & jnp.uint32(0xFFFF)).astype(I64)
+    wr = (util_val >> 16).astype(I64)
+    total = (rd + wr).astype(jnp.int32)
+    bucket = jnp.minimum(7, 32 - jax.lax.clz(total)).astype(jnp.int32)
+    m = mask & jnp.asarray(enabled, bool)
+    tiles = jnp.arange(util_val.shape[0], dtype=jnp.int32)
+    return counters.replace(
+        line_util_hist=counters.line_util_hist.at[tiles, bucket].add(
+            m.astype(I64), unique_indices=True),
+        line_util_reads=counters.line_util_reads + jnp.where(m, rd, 0),
+        line_util_writes=counters.line_util_writes + jnp.where(m, wr, 0))
+
+
+def _util_row_local(l2_util, line_l, sets_mod_l):
+    """This device's [Tl, W2] util row at each local lane's L2 set (the
+    cross-device exchange happens via _rows_exchange at the call sites)."""
+    Tl = l2_util.shape[0]
+    lt = jnp.arange(Tl, dtype=jnp.int32)
+    sets_l = (line_l % jnp.asarray(sets_mod_l)).astype(jnp.int32)
+    return l2_util[lt, sets_l]
+
+
+def _util_scatter(px: ParallelCtx, l2_util, line, sets_mod, way, cur, new):
+    """Apply per-lane packed-counter updates block-locally (add-a-delta,
+    unique rows)."""
+    sets = (line % jnp.asarray(sets_mod)).astype(jnp.int32)
+    sets_l, way_l, cur_l, new_l = px.lo((sets, way, cur, new))
+    Tl = l2_util.shape[0]
+    lt = jnp.arange(Tl, dtype=jnp.int32)
+    return l2_util.at[lt, sets_l, way_l].add(
+        new_l - cur_l, unique_indices=True, indices_are_sorted=True)
+
+
 def _mt_bit(line):
     """Hash bucket of a line in the miss-type bitmaps (MT_BITS buckets)."""
     from graphite_tpu.memory.state import MT_BITS
@@ -763,8 +814,13 @@ def memory_engine_step(
                          _mt_test(ms.mt, MT_FETCHED, s_line_l))
         else:
             mt_bits_l = ()
+        if mp.l2.track_line_utilization:
+            mt_bits_l = mt_bits_l + (_util_row_local(
+                ms.l2_util, s_line_l, px.lo_const(mp.l2.sets_mod)),)
         (l1i_row, l1d_row, l2_row), mt_bits = _rows_exchange(
             px, rows_l, mt_bits_l)
+        if mp.l2.track_line_utilization:
+            lu_row, mt_bits = mt_bits[-1], mt_bits[:-1]
         l1i_hit, l1i_way, l1i_state = ca.row_lookup(l1i_row, s_line)
         l1d_hit, l1d_way, l1d_state = ca.row_lookup(l1d_row, s_line)
         l1_state = jnp.where(s_comp_l1i, l1i_state, l1d_state)
@@ -866,6 +922,19 @@ def memory_engine_step(
         # for a dirty OWNED line)
         up_go = upgrade & ~stall_start
         l2_row = ca.row_invalidate(l2_row, s_line, up_go)
+        if mp.l2.track_line_utilization:
+            # L2 hit: count the access; upgrade invalidate: the line
+            # leaves the L2 — classify its counters and zero them
+            en = jnp.asarray(enabled, bool)
+            lu_cur = jnp.take_along_axis(lu_row, l2_way[:, None],
+                                         axis=1)[:, 0]
+            lu_new = _util_inc(lu_cur, s_write, l2_hit_now & en)
+            lu_new = jnp.where(up_go & en, jnp.uint32(0), lu_new)
+            ms = ms.replace(l2_util=_util_scatter(
+                px, ms.l2_util, s_line, mp.l2.sets_mod, l2_way,
+                lu_cur, lu_new))
+            ms = ms.replace(counters=_util_classify(
+                ms.counters, lu_cur, up_go, enabled))
         # scatter the three set rows back — ONE scatter per cache level,
         # each device taking its own lanes' rows
         l1i_upd = ca.scatter_row(ms.l1i, px.lo(l1i_row))
@@ -1091,12 +1160,19 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     rows_l = (ca.gather_row(ms.l2, fline_l, l2_mod_l),
               ca.gather_row(ms.l1i, fline_l, px.lo_const(mp.l1i.sets_mod)),
               ca.gather_row(ms.l1d, fline_l, px.lo_const(mp.l1d.sets_mod)))
+    util_row_l = (_util_row_local(ms.l2_util, fline_l, l2_mod_l)
+                  if mp.l2.track_line_utilization else None)
     if px.sharded:
-        (l2_r, l1i_r, l1d_r), (cloc_row,) = _rows_exchange(
-            px, rows_l, (ms.l2_cloc[lt, sets_l],))
+        extras = (ms.l2_cloc[lt, sets_l],)
+        if util_row_l is not None:
+            extras = extras + (util_row_l,)
+        (l2_r, l1i_r, l1d_r), extras = _rows_exchange(px, rows_l, extras)
+        cloc_row = extras[0]
+        lu_row = extras[1] if util_row_l is not None else None
     else:
         l2_r, l1i_r, l1d_r = rows_l
         cloc_row = None
+        lu_row = util_row_l
     l2_hit, l2_way, l2_state = ca.row_lookup(l2_r, fline)
     serve = found & l2_hit & (l2_state != INVALID)
     silent = found & ~serve  # already evicted; eviction msg satisfies home
@@ -1139,6 +1215,15 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     l2_r = ca.row_invalidate(l2_r, fline, inv_l1)
     l2_r = ca.row_set_state(l2_r, l2_way, wb_state, wb_l1)
     l2 = ca.scatter_row(ms.l2, px.lo(l2_r))
+    if mp.l2.track_line_utilization:
+        # the INV/FLUSH'd line leaves the L2: classify + zero its counters
+        en = jnp.asarray(enabled, bool)
+        lu_cur = jnp.take_along_axis(lu_row, l2_way[:, None], axis=1)[:, 0]
+        ms = ms.replace(
+            l2_util=_util_scatter(
+                px, ms.l2_util, fline, mp.l2.sets_mod, l2_way, lu_cur,
+                jnp.where(inv_l1 & en, jnp.uint32(0), lu_cur)),
+            counters=_util_classify(ms.counters, lu_cur, inv_l1, enabled))
     if mp.l2.track_miss_types:
         ms = ms.replace(mt=_mt_update(ms.mt, MT_INVALIDATED, fline_l,
                                       px.lo(inv_l1), True))
@@ -1743,7 +1828,12 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
                      _mt_test(ms.mt, MT_INVALIDATED, line_l))
     else:
         mt_bits_l = ()
+    if mp.l2.track_line_utilization:
+        mt_bits_l = mt_bits_l + (_util_row_local(
+            ms.l2_util, line_l, px.lo_const(mp.l2.sets_mod)),)
     (l2_r, l1i_r, l1d_r), mt_bits = _rows_exchange(px, rows_l, mt_bits_l)
+    if mp.l2.track_line_utilization:
+        lu_row, mt_bits = mt_bits[-1], mt_bits[:-1]
 
     # L2 victim for the fill; a valid victim emits an eviction message that
     # needs its (home, us) EVICT cell free — else stall this iteration
@@ -1759,6 +1849,19 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
     new_state = jnp.where(mail.rep_type == MSG_EX_REP, MODIFIED, SHARED)
     l2 = ca.scatter_row(ms.l2, px.lo(ca.row_insert(l2_r, line, way,
                                                    new_state, fill)))
+    if mp.l2.track_line_utilization:
+        # the victim leaves the L2 (classify); the filled line's counter
+        # restarts with the miss access itself as its first use
+        en = jnp.asarray(enabled, bool)
+        lu_cur = jnp.take_along_axis(lu_row, way[:, None], axis=1)[:, 0]
+        init = jnp.where(ms.req.is_write, jnp.uint32(1) << 16,
+                         jnp.uint32(1))
+        ms = ms.replace(
+            l2_util=_util_scatter(
+                px, ms.l2_util, line, mp.l2.sets_mod, way, lu_cur,
+                jnp.where(fill & en, init, lu_cur)),
+            counters=_util_classify(ms.counters, lu_cur, evict_go,
+                                    enabled))
     sets = (line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     l2_cloc = px.entry_set(
         ms.l2_cloc, *px.lo((

@@ -12,7 +12,21 @@ read of an uncached line (`pr_l1_sh_l2_mesi/l2_cache_cntlr.cc:660-680`).
 
 Vectorized form mirrors engine.py's discipline: one lane per tile, dense
 mailboxes, one active transaction per home, simulated time carried in
-messages.  Documented simplifications (same class as engine.py's):
+messages.  Like engine.py, the engine takes the packed shard_map
+exchange context (`parallel/px.py`): every phase gathers its lanes' L1 /
+L2-slice / embedded-directory rows block-locally, exchanges them in ONE
+packed all-gather, computes full-width on replicated control state, and
+scatters row deltas back to this device's block — so shared-L2 meshes
+ride the same one-collective-per-phase program as the private-L2 engines
+(the reference's process striping serves every protocol equally,
+`config.cc` computeProcessToTileMapping + `socktransport.cc`).
+
+The embedded directory is stored packed like the private engine's
+(state/owner/nsharers/cloc in ONE int64 word per L2 line, all-zero =
+UNCACHED; sharer bitvectors set-row-major [T, S2, W2*SW] so the minor
+dim stays un-padded on TPU — PERF.md "array padding").
+
+Documented simplifications (same class as engine.py's):
  - upgrade replies are modeled as EX_REP (same message count, the data
    serialization is slightly larger than the reference's UPGRADE_REP);
  - one transaction per home serializes same-home requests (the reference
@@ -33,9 +47,9 @@ from graphite_tpu.memory.cache_array import (
     state_readable, state_writable,
 )
 from graphite_tpu.memory.engine import (
-    MemStepOut, RecView, _row_earliest, clear_bit, lowest_sharer,
-    mem_net_fanout, mem_net_latency_ps, mem_net_send, set_bit,
-    test_bit, unpack_sharers,
+    MemStepOut, RecView, _dir_set_field, _ID_MASK, _row_earliest,
+    _rows_exchange, clear_bit, lowest_sharer, mem_net_fanout,
+    mem_net_latency_ps, mem_net_send, set_bit, test_bit, unpack_sharers,
 )
 from graphite_tpu.memory.params import MemParams
 from graphite_tpu.memory.state import (
@@ -47,6 +61,7 @@ from graphite_tpu.memory.state import (
     PHASE_IDLE, PHASE_WAIT_REPLY,
     MemCounters, MemMailboxes, RequesterState, init_mem_common,
 )
+from graphite_tpu.parallel.px import IDENT, ParallelCtx
 from graphite_tpu.time_types import cycles_to_ps
 from graphite_tpu.trace.schema import (
     FLAG_CHECK, FLAG_MEM0_VALID, FLAG_MEM0_WRITE, FLAG_MEM1_VALID,
@@ -64,16 +79,101 @@ DATA_INVALID = 5
 # MESI directory state for an exclusive clean L1 copy
 DIR_EXCLUSIVE = 4
 
+# packed embedded-directory word layout (int64[T, S2, W2]; all-zero word =
+# UNCACHED, owner -1, 0 sharers, cloc 0):
+SHL2_STATE_SHIFT = 0    # bits 0..2: directory state
+SHL2_OWNER_SHIFT = 3    # bits 3..15: owner tile + 1
+SHL2_NSH_SHIFT = 16     # bits 16..28: sharer count
+SHL2_CLOC_SHIFT = 29    # bits 29..30: caching component (MOD_L1I/L1D)
+
 
 @struct.dataclass
 class ShL2Dir:
-    """Per-L2-line embedded directory [T(home), S2, W2, ...]."""
+    """Per-L2-line embedded directory, packed (layout above)."""
 
-    dstate: jax.Array    # uint8
-    owner: jax.Array     # int32
-    sharers: jax.Array   # uint32[..., SW]
-    nsharers: jax.Array  # int32
-    cloc: jax.Array      # uint8 — caching component (MOD_L1I / MOD_L1D)
+    word: jax.Array      # int64[T(home), S2, W2]
+    sharers: jax.Array   # uint32[T(home), S2, W2*SW] set-row-major
+
+
+def _d_state(w):
+    return (w & 7).astype(jnp.uint8)
+
+
+def _d_owner(w):
+    return ((w >> SHL2_OWNER_SHIFT) & _ID_MASK).astype(jnp.int32) - 1
+
+
+def _d_nsh(w):
+    return ((w >> SHL2_NSH_SHIFT) & _ID_MASK).astype(jnp.int32)
+
+
+def _d_cloc(w):
+    return ((w >> SHL2_CLOC_SHIFT) & 3).astype(jnp.uint8)
+
+
+def _dir_rows_local(d: ShL2Dir, sets_l):
+    """This device's [Tl, W2] word row + [Tl, W2*SW] sharers row at each
+    local lane's set (exchanged via _rows_exchange at the call sites)."""
+    Tl = d.word.shape[0]
+    lt = jnp.arange(Tl, dtype=jnp.int32)
+    return d.word[lt, sets_l], d.sharers[lt, sets_l]
+
+
+def _entry_at(dw, dsh, way):
+    """(dstate, owner, sharers, nsh, cloc) at `way` from full-width rows."""
+    word = jnp.take_along_axis(dw, way[:, None], axis=1)[:, 0]
+    W2 = dw.shape[1]
+    sh3 = dsh.reshape(dsh.shape[0], W2, -1)
+    sharers = jnp.take_along_axis(sh3, way[:, None, None], axis=1)[:, 0]
+    return (_d_state(word), _d_owner(word), sharers, _d_nsh(word),
+            _d_cloc(word))
+
+
+def _row_update(dw, way, mask, *, dstate=None, owner=None, nsharers=None,
+                cloc=None):
+    """Masked per-lane field update of the entry at `way` in the [T, W2]
+    word row (pure bit math; the phase's single scatter applies it)."""
+    word = jnp.take_along_axis(dw, way[:, None], axis=1)[:, 0]
+    new = word
+    if dstate is not None:
+        new = _dir_set_field(new, jnp.asarray(dstate, jnp.uint8),
+                             SHL2_STATE_SHIFT, 7)
+    if owner is not None:
+        new = _dir_set_field(new, owner.astype(I64) + 1,
+                             SHL2_OWNER_SHIFT, _ID_MASK)
+    if nsharers is not None:
+        new = _dir_set_field(new, nsharers, SHL2_NSH_SHIFT, _ID_MASK)
+    if cloc is not None:
+        new = _dir_set_field(new, cloc, SHL2_CLOC_SHIFT, 3)
+    onehot = (jnp.arange(dw.shape[1], dtype=jnp.int32)[None, :]
+              == way[:, None]) & mask[:, None]
+    return jnp.where(onehot, new[:, None], dw)
+
+
+def _rowsh_update(dsh, way, mask, new_sh):
+    """Masked per-lane sharers write at `way` in the [T, W2*SW] row."""
+    W2SW = dsh.shape[1]
+    SW = new_sh.shape[1]
+    W2 = W2SW // SW
+    sh3 = dsh.reshape(dsh.shape[0], W2, SW)
+    onehot = (jnp.arange(W2, dtype=jnp.int32)[None, :, None]
+              == way[:, None, None]) & mask[:, None, None]
+    return jnp.where(onehot, new_sh[:, None, :], sh3).reshape(
+        dsh.shape[0], W2SW)
+
+
+def _dir_scatter(d: ShL2Dir, px: ParallelCtx, sets, dw0, dw, dsh0, dsh):
+    """Apply the phase's accumulated full-width row updates block-locally:
+    ONE add-a-delta scatter per array (per-lane rows unique, aliases in
+    place)."""
+    sets_l, dw0_l, dw_l, dsh0_l, dsh_l = px.lo((sets, dw0, dw, dsh0, dsh))
+    Tl = d.word.shape[0]
+    lt = jnp.arange(Tl, dtype=jnp.int32)
+    return d.replace(
+        word=d.word.at[lt, sets_l].add(
+            dw_l - dw0_l, unique_indices=True, indices_are_sorted=True),
+        sharers=d.sharers.at[lt, sets_l].add(
+            dsh_l - dsh0_l, unique_indices=True, indices_are_sorted=True))
 
 
 @struct.dataclass
@@ -124,11 +224,8 @@ def init_shl2_state(mp: MemParams) -> ShL2State:
     S2, W2 = mp.l2.num_sets, mp.l2.num_ways
     SW = mp.sharer_words
     zdir = ShL2Dir(
-        dstate=jnp.zeros((T, S2, W2), jnp.uint8),
-        owner=jnp.full((T, S2, W2), -1, jnp.int32),
-        sharers=jnp.zeros((T, S2, W2, SW), U32),
-        nsharers=jnp.zeros((T, S2, W2), jnp.int32),
-        cloc=jnp.zeros((T, S2, W2), jnp.uint8),
+        word=jnp.zeros((T, S2, W2), I64),
+        sharers=jnp.zeros((T, S2, W2 * SW), U32),
     )
     txn = ShL2Txn(
         active=jnp.zeros(T, jnp.bool_),
@@ -170,33 +267,6 @@ def _dram_lat_ps(mp: MemParams, home, enabled):
     return 2 * net + acc
 
 
-def _dir_at(d: ShL2Dir, tiles, sets, way):
-    return (d.dstate[tiles, sets, way], d.owner[tiles, sets, way],
-            d.sharers[tiles, sets, way], d.nsharers[tiles, sets, way],
-            d.cloc[tiles, sets, way])
-
-
-def _dir_set(d: ShL2Dir, tiles, sets, way, mask, *, dstate=None, owner=None,
-             sharers=None, nsharers=None, cloc=None) -> ShL2Dir:
-    def upd(arr, val, cast=None):
-        if val is None:
-            return arr
-        cur = arr[tiles, sets, way]
-        new = jnp.where(mask, val, cur) if arr.ndim == 3 else jnp.where(
-            mask[:, None], val, cur)
-        if cast is not None:
-            new = new.astype(cast)
-        return arr.at[tiles, sets, way].set(new)
-
-    return d.replace(
-        dstate=upd(d.dstate, dstate, jnp.uint8),
-        owner=upd(d.owner, owner, jnp.int32),
-        sharers=upd(d.sharers, sharers),
-        nsharers=upd(d.nsharers, nsharers, jnp.int32),
-        cloc=upd(d.cloc, cloc, jnp.uint8),
-    )
-
-
 def shl2_engine_step(
     mp: MemParams,
     ms: ShL2State,
@@ -205,14 +275,8 @@ def shl2_engine_step(
     freq_mhz: jax.Array,
     active: jax.Array,
     enabled,
-    px=None,
+    px: ParallelCtx = IDENT,
 ) -> MemStepOut:
-    if px is not None and px.sharded:
-        # shared-L2 multichip runs ride the GSPMD specs path (the
-        # Simulator routes them there); the packed shard_map exchange
-        # currently covers the private-L2 engines
-        raise NotImplementedError(
-            "shard_map exchange not yet wired for the shared-L2 engine")
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     fmhz = freq_mhz.astype(I64)
@@ -261,8 +325,15 @@ def shl2_engine_step(
     new_instr_buf = jnp.where(starting & s_is_icache, s_line,
                               ms.req.instr_buf)
 
-    l1i_hit, l1i_way, l1i_state = ca.lookup(ms.l1i, s_line, mp.l1i.sets_mod)
-    l1d_hit, l1d_way, l1d_state = ca.lookup(ms.l1d, s_line, mp.l1d.sets_mod)
+    # L1 rows: block-local gathers, ONE exchange, full-width row ops
+    s_line_l = px.lo(s_line)
+    rows_l = (
+        ca.gather_row(ms.l1i, s_line_l, px.lo_const(mp.l1i.sets_mod)),
+        ca.gather_row(ms.l1d, s_line_l, px.lo_const(mp.l1d.sets_mod)),
+    )
+    (l1i_row, l1d_row), _ = _rows_exchange(px, rows_l)
+    l1i_hit, l1i_way, l1i_state = ca.row_lookup(l1i_row, s_line)
+    l1d_hit, l1d_way, l1d_state = ca.row_lookup(l1d_row, s_line)
     l1_state = jnp.where(s_is_icache, l1i_state, l1d_state)
     l1_permit = jnp.where(s_write, state_writable(l1_state),
                           state_readable(l1_state))
@@ -280,16 +351,15 @@ def shl2_engine_step(
     # MESI silent upgrade: a write to an EXCLUSIVE L1 line promotes to M
     # with no messages (the write-hit path: E is writable)
     promote = l1_hit_now & s_write & (l1_state == EXCLUSIVE)
-    l1d_upd = ca.set_state(ms.l1d, s_line, l1d_way, MODIFIED,
-                           promote & ~s_is_icache, mp.l1d.sets_mod)
-    l1i_upd = ms.l1i
+    l1d_row = ca.row_set_state(l1d_row, l1d_way, MODIFIED,
+                               promote & ~s_is_icache)
     # hits refresh recency under LRU; round_robin's update is a no-op
     if mp.l1i.replacement != "round_robin":
-        l1i_upd = ca.touch_lru(l1i_upd, s_line, l1i_way,
-                               l1_hit_now & s_is_icache, mp.l1i.sets_mod)
+        l1i_row = ca.row_touch(l1i_row, l1i_way, l1_hit_now & s_is_icache)
     if mp.l1d.replacement != "round_robin":
-        l1d_upd = ca.touch_lru(l1d_upd, s_line, l1d_way,
-                               l1_hit_now & ~s_is_icache, mp.l1d.sets_mod)
+        l1d_row = ca.row_touch(l1d_row, l1d_way, l1_hit_now & ~s_is_icache)
+    l1i_upd = ca.scatter_row(ms.l1i, px.lo(l1i_row))
+    l1d_upd = ca.scatter_row(ms.l1d, px.lo(l1d_row))
 
     # L1 miss: an upgrade (write to readable-but-unwritable line) keeps the
     # line until the reply; a plain miss sends the request right away.  In
@@ -353,30 +423,31 @@ def shl2_engine_step(
     # ======================================================================
     # (2) L1 sharers serve INV/FLUSH/WB from homes
     # ======================================================================
-    ms, progress = _sharer_step(mp, ms, fmhz, enabled, progress, sync_l1_net)
+    ms, progress = _sharer_step(mp, ms, fmhz, enabled, progress,
+                                sync_l1_net, px)
 
     # ======================================================================
     # (3) homes consume L1 evictions (directory + L2 dirty fill)
     # ======================================================================
-    ms, progress = _home_evictions(mp, ms, l2_access, enabled, progress)
+    ms, progress = _home_evictions(mp, ms, l2_access, enabled, progress, px)
 
     # ======================================================================
     # (4) homes consume acks / dram arrivals, finish transactions
     # ======================================================================
     ms, progress = _home_finish(mp, ms, l2_access, sync_l2_net, enabled,
-                                progress, mesi)
+                                progress, mesi, px)
 
     # ======================================================================
     # (5) homes start transactions
     # ======================================================================
     ms, progress = _home_starts(mp, ms, l2_access, sync_l2_net, enabled,
-                                progress, mesi)
+                                progress, mesi, px)
 
     # ======================================================================
     # (6) requesters consume replies (fill L1)
     # ======================================================================
     ms, progress = _requester_fill(mp, ms, rec, clock_ps, fmhz, enabled,
-                                   progress, sync_l1_net)
+                                   progress, sync_l1_net, px)
 
     final_slot = next_present(ms.req.slot)
     mem_complete = (ms.req.phase == PHASE_IDLE) & (final_slot >= 3)
@@ -409,7 +480,8 @@ def _apply_functional(mp, ms: ShL2State, rec: RecView, slot, s_addr,
     return ms.replace(func_mem=fm, func_errors=ms.func_errors + errs)
 
 
-def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net):
+def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net,
+                 px: ParallelCtx = IDENT):
     """L1-side service of INV/FLUSH/WB (`l1_cache_cntlr.cc` handlers)."""
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
@@ -424,8 +496,14 @@ def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net):
     fline = mail.fwd_line[tiles, h]
     ftime = mail.fwd_time[tiles, h]
 
-    l1i_hit, l1i_way, l1i_state = ca.lookup(ms.l1i, fline, mp.l1i.sets_mod)
-    l1d_hit, l1d_way, l1d_state = ca.lookup(ms.l1d, fline, mp.l1d.sets_mod)
+    fline_l = px.lo(fline)
+    rows_l = (
+        ca.gather_row(ms.l1i, fline_l, px.lo_const(mp.l1i.sets_mod)),
+        ca.gather_row(ms.l1d, fline_l, px.lo_const(mp.l1d.sets_mod)),
+    )
+    (l1i_row, l1d_row), _ = _rows_exchange(px, rows_l)
+    l1i_hit, l1i_way, l1i_state = ca.row_lookup(l1i_row, fline)
+    l1d_hit, l1d_way, l1d_state = ca.row_lookup(l1d_row, fline)
     have = l1i_hit | l1d_hit
     serve = found & have
     was_dirty = ((l1d_hit & ((l1d_state == MODIFIED)))
@@ -436,11 +514,15 @@ def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net):
     done_ps = ftime + sync_l1_net + ccyc(mp.l1d.data_and_tags_cycles)
 
     inv_do = serve & ~is_wb
-    l1i = ca.invalidate(ms.l1i, fline, inv_do & l1i_hit, mp.l1i.sets_mod)
-    l1d = ca.invalidate(ms.l1d, fline, inv_do & l1d_hit, mp.l1d.sets_mod)
+    l1i_row = ca.row_invalidate(l1i_row, fline, inv_do & l1i_hit)
+    l1d_row = ca.row_invalidate(l1d_row, fline, inv_do & l1d_hit)
     # WB downgrades M/E -> SHARED, data written back
-    l1i = ca.set_state(l1i, fline, l1i_way, SHARED, serve & is_wb & l1i_hit, mp.l1i.sets_mod)
-    l1d = ca.set_state(l1d, fline, l1d_way, SHARED, serve & is_wb & l1d_hit, mp.l1d.sets_mod)
+    l1i_row = ca.row_set_state(l1i_row, l1i_way, SHARED,
+                               serve & is_wb & l1i_hit)
+    l1d_row = ca.row_set_state(l1d_row, l1d_way, SHARED,
+                               serve & is_wb & l1d_hit)
+    l1i = ca.scatter_row(ms.l1i, px.lo(l1i_row))
+    l1d = ca.scatter_row(ms.l1d, px.lo(l1d_row))
 
     # ack: FLUSH_REP when dirty data travels (flush of M, or WB of M),
     # else INV_REP / WB_REP
@@ -476,7 +558,8 @@ def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net):
                       noc=noc), progress
 
 
-def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress):
+def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress,
+                    px: ParallelCtx = IDENT):
     """L1 eviction notices update the embedded directory; dirty flushes
     land in the L2 slice (its line turns MODIFIED wrt DRAM)."""
     T = mp.n_tiles
@@ -488,10 +571,17 @@ def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress):
     eline = mail.evict_line[tiles, src]
     etime = mail.evict_time[tiles, src]
 
-    l2_hit, l2_way, l2_state = ca.lookup(ms.l2, eline, mp.l2.sets_mod)
+    eline_l = px.lo(eline)
+    mod_l = px.lo_const(mp.l2.sets_mod)
+    l2row_l = ca.gather_row(ms.l2, eline_l, mod_l)
+    sets_l = (eline_l % jnp.asarray(mod_l)).astype(jnp.int32)
+    dw_l, dsh_l = _dir_rows_local(ms.dir, sets_l)
+    (l2row,), (dw, dsh) = _rows_exchange(px, (l2row_l,), (dw_l, dsh_l))
+    dw0, dsh0 = dw, dsh
+    l2_hit, l2_way, l2_state = ca.row_lookup(l2row, eline)
     sets = (eline % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     apply = found & l2_hit
-    dstate, owner, sharers, nsh, cloc = _dir_at(ms.dir, tiles, sets, l2_way)
+    dstate, owner, sharers, nsh, cloc = _entry_at(dw, dsh, l2_way)
 
     was_sharer = test_bit(sharers, src)
     new_sharers = clear_bit(sharers, src, apply)
@@ -503,11 +593,13 @@ def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress):
         apply,
         jnp.where(new_nsh == 0, DIR_UNCACHED, DIR_SHARED),
         dstate).astype(jnp.uint8)
-    d = _dir_set(ms.dir, tiles, sets, l2_way, apply,
-                 dstate=new_dstate, owner=new_owner,
-                 sharers=new_sharers, nsharers=new_nsh)
+    dw = _row_update(dw, l2_way, apply, dstate=new_dstate, owner=new_owner,
+                     nsharers=new_nsh)
+    dsh = _rowsh_update(dsh, l2_way, apply, new_sharers)
+    d = _dir_scatter(ms.dir, px, sets, dw0, dw, dsh0, dsh)
     # dirty flush data lands in the slice
-    l2 = ca.set_state(ms.l2, eline, l2_way, MODIFIED, apply & is_flush, mp.l2.sets_mod)
+    l2row = ca.row_set_state(l2row, l2_way, MODIFIED, apply & is_flush)
+    l2 = ca.scatter_row(ms.l2, px.lo(l2row))
 
     txn = ms.txn
     txn_match = txn.active & found & (txn.line == eline)
@@ -531,7 +623,7 @@ def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress):
 
 
 def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
-                 progress, mesi):
+                 progress, mesi, px: ParallelCtx = IDENT):
     """Consume acks + DRAM arrivals; finish when nothing is pending."""
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
@@ -561,12 +653,21 @@ def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     mail = mail.replace(ack_type=jnp.where(
         mail.ack_type != MSG_NONE, MSG_NONE, mail.ack_type))
 
+    # the phase's L2 + directory rows for each home's transaction line
+    tl_l = px.lo(txn.line)
+    mod_l = px.lo_const(mp.l2.sets_mod)
+    l2row_l = ca.gather_row(ms.l2, tl_l, mod_l)
+    sets_l = (tl_l % jnp.asarray(mod_l)).astype(jnp.int32)
+    dw_l, dsh_l = _dir_rows_local(ms.dir, sets_l)
+    (l2row,), (dw, dsh) = _rows_exchange(px, (l2row_l,), (dw_l, dsh_l))
+    dw0, dsh0 = dw, dsh
+    sets = (txn.line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
+
     # DRAM arrival: the fetched line fills the slice in SHARED
     dram_in = txn.active & (txn.dram_ready_ps < FAR) & (
         txn.pending == 0).all(axis=1)
-    l2 = ms.l2
-    l2_hit, l2_way, _ = ca.lookup(l2, txn.line, mp.l2.sets_mod)
-    l2 = ca.set_state(l2, txn.line, l2_way, SHARED, dram_in & l2_hit, mp.l2.sets_mod)
+    l2_hit, l2_way, _ = ca.row_lookup(l2row, txn.line)
+    l2row = ca.row_set_state(l2row, l2_way, SHARED, dram_in & l2_hit)
     txn = txn.replace(
         time_ps=jnp.where(dram_in,
                           jnp.maximum(txn.time_ps, txn.dram_ready_ps),
@@ -581,44 +682,44 @@ def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     is_sh = txn.mtype == MSG_SH_REQ
     is_nullify = txn.mtype == MSG_NULLIFY
 
-    sets = (txn.line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-    _, l2_way, l2_state = ca.lookup(l2, txn.line, mp.l2.sets_mod)
+    _, l2_way, l2_state = ca.row_lookup(l2row, txn.line)
     r = txn.requester
     rbit = set_bit(jnp.zeros((T, mp.sharer_words), U32), r, finish)
-    d = ms.dir
-    dstate, owner, sharers, nsh, cloc = _dir_at(d, tiles, sets, l2_way)
+    dstate, owner, sharers, nsh, cloc = _entry_at(dw, dsh, l2_way)
 
     # dirty acks flushed data into the slice
-    l2 = ca.set_state(l2, txn.line, l2_way, MODIFIED,
-                      finish & txn.got_flush & ~is_nullify, mp.l2.sets_mod)
+    l2row = ca.row_set_state(l2row, l2_way, MODIFIED,
+                             finish & txn.got_flush & ~is_nullify)
 
     # EX finish: directory MODIFIED owner=r
     exf = finish & is_ex
-    d = _dir_set(d, sets=sets, tiles=tiles, way=l2_way, mask=exf,
-                 dstate=jnp.full(T, DIR_MODIFIED, jnp.uint8), owner=r,
-                 sharers=rbit, nsharers=jnp.ones(T, jnp.int32),
-                 cloc=txn.req_comp)
+    dw = _row_update(dw, l2_way, exf,
+                     dstate=jnp.full(T, DIR_MODIFIED, jnp.uint8), owner=r,
+                     nsharers=jnp.ones(T, jnp.int32), cloc=txn.req_comp)
+    dsh = _rowsh_update(dsh, l2_way, exf, rbit)
     # SH finish: add r as a sharer; MESI grants EXCLUSIVE when alone
     shf = finish & is_sh
     had = test_bit(sharers, r)
     alone = (nsh - had.astype(jnp.int32)) == 0
     excl = shf & alone & mesi
     sh_dstate = jnp.where(excl, DIR_EXCLUSIVE, DIR_SHARED).astype(jnp.uint8)
-    d = _dir_set(d, tiles=tiles, sets=sets, way=l2_way, mask=shf,
-                 dstate=sh_dstate,
-                 owner=jnp.where(excl, r, -1),
-                 sharers=sharers | rbit,
-                 nsharers=nsh + (~had).astype(jnp.int32),
-                 cloc=txn.req_comp)
+    dw = _row_update(dw, l2_way, shf, dstate=sh_dstate,
+                     owner=jnp.where(excl, r, -1),
+                     nsharers=nsh + (~had).astype(jnp.int32),
+                     cloc=txn.req_comp)
+    dsh = _rowsh_update(dsh, l2_way, shf, sharers | rbit)
     # NULLIFY finish: entry dies; dirty data (slice M or flushed) → DRAM
     nlf = finish & is_nullify
     wb_dram = nlf & ((l2_state == MODIFIED) | txn.got_flush)
-    l2 = ca.invalidate(l2, txn.line, nlf, mp.l2.sets_mod)
-    d = _dir_set(d, tiles=tiles, sets=sets, way=l2_way, mask=nlf,
-                 dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
-                 owner=jnp.full(T, -1, jnp.int32),
-                 sharers=jnp.zeros((T, mp.sharer_words), U32),
-                 nsharers=jnp.zeros(T, jnp.int32))
+    l2row = ca.row_invalidate(l2row, txn.line, nlf)
+    dw = _row_update(dw, l2_way, nlf,
+                     dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
+                     owner=jnp.full(T, -1, jnp.int32),
+                     nsharers=jnp.zeros(T, jnp.int32))
+    dsh = _rowsh_update(dsh, l2_way, nlf,
+                        jnp.zeros((T, mp.sharer_words), U32))
+    l2 = ca.scatter_row(ms.l2, px.lo(l2row))
+    d = _dir_scatter(ms.dir, px, sets, dw0, dw, dsh0, dsh)
 
     # reply to the requester (the slice access was charged at txn start)
     rep_ready = txn.time_ps + sync_l2_net
@@ -653,7 +754,7 @@ def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
 
 
 def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
-                 progress, mesi):
+                 progress, mesi, px: ParallelCtx = IDENT):
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     mail = ms.mail
@@ -680,21 +781,28 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
             jnp.where(use_pop, MSG_NONE, mail.req_type[tiles, cr])))
     txn = txn.replace(saved_valid=txn.saved_valid & ~use_saved)
 
-    # ---- L2 slice lookup / allocation -----------------------------------
-    l2 = ms.l2
-    l2_hit, way, l2_state = ca.lookup(l2, rline, mp.l2.sets_mod)
+    # ---- L2 slice lookup / allocation (all on rline's SET: the victim
+    # and the effective line share it, so ONE row exchange serves the
+    # whole phase) ---------------------------------------------------------
+    rline_l = px.lo(rline)
+    mod_l = px.lo_const(mp.l2.sets_mod)
+    l2row_l = ca.gather_row(ms.l2, rline_l, mod_l)
+    sets_l = (rline_l % jnp.asarray(mod_l)).astype(jnp.int32)
+    dw_l, dsh_l = _dir_rows_local(ms.dir, sets_l)
+    (l2row,), (dw, dsh) = _rows_exchange(px, (l2row_l,), (dw_l, dsh_l))
+    dw0, dsh0 = dw, dsh
     sets = (rline % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
+
+    l2_hit, way, l2_state = ca.row_lookup(l2row, rline)
     # allocate on miss; a valid victim with L1 copies runs NULLIFY first
-    v_way, v_valid, v_line, v_state = ca.pick_victim(
-        l2, rline, mp.l2.replacement, mp.l2.sets_mod, mp.l2.ways_limit)
-    v_sets = (v_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-    v_dstate, v_owner, v_sharers, v_nsh, v_cloc = _dir_at(
-        ms.dir, tiles, v_sets, v_way)
+    v_way, v_valid, v_line, v_state = ca.row_pick_victim(
+        l2row, mp.l2.replacement, mp.l2.ways_limit)
+    v_dstate, v_owner, v_sharers, v_nsh, v_cloc = _entry_at(dw, dsh, v_way)
     need_alloc = starting & ~l2_hit
     nullify_live = need_alloc & v_valid & (v_dstate != DIR_UNCACHED)
     # clean victim with no L1 copies: drop now (dirty → DRAM write)
     silent_kill = need_alloc & v_valid & (v_dstate == DIR_UNCACHED)
-    l2 = ca.invalidate(l2, v_line, silent_kill, mp.l2.sets_mod)
+    l2row = ca.row_invalidate(l2row, v_line, silent_kill)
     dram_wb = silent_kill & (v_state == MODIFIED)
 
     txn = txn.replace(
@@ -708,22 +816,22 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     # install the new line (DATA_INVALID until DRAM returns)
     do_install = need_alloc & ~nullify_live
     alloc_way = v_way  # pick_victim returns invalid-way-first
-    l2 = ca.insert_at(l2, rline, alloc_way, DATA_INVALID, do_install, mp.l2.sets_mod)
-    d = _dir_set(ms.dir, tiles, sets, alloc_way, do_install,
-                 dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
-                 owner=jnp.full(T, -1, jnp.int32),
-                 sharers=jnp.zeros((T, mp.sharer_words), U32),
-                 nsharers=jnp.zeros(T, jnp.int32))
+    l2row = ca.row_insert(l2row, rline, alloc_way, DATA_INVALID, do_install)
+    dw = _row_update(dw, alloc_way, do_install,
+                     dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
+                     owner=jnp.full(T, -1, jnp.int32),
+                     nsharers=jnp.zeros(T, jnp.int32))
+    dsh = _rowsh_update(dsh, alloc_way, do_install,
+                        jnp.zeros((T, mp.sharer_words), U32))
 
     eff_line = jnp.where(nullify_live, v_line, rline)
     eff_type = jnp.where(nullify_live, MSG_NULLIFY, rtype).astype(jnp.uint8)
     eff_time = rtime + l2_access
     run_req = starting & ~nullify_live
 
-    # re-gather directory for the effective line
-    eff_sets = (eff_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
-    _, eff_way, eff_l2_state = ca.lookup(l2, eff_line, mp.l2.sets_mod)
-    dstate, owner, sharers, nsh, cloc = _dir_at(d, tiles, eff_sets, eff_way)
+    # re-read the directory for the effective line (post-install rows)
+    _, eff_way, eff_l2_state = ca.row_lookup(l2row, eff_line)
+    dstate, owner, sharers, nsh, cloc = _entry_at(dw, dsh, eff_way)
 
     is_ex = eff_type == MSG_EX_REQ
     is_sh = eff_type == MSG_SH_REQ
@@ -772,9 +880,8 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
         victim_bits = set_bit(jnp.zeros((T, mp.sharer_words), U32),
                               jnp.clip(victim, 0, T - 1),
                               sh_over & (victim >= 0))
-        d = _dir_set(d, tiles=tiles, sets=eff_sets, way=eff_way,
-                     mask=sh_over,
-                     sharers=sharers & ~victim_bits, nsharers=nsh - 1)
+        dw = _row_update(dw, eff_way, sh_over, nsharers=nsh - 1)
+        dsh = _rowsh_update(dsh, eff_way, sh_over, sharers & ~victim_bits)
         pending = jnp.where(sh_over[:, None], victim_bits, pending)
         fwd_msg = jnp.where(sh_over, MSG_INV_REQ, fwd_msg).astype(jnp.uint8)
         fan = fan | sh_over
@@ -784,12 +891,12 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
         sh_over_m = served & is_sh & owned_like & (nsh >= k) & ~already
         fwd_msg = jnp.where(sh_over_m, MSG_FLUSH_REQ,
                             fwd_msg).astype(jnp.uint8)
-        d = _dir_set(d, tiles=tiles, sets=eff_sets, way=eff_way,
-                     mask=sh_over_m,
-                     dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
-                     owner=jnp.full(T, -1, jnp.int32),
-                     sharers=jnp.zeros((T, mp.sharer_words), U32),
-                     nsharers=jnp.zeros(T, jnp.int32))
+        dw = _row_update(dw, eff_way, sh_over_m,
+                         dstate=jnp.full(T, DIR_UNCACHED, jnp.uint8),
+                         owner=jnp.full(T, -1, jnp.int32),
+                         nsharers=jnp.zeros(T, jnp.int32))
+        dsh = _rowsh_update(dsh, eff_way, sh_over_m,
+                            jnp.zeros((T, mp.sharer_words), U32))
     if mp.dir_type == "limitless":
         sw_mode = (nsh > k) | (is_sh & ~already & (nsh >= k)
                                & (shared | owned_like))
@@ -798,6 +905,8 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
             cycles_to_ps(jnp.asarray(mp.limitless_trap_cycles, I64),
                          mp.dir_freq_mhz),
             0)
+    l2 = ca.scatter_row(ms.l2, px.lo(l2row))
+    d = _dir_scatter(ms.dir, px, sets, dw0, dw, dsh0, dsh)
 
     activate = fan | data_missing | served | nullify_live
     txn = txn.replace(
@@ -855,7 +964,8 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
 
 
 def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
-                    enabled, progress, sync_l1_net):
+                    enabled, progress, sync_l1_net,
+                    px: ParallelCtx = IDENT):
     """Reply fills the L1 (`handleMsgFromL2Cache` → insertCacheLine)."""
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
@@ -875,12 +985,18 @@ def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
 
     # Upgrade replies land in the line's EXISTING way (the S copy stays
     # put during an EX upgrade); only true misses pick a victim.
-    l1i_hit, l1i_hway, _ = ca.lookup(ms.l1i, line, mp.l1i.sets_mod)
-    l1d_hit, l1d_hway, _ = ca.lookup(ms.l1d, line, mp.l1d.sets_mod)
-    l1i_vway, l1i_vv, l1i_vline, l1i_vstate = ca.pick_victim(
-        ms.l1i, line, mp.l1i.replacement, mp.l1i.sets_mod, mp.l1i.ways_limit)
-    l1d_vway, l1d_vv, l1d_vline, l1d_vstate = ca.pick_victim(
-        ms.l1d, line, mp.l1d.replacement, mp.l1d.sets_mod, mp.l1d.ways_limit)
+    line_l = px.lo(line)
+    rows_l = (
+        ca.gather_row(ms.l1i, line_l, px.lo_const(mp.l1i.sets_mod)),
+        ca.gather_row(ms.l1d, line_l, px.lo_const(mp.l1d.sets_mod)),
+    )
+    (l1i_row, l1d_row), _ = _rows_exchange(px, rows_l)
+    l1i_hit, l1i_hway, _ = ca.row_lookup(l1i_row, line)
+    l1d_hit, l1d_hway, _ = ca.row_lookup(l1d_row, line)
+    l1i_vway, l1i_vv, l1i_vline, l1i_vstate = ca.row_pick_victim(
+        l1i_row, mp.l1i.replacement, mp.l1i.ways_limit)
+    l1d_vway, l1d_vv, l1d_vline, l1d_vstate = ca.row_pick_victim(
+        l1d_row, mp.l1d.replacement, mp.l1d.ways_limit)
     l1i_way = jnp.where(l1i_hit, l1i_hway, l1i_vway)
     l1d_way = jnp.where(l1d_hit, l1d_hway, l1d_vway)
     already = jnp.where(comp_l1i, l1i_hit, l1d_hit)
@@ -893,8 +1009,12 @@ def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
     fill = have_rep & ~(need_evict & evict_busy)
     evict_go = need_evict & fill
 
-    l1i = ca.insert_at(ms.l1i, line, l1i_way, new_state, fill & comp_l1i, mp.l1i.sets_mod)
-    l1d = ca.insert_at(ms.l1d, line, l1d_way, new_state, fill & ~comp_l1i, mp.l1d.sets_mod)
+    l1i_row = ca.row_insert(l1i_row, line, l1i_way, new_state,
+                            fill & comp_l1i)
+    l1d_row = ca.row_insert(l1d_row, line, l1d_way, new_state,
+                            fill & ~comp_l1i)
+    l1i = ca.scatter_row(ms.l1i, px.lo(l1i_row))
+    l1d = ca.scatter_row(ms.l1d, px.lo(l1d_row))
 
     e_msg = jnp.where(v_state == MODIFIED, MSG_FLUSH_REP,
                       MSG_INV_REP).astype(jnp.uint8)

@@ -38,6 +38,11 @@ class CacheLevelParams:
     tags_access_cycles: int
     sequential: bool          # perf_model_type (parallel|sequential)
     track_miss_types: bool = False
+    # per-line read/write access counters, histogram-classified when the
+    # line leaves the cache (`cache/cache_line_utilization.h`; the MOSI
+    # L2 controller's eviction/invalidation hook points,
+    # `pr_l1_pr_l2_dram_directory_mosi/l2_cache_cntlr.cc:120`)
+    track_line_utilization: bool = False
     # `replacement_policy` (`carbon_sim.cfg:213`): lru | round_robin
     # (factory `CacheReplacementPolicy::create`)
     replacement: str = "lru"
@@ -100,6 +105,8 @@ class CacheLevelParams:
             tags_access_cycles=first.tags_access_cycles,
             sequential=first.sequential,
             track_miss_types=any(p.track_miss_types for p in per_tile),
+            track_line_utilization=any(
+                p.track_line_utilization for p in per_tile),
             replacement=first.replacement,
             tile_sets=per(sets), tile_ways=per(ways),
             tile_data_cycles=per(data), tile_tags_cycles=per(tags),
@@ -171,6 +178,8 @@ class CacheLevelParams:
             sequential=cfg.get_string(f"{section}/perf_model_type", "parallel")
             == "sequential",
             track_miss_types=cfg.get_bool(f"{section}/track_miss_types", False),
+            track_line_utilization=cfg.get_bool(
+                f"{section}/track_cache_line_utilization", False),
             replacement=cfg.get_string(f"{section}/replacement_policy",
                                        "lru").strip(),
             num_banks=cfg.get_int(f"{section}/num_banks", 1),

@@ -194,6 +194,15 @@ class MemCounters:
     l2_cold_misses: jax.Array
     l2_capacity_misses: jax.Array
     l2_sharing_misses: jax.Array
+    # L2 cache-line utilization (`cache/cache_line_utilization.h`; MOSI
+    # l2_cache_cntlr eviction/invalidation hooks) — populated when
+    # `[l2_cache/<type>] track_cache_line_utilization`:
+    # histogram of per-line TOTAL accesses classified when the line
+    # leaves the L2 (buckets: 0, 1, 2-3, 4-7, ..., >=64), plus the
+    # classified lines' accumulated read/write access counts
+    line_util_hist: jax.Array    # int64[T, 8]
+    line_util_reads: jax.Array   # int64[T]
+    line_util_writes: jax.Array  # int64[T]
 
 
 @struct.dataclass
@@ -202,6 +211,10 @@ class MemState:
     l1d: CacheArrays
     l2: CacheArrays
     l2_cloc: jax.Array       # uint8[T, S2, W2] which L1 holds it (0/MOD_L1I/MOD_L1D)
+    # per-L2-line utilization counters when track_cache_line_utilization:
+    # uint32[T, S2, W2], low 16 bits = read accesses, high 16 = writes
+    # (saturating); None when tracking is off
+    l2_util: "object"
     directory: DirectoryArrays
     txn: TxnState
     mail: MemMailboxes
@@ -270,6 +283,8 @@ def init_mem_common(mp: MemParams) -> dict:
         dram_total_lat_ps=zi64(),
         l2_cold_misses=zi64(), l2_capacity_misses=zi64(),
         l2_sharing_misses=zi64(),
+        line_util_hist=jnp.zeros((T, 8), I64),
+        line_util_reads=zi64(), line_util_writes=zi64(),
     )
     return dict(
         l1i=make_cache(T, mp.l1i.num_sets, mp.l1i.num_ways),
@@ -329,6 +344,8 @@ def init_mem_state(mp: MemParams) -> MemState:
           if mp.l2.track_miss_types else None)
     return MemState(
         l2_cloc=jnp.zeros((T, mp.l2.num_sets, mp.l2.num_ways), jnp.uint8),
+        l2_util=(jnp.zeros((T, mp.l2.num_sets, mp.l2.num_ways), jnp.uint32)
+                 if mp.l2.track_line_utilization else None),
         directory=directory,
         txn=txn,
         live=jnp.zeros((), jnp.bool_),

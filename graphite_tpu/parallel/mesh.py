@@ -123,8 +123,10 @@ def shard_window(window: DeviceTrace, mesh: Mesh, bases) -> tuple:
 _SHARD_MAP_LOCAL = {
     "core.bp_bits",
     "mem.l1i.meta", "mem.l1d.meta", "mem.l2.meta",
-    "mem.l2_cloc", "mem.mt",
+    "mem.l2_cloc", "mem.l2_util", "mem.mt",
     "mem.directory.entry", "mem.directory.sharers",
+    # shared-L2 engine: the L2-slice-embedded directory (engine_shl2)
+    "mem.dir.word", "mem.dir.sharers",
 }
 
 

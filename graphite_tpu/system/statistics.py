@@ -151,6 +151,16 @@ class StatisticsManager:
             row = " ".join(f"{k + 1}:{hist[k]}" for k in nz)
             self._file("cache_line_replication").write(
                 f"{time_ns} {row}\n")
+        if ("cache_line_utilization" in self.types and state.mem is not None
+                and getattr(state.mem, "l2_util", None) is not None):
+            # cumulative histogram of classified (departed) L2 lines by
+            # total accesses, aggregated over tiles
+            # (cache_line_utilization.h harvested at eviction/invalidation)
+            hist = np.asarray(jax.device_get(
+                state.mem.counters.line_util_hist)).sum(axis=0)
+            row = " ".join(f"{k}:{int(v)}" for k, v in enumerate(hist))
+            self._file("cache_line_utilization").write(
+                f"{time_ns} {row}\n")
         if "network_utilization" in self.types:
             interval_ns = max(time_ns - self._prev_sample_ns, 1)
             sent, = jax.device_get((state.net.packets_sent,))

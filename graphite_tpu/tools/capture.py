@@ -134,7 +134,8 @@ def run_radix_app(n_tiles: int = 4, keys_per_tile: int = 256,
     # region layout bounds (aliasing window documented below): keys must
     # fit the 64 KB per-array slots, histograms/ranks their 32/16 KB
     assert 4 * N <= 0x10000, "key arrays overrun the region layout"
-    assert 4 * T * radix <= 0x8000, "histograms overrun the region layout"
+    # RANK has the narrowest slot (16 KB, 0x128000..0x12C000)
+    assert 4 * T * radix <= 0x4000, "hist/rank overrun the region layout"
     # all regions inside one 256 KB window: the replay's functional
     # memory maps addr>>2 modulo general/functional_memory_kb*256 words
     # (memory/params.py:440), so wider spacing would alias

@@ -4,19 +4,19 @@ companion VERDICT round 3 asked for (`BENCH_r{N}.json` field
 `coherence_1024_instr_per_s`).
 
 Run as a subprocess (bench.py does) because the largest configs can kill
-the TPU worker: the full auto-sized directory is 2.4 GB at 1024 tiles and
-XLA's scatter-staging copies of it exhaust HBM mid-run, and the tunnel's
-remote-compile helper intermittently dies on programs this size (PERF.md
-"Known limitation").  bench.py walks a fidelity ladder — full directory +
+the TPU worker; bench.py walks a fidelity ladder — full directory +
 hop-by-hop memory NoC, then full directory + hop-counter, then a reduced
 directory — and records the first rung that completes, tagged with its
 fidelity, so the recorded number is always real.
 
-A deterministic TPU kernel fault (not OOM — 3 GB allocated of 16) kills
-send-carrying traces (FFT) at 1024 tiles x full directory while canneal /
-memory-stress run the same shapes, so the ladder includes a
-memory-stress-at-full-directory rung: full coherence at the north-star
-scale, minus the CAPI messaging the faulting kernel needs.
+Round-5 status: the round-4 "deterministic TPU kernel fault" on
+1024-tile x full-directory x SEND-carrying traces no longer reproduces
+under the staged+packed directory program — the FFT rung completes at
+FULL directory with the hop-counter NoC.  The remaining failing
+combination is hbh NoC + full directory + SEND traces (worker crash;
+memstress+hbh+full and fft+hbh+quarter both run, so it is the combined
+footprint, not the hbh code) — hence the ladder's second rung is the
+one that records today.
 
 Usage: python -m graphite_tpu.tools.coherence1024 [--net hbh|hopctr]
        [--dir full|small] [--workload fft|memstress] [--points N]

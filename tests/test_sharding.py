@@ -82,9 +82,9 @@ def _make_mem_sim(n_tiles=64, proto=MSI, mesh=None, spmd=None):
 
 @pytest.mark.parametrize("proto", [MSI, MOSI, SHL2_MSI, SHL2_MESI])
 def test_sharded_coherence_matches_single_device(proto):
-    # private-L2 protocols ride the packed shard_map exchange (the
-    # default); shared-L2 falls back to GSPMD specs — both must be
-    # bit-identical to the single-device run
+    # every protocol — private-L2 AND shared-L2 — rides the packed
+    # shard_map exchange by default and must be bit-identical to the
+    # single-device run
     ra = _make_mem_sim(proto=proto).run()
     rb = _make_mem_sim(proto=proto, mesh=make_tile_mesh(8)).run()
 
@@ -103,12 +103,11 @@ def test_sharded_coherence_matches_single_device(proto):
 
 
 def test_default_mesh_program_selection():
-    # shard_map is the default multi-chip program for private-L2 /
-    # memoryless runs; shared-L2 auto-falls back to GSPMD until its
-    # engine takes the exchange context
+    # shard_map is the default multi-chip program for EVERY protocol
+    # (the shared-L2 engine took the exchange context in round 5)
     mesh = make_tile_mesh(8)
     assert _make_mem_sim(proto=MSI, mesh=mesh).spmd == "shard_map"
-    assert _make_mem_sim(proto=SHL2_MSI, mesh=mesh).spmd == "gspmd"
+    assert _make_mem_sim(proto=SHL2_MSI, mesh=mesh).spmd == "shard_map"
     assert _make_sim(64, mesh=mesh).spmd == "shard_map"
 
 
