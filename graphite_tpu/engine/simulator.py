@@ -540,6 +540,17 @@ class Simulator:
                 self.state = self.state.replace(
                     mem=self.state.mem.replace(
                         noc=init_noc_state(mem_params.net_hbh)))
+            elif mem_params.net_atac is not None:
+                # ATAC hub-queue state of the MEMORY NoC (`[network]
+                # memory = atac`) — coherence messages route over the
+                # clusters/hubs/waveguide with hub contention
+                from graphite_tpu.models.network_atac import (
+                    init_atac_state,
+                )
+
+                self.state = self.state.replace(
+                    mem=self.state.mem.replace(
+                        noc=init_atac_state(mem_params.net_atac)))
         if user_hbh is not None:
             from graphite_tpu.models.network_hop_by_hop import init_noc_state
 

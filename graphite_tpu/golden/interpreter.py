@@ -202,7 +202,7 @@ class _HbhNet:
         return cycles_to_ps(int(t), p.freq_mhz)
 
     def fanout(self, src, targets, bits, t0_ps, enabled, n_copies=None,
-               ranks=None):
+               ranks=None, copy_set=None):
         """A home's multicast, mirroring the ENGINE's shared fan-out
         approximation (`memory/engine.py mem_net_fanout`): ONE inject-port
         charge of n_copies*flits, rank-of-target serialization (by tile
@@ -250,11 +250,40 @@ class _AtacNet(_HbhNet):
     router, the optical link (waveguide + E-O/O-E), receive-hub queue +
     router, and the receive net, plus receiver serialization."""
 
-    def route(self, src, dst, payload_bytes, t_send_ps, enabled):
+    # (route() is inherited: _HbhNet already wraps route_bits with the
+    # NetPacket header)
+
+    def _cluster(self, t):
+        p = self.p
+        x, y = t % p.mesh_width, t // p.mesh_width
+        cpr = p.mesh_width // p.cluster_width
+        return (y // p.cluster_height) * cpr + (x // p.cluster_width)
+
+    def _hub(self, c):
+        p = self.p
+        cpr = p.mesh_width // p.cluster_width
+        return ((c // cpr) * p.cluster_height * p.mesh_width
+                + (c % cpr) * p.cluster_width)
+
+    def _hops(self, a, b):
+        w = self.p.mesh_width
+        return abs(a % w - b % w) + abs(a // w - b // w)
+
+    def _use_onet(self, src, dst):
+        p = self.p
+        same = self._cluster(src) == self._cluster(dst)
+        if p.global_routing_strategy == "distance_based":
+            return not (same
+                        or self._hops(src, dst)
+                        <= p.unicast_distance_threshold)
+        return not same
+
+    def route_bits(self, src, dst, bits, t_send_ps, enabled):
+        """Route a packet of `bits` modeled length (raw ShmemMsg lengths
+        on the MEMORY net, NetPacket-headered on the USER net)."""
         p = self.p  # AtacParams
         if not enabled:
             return t_send_ps
-        bits = (HEADER_BYTES + payload_bytes) * 8
         flits = max(_ceil_div(bits, p.flit_width_bits), 1)
 
         def cyc_ps(n):
@@ -303,6 +332,63 @@ class _AtacNet(_HbhNet):
         return (recvhub_done
                 + cyc_ps(p.receive_net_levels * p.receive_net_cycles)
                 + ser_ps)
+
+    def _zeroload_ps(self, src, dst, bits):
+        """Contention-free path cost (engine's atac_zeroload_ps mirror)."""
+        p = self.p
+        flits = max(_ceil_div(bits, p.flit_width_bits), 1)
+
+        def cyc_ps(n):
+            return _ceil_div(int(n) * 10**6, p.freq_mhz)
+
+        ser = 0 if src == dst else cyc_ps(flits)
+        if not self._use_onet(src, dst):
+            return (cyc_ps(self._hops(src, dst) * p.enet_hop_cycles)
+                    + ser, False)
+        onet = (cyc_ps(self._hops(src, self._hub(self._cluster(src)))
+                       * p.enet_hop_cycles)
+                + cyc_ps(p.send_hub_cycles) + p.optical_link_ps
+                + cyc_ps(p.receive_hub_cycles)
+                + cyc_ps(p.receive_net_levels * p.receive_net_cycles))
+        return onet + ser, True
+
+    def fanout(self, src, targets, bits, t0_ps, enabled, n_copies=None,
+               ranks=None, copy_set=None):
+        """A home's multicast, mirroring the ENGINE's ATAC fan-out
+        (`memory/engine.py mem_net_fanout` atac leg): ONE send-hub charge
+        of k_onet*flits (delay applied to ONet copies), rank-of-target
+        serialization (by tile id) for every copy, then each copy's
+        zero-load path.  Returns {target: arrival_ps}."""
+        p = self.p
+        targets = sorted(targets)
+        if not enabled or not targets:
+            return {s: t0_ps for s in targets}
+        flits = max(_ceil_div(bits, p.flit_width_bits), 1)
+        zl = {s: self._zeroload_ps(src, s, bits) for s in targets}
+        # the hub charge counts every ONet COPY — broadcast sweeps pass
+        # the full copy set (engine: (send_hs & onet_pair).sum())
+        copies = copy_set if copy_set is not None else targets
+        k_onet = sum(1 for s in copies if self._use_onet(src, s))
+        inj = 0
+        if p.contention_enabled and k_onet > 0:
+            t_cyc = _ceil_div(t0_ps * p.freq_mhz, 10**6)
+            inj, _ = self._delay(self._cluster(src), t_cyc, k_onet * flits)
+            self._commit(self._cluster(src), t_cyc, inj, k_onet * flits)
+
+        def cyc_ps(n):
+            return _ceil_div(int(n) * 10**6, p.freq_mhz)
+
+        out = {}
+        for i, s in enumerate(targets):
+            rank = ranks[s] if ranks is not None else i
+            lat, onet = zl[s]
+            # ONE cycles->ps conversion for the combined extra cycles —
+            # the engine converts the sum (rank*flits + hub delay) once,
+            # and split ceil conversions diverge at frequencies that do
+            # not divide 10^6
+            out[s] = t0_ps + lat + cyc_ps(
+                rank * flits + (inj if onet else 0))
+        return out
 
 
 class _Tile:

@@ -206,6 +206,12 @@ class GoldenMemory:
             from graphite_tpu.golden.interpreter import _HbhNet
 
             self.net = _HbhNet(mp.net_hbh)
+        elif mp.net_atac is not None:
+            # coherence messages over the ATAC optical NoC (`[network]
+            # memory = atac`) — the serial hub-queue oracle
+            from graphite_tpu.golden.interpreter import _AtacNet
+
+            self.net = _AtacNet(mp.net_atac)
         else:
             self.net = None
         self.instr_buf = [-1] * T
@@ -331,14 +337,16 @@ class GoldenMemory:
         return t_send + self._net_ps(src, dst, bits, enabled)
 
     def _net_fanout(self, src: int, targets, bits: int, t0: int,
-                    enabled: bool, n_copies=None, ranks=None) -> dict:
+                    enabled: bool, n_copies=None, ranks=None,
+                    copy_set=None) -> dict:
         """{target: arrival} for a home's multicast (engine contract —
         see _HbhNet.fanout).  Broadcast sweeps pass n_copies (total
-        copies occupying the inject port) and ranks (target -> rank
-        among ALL copies)."""
+        copies occupying the inject port), ranks (target -> rank among
+        ALL copies), and copy_set (every copy destination — the ATAC
+        mirror counts its ONet members exactly)."""
         if self.net is not None:
             return self.net.fanout(src, targets, bits, t0, enabled,
-                                   n_copies, ranks)
+                                   n_copies, ranks, copy_set)
         return {s: t0 + self._net_ps(src, s, bits, enabled)
                 for s in targets}
 
@@ -614,7 +622,13 @@ class GoldenMemory:
             f_arrivals = self._net_fanout(
                 home, list(targets), mp.req_bits, eff_time, enabled,
                 n_copies=mp.n_tiles,
-                ranks={s: s for s in targets})
+                ranks={s: s for s in targets},
+                # the engine's broadcast row is holders | (all tiles
+                # except the requester): a requester that still HOLDS the
+                # victim line gets a copy (NULLIFY sweeps must kill it)
+                copy_set=sorted(
+                    (set(range(mp.n_tiles)) - {requester})
+                    | set(targets)))
         else:
             f_arrivals = self._net_fanout(home, list(targets), mp.req_bits,
                                           eff_time, enabled)

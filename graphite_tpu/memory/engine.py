@@ -189,12 +189,17 @@ def _row_earliest(cell_type: jax.Array, cell_time: jax.Array):
 
 def mem_net_latency_ps(mp: MemParams, src, dst, bits: int, enabled):
     """MEMORY-network zero-load latency (`network_model_emesh_hop_counter.cc`
-    + receive serialization `network_model.cc:119-149`)."""
+    + receive serialization `network_model.cc:119-149`; ATAC zero-load
+    path costs under `memory = atac`)."""
     src = jnp.asarray(src)
     dst = jnp.asarray(dst)
     if mp.net_kind == "magic":
         cycles = jnp.where(enabled, jnp.ones_like(src, I64), 0)
         return cycles_to_ps(cycles, mp.net_freq_mhz)
+    if mp.net_atac is not None:
+        from graphite_tpu.models.network_atac import atac_zeroload_ps
+
+        return atac_zeroload_ps(mp.net_atac, src, dst, bits, enabled)
     w = mp.mesh_width
     hops = jnp.abs(src % w - dst % w) + jnp.abs(src // w - dst // w)
     flits = (bits + mp.flit_width_bits - 1) // mp.flit_width_bits
@@ -211,10 +216,19 @@ def mem_net_send(mp: MemParams, noc, src, dst, bits, t0_ps, mask, enabled):
     Returns (noc, arrival_ps[T]).  With `[network] memory =
     emesh_hop_by_hop` (mp.net_hbh) the packet routes through the dense
     per-hop contention engine on the memory NoC's own port-queue state
-    (`MemState.noc`) — the analog of the reference routing every ShmemMsg
-    through the configured memory network model
-    (`network_model_emesh_hop_by_hop.cc:146-265`, `carbon_sim.cfg:281`).
+    (`MemState.noc`); with `memory = atac` (mp.net_atac) it routes over
+    the ATAC clusters/hubs/waveguide with hub contention on the memory
+    NoC's own AtacState — the analog of the reference routing every
+    ShmemMsg through the configured memory network model (any-model-per-
+    net factory `network.cc:21-40`, `carbon_sim.cfg:281-282`).
     Otherwise zero-load hop-counter/magic math (state untouched)."""
+    if mp.net_atac is not None:
+        from graphite_tpu.models.network_atac import route_atac
+
+        bits = jnp.broadcast_to(jnp.asarray(bits, I64), jnp.shape(src))
+        noc, arrival_ps, _ = route_atac(
+            mp.net_atac, noc, src, dst, bits, t0_ps, mask, enabled)
+        return noc, arrival_ps
     if mp.net_hbh is None:
         return noc, t0_ps + mem_net_latency_ps(mp, src, dst, bits, enabled)
     from graphite_tpu.models.network_hop_by_hop import route_hop_by_hop
@@ -249,6 +263,44 @@ def mem_net_fanout(mp: MemParams, noc, send_hs, bits: int, t0_ps, enabled):
     T = mp.n_tiles
     src = jnp.arange(T, dtype=jnp.int32)[:, None]
     dst = jnp.arange(T, dtype=jnp.int32)[None, :]
+    if mp.net_atac is not None:
+        # ATAC multicast (`network_model_atac.cc:372-500` broadcast over
+        # the waveguide): the home's SEND HUB serializes its ONet copies
+        # (one queue charge of k_onet * flits, delay applied to ONet
+        # copies), every copy pays its rank (by tile id) times flits at
+        # the source, then its zero-load path — the same
+        # dominant-contention-exact / intermediate-hops-approximate
+        # contract as the hop-by-hop fan-out below, mirrored by the
+        # oracle (`_AtacNet.fanout`)
+        from graphite_tpu.models import queue_models as qm
+        from graphite_tpu.models.network_atac import (
+            _cluster_of, atac_use_onet, atac_zeroload_ps,
+        )
+        from graphite_tpu.time_types import ps_to_cycles
+
+        p = mp.net_atac
+        zl = atac_zeroload_ps(p, src, dst, bits, enabled)       # [T, T]
+        flits = max(1, (bits + p.flit_width_bits - 1) // p.flit_width_bits)
+        onet_pair = atac_use_onet(p, src, dst)                  # [T, T]
+        k_onet = (send_hs & onet_pair).sum(axis=1, dtype=I64)
+        fan = send_hs.any(axis=1)
+        t0_cyc = ps_to_cycles(t0_ps, p.freq_mhz)
+        if p.contention_enabled:
+            go = fan & (k_onet > 0) & jnp.asarray(enabled, bool)
+            home = jnp.arange(T, dtype=jnp.int32)
+            qid = jnp.where(go, _cluster_of(p, home),
+                            2 * p.n_clusters).astype(jnp.int32)
+            queues, hub_delay = qm.scatter_queue_delay(
+                p.queue, noc.hub_queues, qid, t0_cyc, k_onet * flits, go)
+            noc = noc.replace(hub_queues=queues)
+        else:
+            hub_delay = jnp.zeros(T, I64)
+        rank = jnp.cumsum(send_hs.astype(I64), axis=1) - 1
+        extra_cyc = rank * flits + jnp.where(onet_pair, hub_delay[:, None],
+                                             0)
+        extra_cyc = jnp.where(jnp.asarray(enabled, bool), extra_cyc, 0)
+        arrival = t0_ps[:, None] + zl + cycles_to_ps(extra_cyc, p.freq_mhz)
+        return noc, arrival
     if mp.net_hbh is None:
         lat = mem_net_latency_ps(mp, src, dst, bits, enabled)
         return noc, t0_ps[:, None] + lat

@@ -237,6 +237,10 @@ class MemParams:
     # ack, reply — routes through the dense hop-by-hop engine instead of
     # the zero-load hop-counter math (HopByHopParams | None)
     net_hbh: "object" = None
+    # MEMORY network ATAC optical model (`[network] memory = atac`):
+    # coherence messages route over clusters/hubs/waveguide with hub
+    # contention on the memory NoC's own state (AtacParams | None)
+    net_atac: "object" = None
     # how many requester slot-starts run per engine iteration: >1 lets a
     # record whose slots HIT the L1 complete several slots per iteration.
     # Measured A/B: a win only for hit-dominated multi-slot records —
@@ -385,20 +389,22 @@ class MemParams:
         from graphite_tpu.models.network_user import UserNetworkParams
 
         mem_kind = sc.network_types[1]
-        if mem_kind == "atac":
-            # the reference supports atac as a memory network; the TPU
-            # engine does not model its timing for coherence messages yet
-            # — refuse loudly instead of flowing a degenerate mesh into
-            # the latency math
-            raise NotImplementedError(
-                "[network] memory = atac is not supported; use magic, "
-                "emesh_hop_counter, or emesh_hop_by_hop")
         netp = UserNetworkParams.from_config(sc, "memory")
         net_hbh = None
+        net_atac = None
         if mem_kind == "emesh_hop_by_hop":
             from graphite_tpu.models.network_hop_by_hop import HopByHopParams
 
             net_hbh = HopByHopParams.from_config(sc, "memory")
+        elif mem_kind == "atac":
+            # any network model serves the MEMORY net in the reference
+            # (`network.cc:21-40` model-per-net factory,
+            # `carbon_sim.cfg:281-282`): coherence messages route over
+            # the ATAC clusters/hubs/waveguide with hub contention on the
+            # memory NoC's own state (engine mem_net_send)
+            from graphite_tpu.models.network_atac import AtacParams
+
+            net_atac = AtacParams.from_config(sc, "memory")
 
         # --- DVFS domains for synchronization delay ------------------------
         from graphite_tpu.models.dvfs import module_domain_index, module_freq_mhz
@@ -443,6 +449,7 @@ class MemParams:
             hop_latency_cycles=netp.hop_latency_cycles,
             flit_width_bits=netp.flit_width_bits,
             net_hbh=net_hbh,
+            net_atac=net_atac,
             module_domains=module_domains,
             sync_delay_cycles=cfg.get_int("dvfs/synchronization_delay", 2),
             icache_modeling=cfg.get_bool("general/enable_icache_modeling", False),

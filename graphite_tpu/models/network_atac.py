@@ -241,3 +241,41 @@ def route_atac(p: AtacParams, state: AtacState, src, dst, bits, clock_ps,
     total_ps = jnp.where(enabled, route_ps + ser_ps, 0)
     arrival = clock_ps + jnp.where(mask, total_ps, 0)
     return AtacState(hub_queues=queues), arrival, use_onet
+
+
+def atac_use_onet(p: AtacParams, src, dst):
+    """Which (src, dst) pairs ride the ONet (broadcastable bool)."""
+    src = jnp.asarray(src)
+    dst = jnp.asarray(dst)
+    same_cluster = _cluster_of(p, src) == _cluster_of(p, dst)
+    if p.global_routing_strategy == "distance_based":
+        return ~(same_cluster
+                 | (_enet_hops(p, src, dst) <= p.unicast_distance_threshold))
+    return ~same_cluster
+
+
+def atac_zeroload_ps(p: AtacParams, src, dst, bits, enabled):
+    """Contention-free ATAC latency (broadcastable [.., ..] math): the
+    route_atac path costs with zero hub-queue delay — what a packet pays
+    on idle hubs (`test_atac.py` pins route_atac == this on fresh state).
+    Used for the MEMORY net's zero-load call sites (shl2 DRAM round trip,
+    fan-out per-target legs)."""
+    src = jnp.asarray(src)
+    dst = jnp.asarray(dst)
+
+    def cyc(n):
+        return cycles_to_ps(jnp.asarray(n, I64), p.freq_mhz)
+
+    flits = ((jnp.asarray(bits) + p.flit_width_bits - 1)
+             // p.flit_width_bits).astype(I64)
+    ser_ps = jnp.where(src == dst, 0, cyc(flits))
+    use_onet = atac_use_onet(p, src, dst)
+    enet_ps = cyc(_enet_hops(p, src, dst) * p.enet_hop_cycles)
+    to_hub = cyc(_enet_hops(p, src, _hub_tile(p, _cluster_of(p, src)))
+                 * p.enet_hop_cycles)
+    onet_ps = (to_hub + cyc(p.send_hub_cycles)
+               + jnp.where(jnp.asarray(enabled, bool), p.optical_link_ps, 0)
+               + cyc(p.receive_hub_cycles)
+               + cyc(p.receive_net_levels * p.receive_net_cycles))
+    total = jnp.where(use_onet, onet_ps, enet_ps) + ser_ps
+    return jnp.where(jnp.asarray(enabled, bool), total, 0)

@@ -216,3 +216,27 @@ class TestAtacGolden:
             bs2[15].recv(0, 64)
         r_off = run(make_config(16, contention="false"), bs2)
         assert res.completion_time_ps > r_off.completion_time_ps
+
+
+def test_route_atac_matches_zeroload_on_idle_hubs():
+    """atac_zeroload_ps (the memory net's latency/fan-out basis) must
+    equal route_atac on fresh (idle) hub queues — the two formulas are
+    written separately, so pin them together."""
+    import jax.numpy as jnp
+
+    from graphite_tpu.models.network_atac import (
+        AtacParams, atac_zeroload_ps, init_atac_state, route_atac,
+    )
+
+    sc = make_config(16, strategy="cluster_based", contention="true")
+    p = AtacParams.from_config(sc, "user")
+    src = jnp.arange(16, dtype=jnp.int32)
+    for dst_val in (0, 5, 10, 15):
+        dst = jnp.full((16,), dst_val, jnp.int32)
+        t0 = jnp.full((16,), 1_000_000, jnp.int64)
+        st = init_atac_state(p)
+        _, arrival, _ = route_atac(
+            p, st, src, dst, jnp.full((16,), 512, jnp.int64), t0,
+            jnp.ones(16, bool), True)
+        zl = atac_zeroload_ps(p, src, dst, 512, True)
+        assert (arrival == t0 + zl).all(), dst_val

@@ -14,7 +14,9 @@ Contract (BASELINE.md carve-outs):
  - hop_by_hop must CHANGE measured completion vs hop_counter (the
    round-2 gap was that `memory = emesh_hop_by_hop` silently degraded
    to zero-load);
- - memory = atac raises instead of flowing garbage mesh math.
+ - memory = atac routes coherence messages over the optical NoC
+   (clusters/hubs/waveguide, hub contention) — serialized-bit-exact vs
+   the serial `_AtacNet` oracle, including ackwise broadcast sweeps.
 """
 
 import numpy as np
@@ -174,9 +176,91 @@ def test_racy_envelope_vs_oracle():
         assert abs(e - g) <= max(2, 0.02 * max(e, g)), f"{k}: {e} vs {g}"
 
 
-def test_atac_memory_raises():
-    with pytest.raises(NotImplementedError, match="memory = atac"):
-        Simulator(make_config(4, net="atac"), disjoint_stream(4))
+ATAC_EXTRA = """
+[network/atac]
+flit_width = 64
+cluster_size = 4
+receive_network_type = star
+global_routing_strategy = cluster_based
+unicast_distance_threshold = 4
+[network/atac/queue_model]
+enabled = true
+type = history_tree
+[network/atac/enet/router]
+delay = 1
+[network/atac/onet/send_hub/router]
+delay = 1
+[network/atac/onet/receive_hub/router]
+delay = 1
+[network/atac/star_net/router]
+delay = 1
+[link_model/optical]
+waveguide_delay_per_mm = 10e-3
+E-O_conversion_delay = 1
+O-E_conversion_delay = 1
+"""
+
+
+def test_atac_memory_serialized_bit_exact():
+    """`[network] memory = atac` (any-model-per-net factory,
+    `network.cc:21-40`): coherence messages ride the clusters/hubs/
+    waveguide with hub contention on the memory NoC's own state.
+    Serialized traffic is bit-exact vs the serial hub-queue oracle
+    (`_AtacNet`), crossing clusters so the ONet path carries real
+    protocol messages."""
+    sc = make_config(16, MSI, net="atac", extra=ATAC_EXTRA)
+    res, gold = assert_exact(sc, mutex_rmw(16, rounds=3, lines=2))
+    assert int(np.asarray(res.mem_counters["l2_misses"]).sum()) > 0
+
+
+def test_atac_memory_ackwise_broadcast_exact():
+    """Overflowed-entry INV sweep under memory = atac: the broadcast
+    charges the home's SEND HUB with its ONet copies and ranks every
+    copy by tile id — mirrored exactly by `_AtacNet.fanout` on
+    serialized traffic."""
+    extra = ATAC_EXTRA + \
+        "[dram_directory]\ndirectory_type = ackwise\nmax_hw_sharers = 2\n"
+    sc = make_config(16, MSI, net="atac", extra=extra)
+    bs = [TraceBuilder() for _ in range(16)]
+    bs[0].mutex_init(0)
+    bs[0].barrier_init(9, 16)
+    for b in bs:
+        b.barrier_wait(9)
+    for t, b in enumerate(bs):
+        b.mutex_lock(0)
+        b.load(0x900000, 8)
+        b.mutex_unlock(0)
+    for b in bs:
+        b.barrier_wait(9)
+    # the writer sits in a DIFFERENT cluster than the home tile and
+    # still holds the line: its own sweep copy and the cross-cluster
+    # hub charge must match the oracle exactly (the engine's broadcast
+    # row is holders | (all tiles except the requester))
+    bs[10].mutex_lock(0)
+    bs[10].store(0x900000, 8)
+    bs[10].mutex_unlock(0)
+    # follow-on cross-cluster traffic reads the hub queue the sweep
+    # occupied — catches under-charged hub occupancy, not just arrivals
+    for b in bs:
+        b.barrier_wait(9)
+    for t in (1, 5, 10, 15):
+        bs[t].mutex_lock(0)
+        bs[t].load(0x900000 + 64, 8)
+        bs[t].mutex_unlock(0)
+    res, gold = assert_exact(sc, TraceBatch.from_builders(bs))
+    assert int(gold.mem_counters["dir_broadcasts"].sum()) > 0
+
+
+def test_atac_memory_changes_timing():
+    """The ATAC wiring is live: completion differs from the zero-load
+    hop-counter memory net on the same workload."""
+    batch = synthetic.memory_stress_trace(
+        16, n_accesses=30, working_set_bytes=1 << 12,
+        write_fraction=0.4, shared_fraction=0.5, seed=3)
+    r_hc = Simulator(make_config(16, net="emesh_hop_counter"), batch).run()
+    r_at = Simulator(make_config(16, net="atac", extra=ATAC_EXTRA),
+                     batch).run()
+    assert r_at.completion_time_ps != r_hc.completion_time_ps
 
 
 def test_shl2_hbh_runs():
