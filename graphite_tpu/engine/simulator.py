@@ -326,6 +326,7 @@ class Simulator:
         spmd: str | None = None,
         donate: bool = False,
         dir_stage: bool | None = None,
+        barrier_host: bool | None = None,
     ):
         """`dir_stage`: force the directory write-staging path on/off
         (None = auto: on for single-device private-L2 runs whose sharers
@@ -500,6 +501,24 @@ class Simulator:
             )
         else:
             self.quantum_ps = None  # lax: unbounded
+        # Host-driven lax_barrier quanta: at 1024 tiles with the memory
+        # engine the single-region lax_barrier program crashes the
+        # tunnel's remote-compile helper (PERF.md "Known limitation"),
+        # while the per-quantum region (no outer while_loop, qend as an
+        # argument) compiles — so the Simulator drives the barrier loop
+        # host-side there, with identical quantum semantics
+        # (`lax_barrier_sync_server.h:12-36`).  Override via barrier_host.
+        if barrier_host is None:
+            barrier_host = (self.quantum_ps is not None
+                            and mem_params is not None
+                            and n_tiles >= 1024
+                            and mesh is None and not stream)
+        self.barrier_host = bool(barrier_host and self.quantum_ps
+                                 is not None)
+        if self.barrier_host and (mesh is not None or stream):
+            raise ValueError(
+                "host-driven lax_barrier quanta support single-device "
+                "resident runs only")
         if self.p2p_slack_ps is not None:
             self.params = dataclasses.replace(
                 self.params, p2p_slack_ps=self.p2p_slack_ps)
@@ -626,6 +645,7 @@ class Simulator:
         self.last_n_iterations = 0
         self._runner = None
         self._runner_max_quanta = None
+        self._hb_runner = None
 
     def _get_runner(self, max_quanta: int):
         if self._runner is None or self._runner_max_quanta != max_quanta:
@@ -652,6 +672,9 @@ class Simulator:
         Returns (done, quanta_executed).  Unlike run(), hitting the bound
         is not an error — the caller samples/checkpoints and continues.
         """
+        if self.barrier_host:
+            nq, all_done = self._host_barrier_loop(n_quanta)
+            return all_done, nq
         state, n_quanta_dev, deadlock_dev, n_iters = self._get_runner(
             n_quanta)(self.state)
         nq, deadlock, overflow, done, self.last_n_iterations = (
@@ -668,6 +691,80 @@ class Simulator:
                 f"{blocked[:16]}{'...' if len(blocked) > 16 else ''}")
         self.state = state
         return bool(done.all()), int(nq)
+
+    def _run_host_barrier(self, max_quanta: int) -> SimResults:
+        """lax_barrier quanta driven host-side (see run()): one compiled
+        per-quantum region (`_quantum_loop` with qend as an ARGUMENT, no
+        outer while_loop) — the variant that compiles where the 1024-tile
+        + memory-engine single-region lax_barrier program crashes the
+        remote-compile helper.  Semantics mirror `run_simulation`'s
+        device loop exactly: next boundary above the laggard tile, empty
+        quanta skipped, zero-progress with a tile beyond the boundary
+        jumps the window, else deadlock.  Costs one host round trip per
+        quantum (~100 ms tunneled) — the fallback trades wall clock for
+        the reference's default scheme at full scale."""
+        n, all_done = self._host_barrier_loop(max_quanta)
+        if not all_done:
+            raise RuntimeError(f"exceeded max_quanta={max_quanta}")
+        return self._results_from_state(n)
+
+    def _hb_get_runner(self):
+        if self._hb_runner is None:
+            from graphite_tpu.engine.step import _quantum_loop
+
+            params, trace = self.params, self.device_trace
+
+            def qrun(st, qend):
+                return _quantum_loop(params, trace, st, qend)
+
+            self._hb_runner = jax.jit(
+                qrun, donate_argnums=(0,) if self.donate else ())
+        return self._hb_runner
+
+    def _host_barrier_loop(self, max_quanta: int):
+        """Run up to max_quanta host-driven barrier quanta; returns
+        (quanta_executed, all_done).  Mutates self.state."""
+        import jax.numpy as jnp
+
+        runner = self._hb_get_runner()
+        qps = int(self.quantum_ps)
+        state = self.state
+        prev_qend = 0
+        n = 0
+        total_iters = 0
+        done, clocks, overflow = jax.device_get(
+            (state.done, state.core.clock_ps, state.net.overflow))
+        while n < max_quanta and not done.all():
+            min_pending = int(clocks[~done].min())
+            qend = max(prev_qend + qps, (min_pending // qps + 1) * qps)
+            state, progress_d, iters_d = runner(
+                state, jnp.asarray(qend, jnp.int64))
+            n += 1
+            progress, iters, done, clocks, overflow = jax.device_get(
+                (progress_d, iters_d, state.done, state.core.clock_ps,
+                 state.net.overflow))
+            total_iters += int(iters)
+            if bool(overflow):
+                raise MailboxOverflowError(
+                    "a (dst,src) mailbox ring overflowed; re-run with a "
+                    "larger mailbox_depth")
+            if int(progress) == 0 and not done.all():
+                ahead = clocks[~done]
+                beyond = ahead[ahead >= qend]
+                if beyond.size:
+                    # a tile crossed the boundary executing one long
+                    # record: jump the window up to it
+                    prev_qend = ((int(beyond.min()) // qps + 1) * qps
+                                 - qps)
+                    continue
+                blocked = np.flatnonzero(~done).tolist()
+                raise DeadlockError(
+                    f"no progress across a quantum; blocked tiles: "
+                    f"{blocked[:16]}{'...' if len(blocked) > 16 else ''}")
+            prev_qend = qend
+        self.state = state
+        self.last_n_iterations = total_iters
+        return n, bool(done.all())
 
     @staticmethod
     def _result_parts(state: SimState):
@@ -843,6 +940,17 @@ class Simulator:
                 "warmup() is incompatible with donate=True (the warmup "
                 "run would consume self.state); warm a separate "
                 "non-donating instance and adopt_runner() from it")
+        if self.barrier_host:
+            # compile + execute the per-quantum region (the single-region
+            # program is the one that crashes at this scale); the output
+            # is discarded, self.state stays untouched
+            import jax.numpy as jnp
+
+            qps = int(self.quantum_ps)
+            out = self._hb_get_runner()(
+                self.state, jnp.asarray(qps, jnp.int64))
+            jax.block_until_ready(out)
+            return
         out = self._get_runner(max_quanta)(self.state)
         jax.block_until_ready(out)
 
@@ -855,7 +963,7 @@ class Simulator:
         excludes retrace/recompile.  The runner closes over the other
         instance's device trace, so both instances must be built from the
         SAME trace batch object and identical config/donation."""
-        if other._runner is None:
+        if other._runner is None and other._hb_runner is None:
             raise ValueError(
                 "adopt_runner: the donor has no compiled runner (run it "
                 "first) — adopting nothing would silently time a "
@@ -864,6 +972,7 @@ class Simulator:
                 or other.quantum_ps != self.quantum_ps
                 or other.mesh != self.mesh
                 or other.donate != self.donate
+                or other.barrier_host != self.barrier_host
                 or other.trace_batch is not self.trace_batch):
             raise ValueError(
                 "adopt_runner needs the same trace batch and identical "
@@ -873,6 +982,7 @@ class Simulator:
         self.device_trace = other.device_trace
         self._runner = other._runner
         self._runner_max_quanta = other._runner_max_quanta
+        self._hb_runner = other._hb_runner
 
     def run(self, max_quanta: int = 1_000_000) -> SimResults:
         """Drive quanta until every tile's trace is exhausted.
@@ -888,7 +998,13 @@ class Simulator:
         *running* threads, so idle quanta never happen there either —
         `lax_barrier_sync_server.h:12-36`).  A quantum with zero progress
         while some tile was eligible to run is a genuine deadlock.
+
+        Under `barrier_host` (the 1024-tile + memory-engine lax_barrier
+        combination) the barrier loop runs host-side instead — identical
+        quantum semantics, one compiled region per quantum.
         """
+        if self.barrier_host:
+            return self._run_host_barrier(max_quanta)
         state, n_quanta_dev, deadlock_dev, n_iters = self._get_runner(
             max_quanta)(self.state)
         # ONE batched device→host fetch for control flags + all summary

@@ -289,31 +289,15 @@ class _AtacNet(_HbhNet):
         def cyc_ps(n):
             return _ceil_div(int(n) * 10**6, p.freq_mhz)
 
-        def cluster_of(t):
-            x, y = t % p.mesh_width, t // p.mesh_width
-            cpr = p.mesh_width // p.cluster_width
-            return (y // p.cluster_height) * cpr + (x // p.cluster_width)
-
-        def hub_tile(c):
-            cpr = p.mesh_width // p.cluster_width
-            return ((c // cpr) * p.cluster_height * p.mesh_width
-                    + (c % cpr) * p.cluster_width)
-
-        def hops(a, b):
-            w = p.mesh_width
-            return abs(a % w - b % w) + abs(a // w - b // w)
-
         ser_ps = 0 if src == dst else cyc_ps(flits)
-        csrc, cdst = cluster_of(src), cluster_of(dst)
-        direct = hops(src, dst)
-        use_enet = csrc == cdst
-        if p.global_routing_strategy == "distance_based":
-            use_enet = use_enet or direct <= p.unicast_distance_threshold
-        if use_enet:
-            return t_send_ps + cyc_ps(direct * p.enet_hop_cycles) + ser_ps
+        csrc, cdst = self._cluster(src), self._cluster(dst)
+        if not self._use_onet(src, dst):
+            return (t_send_ps
+                    + cyc_ps(self._hops(src, dst) * p.enet_hop_cycles)
+                    + ser_ps)
 
         sendhub_arrive = t_send_ps + cyc_ps(
-            hops(src, hub_tile(csrc)) * p.enet_hop_cycles)
+            self._hops(src, self._hub(csrc)) * p.enet_hop_cycles)
         if p.contention_enabled:
             t_cyc = _ceil_div(sendhub_arrive * p.freq_mhz, 10**6)
             d, _ = self._delay(csrc, t_cyc, flits)

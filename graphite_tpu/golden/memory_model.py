@@ -623,12 +623,10 @@ class GoldenMemory:
                 home, list(targets), mp.req_bits, eff_time, enabled,
                 n_copies=mp.n_tiles,
                 ranks={s: s for s in targets},
-                # the engine's broadcast row is holders | (all tiles
-                # except the requester): a requester that still HOLDS the
-                # victim line gets a copy (NULLIFY sweeps must kill it)
-                copy_set=sorted(
-                    (set(range(mp.n_tiles)) - {requester})
-                    | set(targets)))
+                # the engine's broadcast row is `send | over_bc` — ALL
+                # tiles, requester included (engine.py:1825; only the
+                # shared-L2 engine excludes the requester)
+                copy_set=list(range(mp.n_tiles)))
         else:
             f_arrivals = self._net_fanout(home, list(targets), mp.req_bits,
                                           eff_time, enabled)

@@ -37,16 +37,12 @@ def run_one(net: str, dir_size: str, points: int,
     from graphite_tpu.tools._template import config_text
     from graphite_tpu.trace.benchmarks import fft_trace
 
-    # lax scheme: the lax_barrier variant at 1024 tiles + memory engine
-    # still crashes the remote-compile helper (PERF.md)
-    import sys
-
-    print("WARNING: substituting clock scheme lax for lax_barrier at "
-          "1024 tiles (remote-compile helper crash, PERF.md); skew "
-          "bounds differ from the reference default",
-          file=sys.stderr, flush=True)
+    # the reference's default lax_barrier scheme: at this scale the
+    # Simulator auto-selects the host-driven barrier loop (barrier_host)
+    # since the single-region lax_barrier program crashes the tunnel's
+    # remote-compile helper (PERF.md)
     text = config_text(
-        1024, shared_mem=True, clock_scheme="lax",
+        1024, shared_mem=True, clock_scheme="lax_barrier",
         network="emesh_hop_by_hop" if net == "hbh" else "emesh_hop_counter")
     if dir_size == "small":
         # quarter-size directory: 0.73 GB of sharer state instead of the

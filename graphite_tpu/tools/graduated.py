@@ -76,16 +76,9 @@ def run_config(n: int, small: bool):
     elif n == 5:
         tiles = 1024 // scale
         text = _cfg(tiles, shared_mem=True, dvfs=True)
-        if not small:
-            # Known limitation (PERF.md): the tunnel's remote-compile
-            # helper crashes on the lax_barrier program variant at 1024
-            # tiles with the full memory engine; the lax scheme (identical
-            # code, unbounded quantum) compiles and runs.  canneal has no
-            # mid-run barriers, so only the skew bound differs.
-            print("WARNING: config 5 substitutes clock scheme lax for "
-                  "lax_barrier (1024-tile remote-compile helper crash, "
-                  "PERF.md)", file=sys.stderr, flush=True)
-            text = text.replace("scheme = lax_barrier", "scheme = lax")
+        # 1024 tiles + memory engine + lax_barrier auto-selects the
+        # host-driven barrier loop (Simulator.barrier_host): the
+        # reference's default scheme at full scale, no substitution
         sc = SimConfig(ConfigFile.from_string(text))
         batch = canneal_trace(tiles, footprint_lines=4096,
                               swaps_per_tile=8 if small else 16)

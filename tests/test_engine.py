@@ -597,3 +597,43 @@ class TestAutoMailboxDepth:
         assert ra.clock_ps.tolist() == rb.clock_ps.tolist()
         assert (ra.instruction_count.tolist()
                 == rb.instruction_count.tolist())
+
+
+class TestHostBarrier:
+    """barrier_host: lax_barrier quanta driven host-side (the 1024-tile
+    + memory-engine fallback) — identical semantics to the device loop."""
+
+    def test_host_barrier_matches_device(self):
+        b = TraceBuilder()
+        for _ in range(1200):
+            b.instr(Op.IDIV)
+        bs = [b] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        batch = TraceBatch.from_builders(bs)
+        sc = make_config(scheme="lax_barrier")
+        r_dev = run(sc, batch)
+        r_host = run(sc, batch, barrier_host=True)
+        assert r_dev.clock_ps.tolist() == r_host.clock_ps.tolist()
+        assert r_dev.n_quanta == r_host.n_quanta
+
+    def test_host_barrier_coherence_exact(self):
+        from graphite_tpu.config import ConfigFile, SimConfig
+        from graphite_tpu.tools._template import config_text
+        from graphite_tpu.trace import synthetic
+
+        batch = synthetic.memory_stress_trace(
+            8, n_accesses=40, working_set_bytes=1 << 12,
+            write_fraction=0.4, shared_fraction=0.6, seed=5)
+        sc = SimConfig(ConfigFile.from_string(config_text(
+            8, shared_mem=True, clock_scheme="lax_barrier")))
+        r_dev = run(sc, batch)
+        r_host = run(sc, batch, barrier_host=True)
+        assert r_dev.clock_ps.tolist() == r_host.clock_ps.tolist()
+        for k in r_dev.mem_counters:
+            assert (np.asarray(r_dev.mem_counters[k])
+                    == np.asarray(r_host.mem_counters[k])).all(), k
+
+    def test_host_barrier_deadlock_detected(self):
+        b0 = TraceBuilder().recv(1)
+        bs = [b0] + [TraceBuilder().instr(Op.IALU) for _ in range(3)]
+        with pytest.raises(DeadlockError):
+            run(make_config(scheme="lax_barrier"), bs, barrier_host=True)
