@@ -483,6 +483,14 @@ class Simulator:
             # directory sharer maps grow as tiles^2 x dir entries)
             mem_gate=(mem_params is None
                       or _mem_state_bytes(mem_params) < 1 << 30),
+            # runtime BBLOCK compression for per-instruction streams
+            # (simple-core memoryless runs; bit-exact by construction —
+            # engine/step.py plain-run batching)
+            # 16 measured best on the 1024-tile per-instruction streamed
+            # ring (8: 1.06M, 16: 1.76M, 32: 0.79M instr/s — PERF.md)
+            plain_unroll=cfg.get_int(
+                "general/plain_unroll",
+                16 if (mem_params is None and iocoom_params is None) else 1),
         )
         # Clock-skew scheme (`carbon_sim.cfg:85-108`): lax_barrier uses the
         # config quantum; lax runs one unbounded quantum; lax_p2p runs
@@ -502,19 +510,27 @@ class Simulator:
         else:
             self.quantum_ps = None  # lax: unbounded
         # Host-driven lax_barrier quanta: at 1024 tiles with the memory
-        # engine the single-region lax_barrier program crashes the
-        # tunnel's remote-compile helper (PERF.md "Known limitation"),
-        # while the per-quantum region (no outer while_loop, qend as an
-        # argument) compiles — so the Simulator drives the barrier loop
-        # host-side there, with identical quantum semantics
+        # engine, SEND-carrying traces crash the TPU worker under the
+        # single-region lax_barrier program (round-5 retest: canneal —
+        # no CAPI sends — compiles AND runs single-region now; the FFT
+        # skeleton still kills the worker), while the per-quantum region
+        # (no outer while_loop, qend as an argument) runs — so the
+        # Simulator drives the barrier loop host-side exactly there,
+        # with identical quantum semantics
         # (`lax_barrier_sync_server.h:12-36`).  Override via barrier_host.
         if barrier_host is None:
+            from graphite_tpu.trace.schema import Op as _Op
+
             barrier_host = (self.quantum_ps is not None
                             and mem_params is not None
                             and n_tiles >= 1024
+                            and bool(np.any(trace.op == int(_Op.SEND)))
                             and mesh is None and not stream)
-        self.barrier_host = bool(barrier_host and self.quantum_ps
-                                 is not None)
+        if barrier_host and self.quantum_ps is None:
+            raise ValueError(
+                "barrier_host=True needs the lax_barrier clock scheme "
+                "(there are no quanta to drive host-side otherwise)")
+        self.barrier_host = bool(barrier_host)
         if self.barrier_host and (mesh is not None or stream):
             raise ValueError(
                 "host-driven lax_barrier quanta support single-device "

@@ -94,6 +94,13 @@ class EngineParams:
     # gate when the memory state (directory sharer maps dominate at large
     # tile counts) is too big to duplicate in HBM.
     mem_gate: bool = True
+    # Commit up to this many consecutive PLAIN records (static
+    # non-branch instruction costs — no machinery, memory, or predictor
+    # state) per lane per iteration: runtime BBLOCK compression for
+    # per-instruction streams, bit-exact by construction (each follow-on
+    # stays quantum-bounded like the per-iteration active check).
+    # Simple-core memoryless runs only; 1 = off.
+    plain_unroll: int = 1
     # Run the net/barrier/mutex/pub/join machinery unconditionally
     # instead of behind their any-lane-active lax.conds.  The conds are a
     # pure wall-clock optimization (skip scatter kernels on quiet
@@ -920,6 +927,63 @@ def subquantum_iteration(
         dvfs_set_now = active & is_dvfs_set & (aux0 == 0) & (aux1 > 0)
         freq_mhz = jnp.where(dvfs_set_now, aux1, core.freq_mhz)
 
+    # --- plain-run batching (per-instruction streams) --------------------
+    # A lane whose record committed may commit up to plain_unroll-1
+    # FOLLOW-ON records in the same iteration when they are PLAIN static
+    # costs (op <= MFENCE, not BRANCH): no machinery, no memory slots, no
+    # predictor state — pure additive cost, so batching is bit-exact (per
+    # record ceil cycles->ps conversion, accumulated clock must stay
+    # before qend exactly like the per-iteration `active` check; a DVFS
+    # retune is an event, so the batch always runs at one frequency).
+    # This is runtime BBLOCK compression for externally captured
+    # per-instruction traces — the streamed replay's floor (PERF.md).
+    # (lax_p2p excluded: its pairwise clamp is a PER-ITERATION hold, so
+    # batching extra records would overrun the slack bound)
+    if (params.plain_unroll > 1 and params.mem is None
+            and params.iocoom is None and params.p2p_slack_ps is None
+            and trace.length > 1):
+        # short traces (compressed benchmark skeletons) bound the window
+        KX = min(params.plain_unroll - 1, trace.length - 1)
+        offs = jnp.arange(1, KX + 1, dtype=jnp.int32)
+        pos_l = jnp.minimum(idx_l[:, None] + offs[None, :],
+                            trace.length - 1)
+        # lockstep fast path (same trick as the record fetch): one
+        # dynamic column slice instead of a per-row gather; the gather
+        # runs when lanes diverged or the slice would clamp at the edge
+        ok_uniform = uniform & (idx[0] + 1 + KX <= trace.length)
+        ops_x_l = lax.cond(
+            ok_uniform,
+            lambda _: lax.dynamic_slice_in_dim(
+                trace.op, idx[0] + 1, KX, axis=1),
+            lambda _: jnp.take_along_axis(trace.op, pos_l, axis=1),
+            None)
+        ops_x = px.ag(ops_x_l).astype(jnp.int32)
+        valid = (idx[:, None] + offs[None, :]) < trace.length
+        plain = valid & (ops_x <= int(Op.MFENCE)) & (
+            ops_x != int(Op.BRANCH))
+        cycles_x = cost_table[jnp.clip(ops_x, 0, 19)]
+        cost_x = cycles_to_ps(cycles_x, freq_mhz.astype(I64)[:, None])
+        # the CURRENT record may be an ENABLE/DISABLE_MODELS event — its
+        # follow-ons run under the POST-event model state (same formula
+        # the commit applies to state.models_enabled below)
+        en_post = jnp.where(
+            jnp.any(active & (op == Op.DISABLE_MODELS)), False,
+            jnp.where(jnp.any(active & (op == Op.ENABLE_MODELS)), True,
+                      enabled))
+        cost_x = jnp.where(en_post, cost_x, 0)
+        cum_before = clock[:, None] + jnp.cumsum(cost_x, axis=1) - cost_x
+        commit_x = (plain & (cum_before < quantum_end_ps)
+                    & advance[:, None])
+        commit_x = jnp.cumprod(commit_x.astype(jnp.int32), axis=1) > 0
+        extra_n = commit_x.sum(axis=1).astype(jnp.int32)
+        extra_charged = jnp.where(en_post, extra_n, 0)
+        extra_cost = jnp.where(commit_x, cost_x, 0).sum(axis=1)
+        clock = clock + extra_cost
+    else:
+        extra_n = jnp.zeros((T,), jnp.int32)
+        extra_charged = extra_n
+        extra_cost = jnp.zeros((T,), I64)
+
     instr_now = advance & (is_static | is_branch
                            | (is_dynamic & ~is_spawn_instr))
     recv_charged = recv_now & (recv_wait_ps > 0) & enabled
@@ -931,9 +995,10 @@ def subquantum_iteration(
     new_core = core.replace(
         clock_ps=clock,
         freq_mhz=freq_mhz,
-        idx=core.idx + advance.astype(jnp.int32),
+        idx=core.idx + advance.astype(jnp.int32) + extra_n,
         instruction_count=core.instruction_count
         + (instr_now & enabled).astype(I64)
+        + extra_charged.astype(I64)
         + jnp.where(advance & is_bblock & enabled, aux0.astype(I64), 0)
         + recv_charged.astype(I64)
         + sync_charged.astype(I64),
@@ -942,7 +1007,7 @@ def subquantum_iteration(
            + ioc_mem_stall
            if params.iocoom is not None else
            jnp.where(advance & (instr_like | is_bblock), mem_acc_ps, 0)),
-        execution_stall_ps=core.execution_stall_ps
+        execution_stall_ps=core.execution_stall_ps + extra_cost
         + (jnp.where(advance & (is_bblock | simple_instr), cost_ps, 0)
            + ioc_exec_stall
            if params.iocoom is not None else

@@ -76,9 +76,12 @@ def run_config(n: int, small: bool):
     elif n == 5:
         tiles = 1024 // scale
         text = _cfg(tiles, shared_mem=True, dvfs=True)
-        # 1024 tiles + memory engine + lax_barrier auto-selects the
-        # host-driven barrier loop (Simulator.barrier_host): the
-        # reference's default scheme at full scale, no substitution
+        # canneal carries no CAPI sends, so the single-region
+        # lax_barrier program compiles and runs device-driven at 1024
+        # tiles (round-5 retest); SEND-carrying traces at this scale
+        # auto-select the host-driven barrier loop instead
+        # (Simulator.barrier_host).  Either way: the reference's default
+        # scheme, no substitution.
         sc = SimConfig(ConfigFile.from_string(text))
         batch = canneal_trace(tiles, footprint_lines=4096,
                               swaps_per_tile=8 if small else 16)
