@@ -91,6 +91,11 @@ class GoldenShL2:
             from graphite_tpu.golden.interpreter import _HbhNet
 
             self.net = _HbhNet(mp.net_hbh)
+        elif mp.net_atac is not None:
+            # coherence messages over the ATAC optical NoC
+            from graphite_tpu.golden.interpreter import _AtacNet
+
+            self.net = _AtacNet(mp.net_atac)
         else:
             self.net = None
         self.counters = {
@@ -116,14 +121,20 @@ class GoldenShL2:
 
     def _net_zero_ps(self, src, dst, bits, enabled):
         mp = self.mp
+        if not enabled:
+            return 0
+        if mp.net_atac is not None:
+            # ATAC zero-load path cost (the engine's mem_net_latency_ps
+            # atac branch — used by its _dram_lat_ps round trip)
+            return self.net._zeroload_ps(src, dst, bits)[0]
         if mp.net_kind == "magic":
-            return _cycles_to_ps(1, mp.net_freq_mhz) if enabled else 0
+            return _cycles_to_ps(1, mp.net_freq_mhz)
         w = mp.mesh_width
         hops = abs(src % w - dst % w) + abs(src // w - dst // w)
         cycles = hops * mp.hop_latency_cycles
         if src != dst:
             cycles += _ceil_div(bits, mp.flit_width_bits)
-        return _cycles_to_ps(cycles, mp.net_freq_mhz) if enabled else 0
+        return _cycles_to_ps(cycles, mp.net_freq_mhz)
 
     def _net_arrive(self, src, dst, bits, t_send, enabled):
         if self.net is not None:
@@ -131,10 +142,10 @@ class GoldenShL2:
         return t_send + self._net_zero_ps(src, dst, bits, enabled)
 
     def _net_fanout(self, src, targets, bits, t0, enabled,
-                    n_copies=None, ranks=None):
+                    n_copies=None, ranks=None, copy_set=None):
         if self.net is not None:
             return self.net.fanout(src, targets, bits, t0, enabled,
-                                   n_copies, ranks)
+                                   n_copies, ranks, copy_set)
         return {s: t0 + self._net_zero_ps(src, s, bits, enabled)
                 for s in targets}
 
@@ -236,7 +247,7 @@ class GoldenShL2:
             if v_valid and v_entry is not None and \
                     v_entry.dstate != DIR_UNCACHED:
                 self._run_nullify(home, v_line, v_way, v_entry,
-                                  rtime, enabled)
+                                  rtime, enabled, requester)
                 # resume the original request (saved + re-run)
                 return self._home_txn(home, requester, line, is_write,
                                       rtime, enabled, _resumed=True)
@@ -318,7 +329,12 @@ class GoldenShL2:
                     f_arrivals = self._net_fanout(
                         home, list(targets), mp.req_bits, eff_time,
                         enabled, n_copies=mp.n_tiles - 1,
-                        ranks=self._bc_ranks(targets, requester))
+                        ranks=self._bc_ranks(targets, requester),
+                        # the shl2 engine's sweep row: holders | (all
+                        # tiles except the requester)
+                        copy_set=sorted(
+                            (set(range(mp.n_tiles)) - {requester})
+                            | set(targets)))
                 else:
                     f_arrivals = self._net_fanout(
                         home, list(targets), mp.req_bits, eff_time,
@@ -368,9 +384,16 @@ class GoldenShL2:
         its tile id minus one if the requester sits below it."""
         return {s: s - (1 if requester < s else 0) for s in targets}
 
-    def _run_nullify(self, home, v_line, v_way, entry, rtime, enabled):
+    def _run_nullify(self, home, v_line, v_way, entry, rtime, enabled,
+                     requester):
         """Evict a slice victim with live L1 copies: INV the sharers (or
-        FLUSH the owner), then the entry dies; dirty data -> DRAM."""
+        FLUSH the owner), then the entry dies; dirty data -> DRAM.
+
+        An ackwise/limited_broadcast victim whose sharer count overflows
+        the hardware list sweeps as a BROADCAST, exactly like the engine
+        (`engine_shl2.py` over_bc includes nullify_live & shared): every
+        tile except the saved requester gets a copy — PLUS the requester
+        itself when it holds the victim line (it sits in `targets`)."""
         mp = self.mp
         l2 = self.l2[home]
         c = self.counters
@@ -384,8 +407,25 @@ class GoldenShL2:
             targets = {s: "inv" for s in entry.sharers}
         txn_time = eff_time
         got_flush = False
-        f_arrivals = self._net_fanout(home, list(targets), mp.req_bits,
-                                      eff_time, enabled)
+        broadcast = (mp.dir_type in ("ackwise", "limited_broadcast")
+                     and entry.dstate not in (DIR_MODIFIED, DIR_EXCLUSIVE)
+                     and len(entry.sharers) > mp.max_hw_sharers)
+        if broadcast:
+            if enabled:
+                c["dir_broadcasts"][home] += 1
+            copy_set = sorted((set(range(mp.n_tiles)) - {requester})
+                              | set(targets))
+            # rank = position in the engine's send row (the requester's
+            # column is present only when it holds the victim line)
+            ranks = {s: s - (1 if (requester < s
+                                   and requester not in targets) else 0)
+                     for s in targets}
+            f_arrivals = self._net_fanout(
+                home, list(targets), mp.req_bits, eff_time, enabled,
+                n_copies=len(copy_set), ranks=ranks, copy_set=copy_set)
+        else:
+            f_arrivals = self._net_fanout(home, list(targets), mp.req_bits,
+                                          eff_time, enabled)
         for s in sorted(targets):
             ack_time, dirty = self._serve_fwd(
                 s, targets[s], line=v_line, ftime=f_arrivals[s],

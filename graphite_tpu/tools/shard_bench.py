@@ -78,6 +78,18 @@ def main():
     tgb, _ = _timed(sc2, batch2, mesh, spmd="gspmd")
     results.append(("ring_64t", t1b, tsmb, tgb))
 
+    # workload 3: shared-L2 coherence stress — round 5 put the shL2
+    # engines on the packed exchange; its multi-device overhead should
+    # sit near the MSI program's, not GSPMD's ~10x
+    sc3, batch3 = coherence_stress_workload(
+        64, n_accesses=200, protocol="pr_l1_sh_l2_msi")
+    t1c, r1c = _timed(sc3, batch3, None)
+    tsmc, rsmc = _timed(sc3, batch3, mesh)
+    np.testing.assert_array_equal(r1c.clock_ps, rsmc.clock_ps)
+    tgc, rgc = _timed(sc3, batch3, mesh, spmd="gspmd")
+    np.testing.assert_array_equal(r1c.clock_ps, rgc.clock_ps)
+    results.append(("shl2_stress_64t", t1c, tsmc, tgc))
+
     for name, a, b, c in results:
         print(f"{name}: single={a*1e3:.0f} ms  "
               f"{n_dev}dev shard_map={b*1e3:.0f} ms ({b/a:.2f}x)  "

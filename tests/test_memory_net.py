@@ -336,3 +336,46 @@ def test_fanout_single_target_matches_unicast():
                 mp, noc, srcs, dsts, 128, t0, mask, True)
             assert int(arr_fan[src, dst]) == int(arr_uni[src]), (
                 net, src, dst)
+
+
+def test_shl2_atac_memory_serialized_bit_exact():
+    """The shared-L2 engine routes through the same mem_net_send, so
+    `memory = atac` serves it too — serialized traffic bit-exact vs the
+    shl2 oracle riding the same `_AtacNet`."""
+    sc = make_config(16, proto="pr_l1_sh_l2_msi", net="atac",
+                     extra=ATAC_EXTRA)
+    res, gold = assert_exact(sc, mutex_rmw(16, rounds=3, lines=2))
+    assert int(np.asarray(res.mem_counters["l2_misses"]).sum()) > 0
+
+
+def test_shl2_atac_ackwise_broadcast_exact():
+    """Shared-L2 overflowed-entry INV sweep under memory = atac: the
+    shl2 engine's broadcast row (holders | all-except-requester) and hub
+    charge mirror `memory_model_shl2`'s oracle exactly on serialized
+    traffic — the writer sits in a different cluster than the home and
+    still holds the line."""
+    extra = ATAC_EXTRA + \
+        "[dram_directory]\ndirectory_type = ackwise\nmax_hw_sharers = 2\n"
+    sc = make_config(16, proto="pr_l1_sh_l2_msi", net="atac", extra=extra)
+    bs = [TraceBuilder() for _ in range(16)]
+    bs[0].mutex_init(0)
+    bs[0].barrier_init(9, 16)
+    for b in bs:
+        b.barrier_wait(9)
+    for t, b in enumerate(bs):
+        b.mutex_lock(0)
+        b.load(0x900000, 8)
+        b.mutex_unlock(0)
+    for b in bs:
+        b.barrier_wait(9)
+    bs[10].mutex_lock(0)
+    bs[10].store(0x900000, 8)
+    bs[10].mutex_unlock(0)
+    for b in bs:
+        b.barrier_wait(9)
+    for t in (1, 5, 10, 15):
+        bs[t].mutex_lock(0)
+        bs[t].load(0x900000 + 64, 8)
+        bs[t].mutex_unlock(0)
+    res, gold = assert_exact(sc, TraceBatch.from_builders(bs))
+    assert int(gold.mem_counters["dir_broadcasts"].sum()) > 0
