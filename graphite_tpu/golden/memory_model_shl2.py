@@ -326,15 +326,16 @@ class GoldenShL2:
 
             if targets:
                 if broadcast:
+                    # the shl2 engine's upgrade sweep row: all tiles
+                    # except the requester (its bit was cleared from
+                    # pending); ranks ARE positions in that row
+                    row = sorted(set(range(mp.n_tiles)) - {requester})
+                    order = {s: i for i, s in enumerate(row)}
                     f_arrivals = self._net_fanout(
                         home, list(targets), mp.req_bits, eff_time,
-                        enabled, n_copies=mp.n_tiles - 1,
-                        ranks=self._bc_ranks(targets, requester),
-                        # the shl2 engine's sweep row: holders | (all
-                        # tiles except the requester)
-                        copy_set=sorted(
-                            (set(range(mp.n_tiles)) - {requester})
-                            | set(targets)))
+                        enabled, n_copies=len(row),
+                        ranks={s: order[s] for s in targets},
+                        copy_set=row)
                 else:
                     f_arrivals = self._net_fanout(
                         home, list(targets), mp.req_bits, eff_time,
@@ -377,13 +378,6 @@ class GoldenShL2:
         return (self._net_arrive(home, requester, mp.rep_bits, rep_ready,
                                  enabled), rep)
 
-    @staticmethod
-    def _bc_ranks(targets, requester):
-        """Engine broadcast ranks: cumsum over the `send | over_bc` row,
-        which covers every tile EXCEPT the requester — target s's rank is
-        its tile id minus one if the requester sits below it."""
-        return {s: s - (1 if requester < s else 0) for s in targets}
-
     def _run_nullify(self, home, v_line, v_way, entry, rtime, enabled,
                      requester):
         """Evict a slice victim with live L1 copies: INV the sharers (or
@@ -415,14 +409,12 @@ class GoldenShL2:
                 c["dir_broadcasts"][home] += 1
             copy_set = sorted((set(range(mp.n_tiles)) - {requester})
                               | set(targets))
-            # rank = position in the engine's send row (the requester's
-            # column is present only when it holds the victim line)
-            ranks = {s: s - (1 if (requester < s
-                                   and requester not in targets) else 0)
-                     for s in targets}
+            # copy_set IS the engine's send row — ranks are positions
+            order = {s: i for i, s in enumerate(copy_set)}
             f_arrivals = self._net_fanout(
                 home, list(targets), mp.req_bits, eff_time, enabled,
-                n_copies=len(copy_set), ranks=ranks, copy_set=copy_set)
+                n_copies=len(copy_set),
+                ranks={s: order[s] for s in targets}, copy_set=copy_set)
         else:
             f_arrivals = self._net_fanout(home, list(targets), mp.req_bits,
                                           eff_time, enabled)

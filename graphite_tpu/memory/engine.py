@@ -633,21 +633,34 @@ def _stage_key(d, sets, way):
 def _stage_put(d, sets, way, mask, new_sh):
     """Stage a masked per-lane sharers write.  Overwrites the entry's
     existing slot if staged (unique-key invariant), else appends at the
-    next free slots (rank-compacted, so capacity tracks real writes)."""
+    next free slots (rank-compacted, so capacity tracks real writes).
+
+    The whole put sits under a lax.cond on "any lane writes": compute
+    stretches then skip the [T, C] dedup scan and the table scatters.
+    Unlike the big-store conds this one is safe — the carried staging
+    table is a few MB, so the cond's double-buffering is noise."""
     C = d.skey.shape[0]
-    key = _stage_key(d, sets, way)
-    m = d.skey[None, :] == key[:, None]            # [T, C]
-    found = m.any(axis=1)
-    c_found = jnp.argmax(m, axis=1).astype(jnp.int32)
-    app = mask & ~found
-    rank = jnp.cumsum(app.astype(jnp.int32)) - 1
-    # masked-off lanes target slot C: out of bounds, dropped.  In-bounds
-    # positions are unique (unique keys; distinct append ranks).
-    pos = jnp.where(mask, jnp.where(found, c_found, d.sn + rank), C)
-    return d.replace(
-        skey=d.skey.at[pos].set(key, mode="drop", unique_indices=True),
-        sval=d.sval.at[pos].set(new_sh, mode="drop", unique_indices=True),
-        sn=d.sn + jnp.sum(app, dtype=jnp.int32))
+
+    def do(_):
+        key = _stage_key(d, sets, way)
+        m = d.skey[None, :] == key[:, None]        # [T, C]
+        found = m.any(axis=1)
+        c_found = jnp.argmax(m, axis=1).astype(jnp.int32)
+        app = mask & ~found
+        rank = jnp.cumsum(app.astype(jnp.int32)) - 1
+        # masked-off lanes target slot C: out of bounds, dropped.  In-
+        # bounds positions are unique (unique keys; distinct ranks).
+        pos = jnp.where(mask, jnp.where(found, c_found, d.sn + rank), C)
+        return (d.skey.at[pos].set(key, mode="drop", unique_indices=True),
+                d.sval.at[pos].set(new_sh, mode="drop",
+                                   unique_indices=True),
+                d.sn + jnp.sum(app, dtype=jnp.int32))
+
+    def skip(_):
+        return d.skey, d.sval, d.sn
+
+    skey, sval, sn = jax.lax.cond(jnp.any(mask), do, skip, None)
+    return d.replace(skey=skey, sval=sval, sn=sn)
 
 
 def _stage_overlay(d, sets, way, sharers):
