@@ -150,7 +150,7 @@ scheme = lax
         sim2.warmup()
         t0 = time.perf_counter()
         r = sim2.run()
-        return r.total_instructions / (time.perf_counter() - t0)
+        return r.total_instructions / (time.perf_counter() - t0), sim2
 
     # Companion rates so the round artifact tracks COHERENCE and NoC-
     # contention throughput, not just the memoryless headline (a
@@ -165,17 +165,22 @@ scheme = lax
 
         sc_msi = SimConfig(ConfigFile.from_string(config_text(
             64, core="iocoom", shared_mem=True, clock_scheme="lax")))
-        msi_rate = _timed_rate(Simulator(
+        msi_rate, msi_sim = _timed_rate(Simulator(
             sc_msi, fft_trace(64, points_per_tile=512, use_memory=True),
             inner_block=64))
         sc_hbh = SimConfig(ConfigFile.from_string(config_text(
             256, network="emesh_hop_by_hop", clock_scheme="lax")))
-        hbh_rate = _timed_rate(Simulator(
+        hbh_rate, _ = _timed_rate(Simulator(
             sc_hbh, radix_trace(256, keys_per_tile=1024),
             inner_block=64))
         companions = {
             "coherence_msi_instr_per_s": round(msi_rate),
             "hop_by_hop_instr_per_s": round(hbh_rate),
+            # gate observability (round 6): per-phase lax.cond skip
+            # counts + the engine-iteration denominator, so BENCH_r{N}
+            # tracks skip rates alongside throughput
+            "coherence_msi_phase_skips": msi_sim.last_phase_skips,
+            "coherence_msi_engine_iters": int(msi_sim.last_n_iterations),
         }
 
         # The north-star-shaped configuration, measured honestly (VERDICT

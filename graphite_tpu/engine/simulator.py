@@ -327,6 +327,9 @@ class Simulator:
         donate: bool = False,
         dir_stage: bool | None = None,
         barrier_host: bool | None = None,
+        phase_gate: bool | None = None,
+        mem_gate_bytes: int | None = None,
+        barrier_batch: int | None = None,
     ):
         """`dir_stage`: force the directory write-staging path on/off
         (None = auto: on for single-device private-L2 runs whose sharers
@@ -337,6 +340,27 @@ class Simulator:
         multi-chip program (parallel/px.py; the default for every
         protocol) — or "gspmd" — whole-program partitioning via
         sharding specs (the legacy path).
+
+        `phase_gate`: per-phase activity gating of the memory engines —
+        each protocol phase under its own scalar-predicate lax.cond
+        carrying only small per-phase state, so quiet phases cost ~zero
+        at EVERY scale including the >= 1 GB directories where the
+        whole-engine mem_gate must stay off (MemParams.phase_gate).
+        None = on whenever the memory subsystem is built; False is the
+        escape hatch back to the straight-line engine.  Config key:
+        `[general] phase_gate`.
+
+        `mem_gate_bytes`: the whole-engine mem_gate's state-size ceiling
+        (the gate's lax.cond double-buffers the carried memory state, so
+        it auto-disables above this; formerly a hard-coded 1 << 30).
+        Config key: `[general] mem_gate_bytes`.
+
+        `barrier_batch`: quanta per host dispatch under `barrier_host`
+        (a bounded device-side while_loop that early-exits on
+        host-visible work — done/overflow/deadlock — amortizing the
+        ~100 ms tunnel dispatch ~K x; `engine/step.barrier_host_batch`).
+        1 restores the per-quantum dispatch.  Config key:
+        `[general] barrier_batch` (default 8).
 
         `donate=True` gives the input state's device buffers to XLA each
         run (halves big-state HBM residency — required for the 1024-tile
@@ -417,15 +441,42 @@ class Simulator:
                 dir_stage = (private_l2 and mesh is None
                              and sharers_bytes >= 64 << 20)
             if dir_stage:
-                if not private_l2 or mesh is not None:
+                if not private_l2:
+                    # Not "pending work": the shared-L2 engines don't
+                    # NEED staging.  Their embedded directory (round-5
+                    # packed words + set-row-major sharer rows) is
+                    # written as ONE add-a-delta row scatter per phase,
+                    # not the private engine's three per-lane
+                    # entry-granular passes that staging amortizes — so
+                    # there is no dense-scatter storm to lift.
                     raise ValueError(
-                        "dir_stage requires a private-L2 protocol on a "
-                        "single device")
+                        "dir_stage applies to the private-L2 directory "
+                        "protocols only: the shared-L2 engines' embedded "
+                        "directory already writes one row-form scatter "
+                        "per phase (no per-entry dense-pass storm to "
+                        "stage away), so staging would add table scans "
+                        "for nothing")
+                if mesh is not None:
+                    raise ValueError(
+                        "dir_stage supports single-device programs only "
+                        "(the staging table is not threaded through the "
+                        "shard_map exchange)")
                 wpi = (5 if mem_params.dir_type == "limited_no_broadcast"
                        else 3)
                 mem_params = dataclasses.replace(
                     mem_params,
                     dir_stage_cap=wpi * n_tiles * inner_block)
+            # Per-phase activity gating (round 6): on by default for
+            # every memory-engine program — the per-phase conds carry
+            # only small state (see MemParams.phase_gate), so unlike the
+            # whole-engine mem_gate there is no size ceiling; predicates
+            # are replicated-deterministic, so sharded programs gate
+            # identically on every device.
+            if phase_gate is None:
+                phase_gate = cfg.get_bool("general/phase_gate", True)
+            if phase_gate:
+                mem_params = dataclasses.replace(mem_params,
+                                                 phase_gate=True)
         # Full hop-by-hop USER NoC with per-port contention
         user_hbh = None
         user_atac = None
@@ -480,17 +531,21 @@ class Simulator:
             user_atac=user_atac,
             # the engine gate's lax.cond double-buffers the memory state in
             # HBM; keep it only while the duplicate comfortably fits (the
-            # directory sharer maps grow as tiles^2 x dir entries)
+            # directory sharer maps grow as tiles^2 x dir entries).  Above
+            # the (config-driven) ceiling the per-phase gating inside the
+            # engine takes over — its conds carry only small state, so it
+            # has no such ceiling (MemParams.phase_gate).
             mem_gate=(mem_params is None
-                      or _mem_state_bytes(mem_params) < 1 << 30),
+                      or _mem_state_bytes(mem_params)
+                      < self._resolve_mem_gate_bytes(cfg, mem_gate_bytes)),
             # runtime BBLOCK compression for per-instruction streams
             # (simple-core memoryless runs; bit-exact by construction —
             # engine/step.py plain-run batching)
             # 16 measured best on the 1024-tile per-instruction streamed
-            # ring (8: 1.06M, 16: 1.76M, 32: 0.79M instr/s — PERF.md)
-            plain_unroll=cfg.get_int(
-                "general/plain_unroll",
-                16 if (mem_params is None and iocoom_params is None) else 1),
+            # ring (8: 1.06M, 16: 1.76M, 32: 0.79M instr/s — PERF.md);
+            # configs above the measured-safe ceiling are clamped + warned
+            plain_unroll=self._resolve_plain_unroll(
+                cfg, mem_params, iocoom_params),
         )
         # Clock-skew scheme (`carbon_sim.cfg:85-108`): lax_barrier uses the
         # config quantum; lax runs one unbounded quantum; lax_p2p runs
@@ -535,6 +590,13 @@ class Simulator:
             raise ValueError(
                 "host-driven lax_barrier quanta support single-device "
                 "resident runs only")
+        # quanta per host dispatch under barrier_host (the batched
+        # device-side loop; 1 = the legacy per-quantum dispatch)
+        if barrier_batch is None:
+            barrier_batch = cfg.get_int("general/barrier_batch", 8)
+        if barrier_batch < 1:
+            raise ValueError("barrier_batch must be >= 1")
+        self.barrier_batch = int(barrier_batch)
         if self.p2p_slack_ps is not None:
             self.params = dataclasses.replace(
                 self.params, p2p_slack_ps=self.p2p_slack_ps)
@@ -663,6 +725,54 @@ class Simulator:
         self._runner_max_quanta = None
         self._hb_runner = None
 
+    @staticmethod
+    def _resolve_mem_gate_bytes(cfg, mem_gate_bytes) -> int:
+        """The whole-engine mem_gate's state-size ceiling: kwarg, else
+        `[general] mem_gate_bytes`, else the historical 1 GB default —
+        an escape hatch now, not a hard-code (per-phase gating covers
+        the regime above it)."""
+        if mem_gate_bytes is not None:
+            return int(mem_gate_bytes)
+        return cfg.get_int("general/mem_gate_bytes", 1 << 30)
+
+    @staticmethod
+    def _resolve_plain_unroll(cfg, mem_params, iocoom_params) -> int:
+        from graphite_tpu.engine.step import PLAIN_UNROLL_MAX
+
+        pu = cfg.get_int(
+            "general/plain_unroll",
+            16 if (mem_params is None and iocoom_params is None) else 1)
+        if pu > PLAIN_UNROLL_MAX:
+            import warnings
+
+            warnings.warn(
+                f"[general] plain_unroll = {pu} exceeds the measured-safe "
+                f"ceiling {PLAIN_UNROLL_MAX} (the [T, K] follow-on gather "
+                f"regresses superlinearly past it — PERF.md unroll sweep); "
+                f"clamping to {PLAIN_UNROLL_MAX}",
+                stacklevel=3)
+            pu = PLAIN_UNROLL_MAX
+        return pu
+
+    @property
+    def last_phase_skips(self):
+        """Per-phase lax.cond skip counts of the memory engine across
+        everything run so far (gate observability: skip rate = skips /
+        `last_n_iterations`).  Dict phase-name -> count in the engine's
+        own phase order, or None when the run has no memory subsystem.
+        Counts every skip source: the per-phase conds AND whole-engine
+        mem_gate skips (which count as a skip of every phase)."""
+        if self.state.mem is None:
+            return None
+        skips = np.asarray(jax.device_get(self.state.mem.phase_skips))
+        if self.params.mem.protocol.startswith("pr_l1_sh_l2"):
+            from graphite_tpu.memory.engine_shl2 import (
+                SHL2_PHASE_NAMES as names,
+            )
+        else:
+            from graphite_tpu.memory.engine import PHASE_NAMES as names
+        return {n: int(v) for n, v in zip(names, skips.tolist())}
+
     def _get_runner(self, max_quanta: int):
         if self._runner is None or self._runner_max_quanta != max_quanta:
             if self.spmd == "shard_map":
@@ -710,15 +820,17 @@ class Simulator:
 
     def _run_host_barrier(self, max_quanta: int) -> SimResults:
         """lax_barrier quanta driven host-side (see run()): one compiled
-        per-quantum region (`_quantum_loop` with qend as an ARGUMENT, no
-        outer while_loop) — the variant that compiles where the 1024-tile
-        + memory-engine single-region lax_barrier program crashes the
-        remote-compile helper.  Semantics mirror `run_simulation`'s
-        device loop exactly: next boundary above the laggard tile, empty
-        quanta skipped, zero-progress with a tile beyond the boundary
-        jumps the window, else deadlock.  Costs one host round trip per
-        quantum (~100 ms tunneled) — the fallback trades wall clock for
-        the reference's default scheme at full scale."""
+        BOUNDED multi-quantum region per dispatch (`barrier_host_batch` —
+        a device-side while_loop over up to `barrier_batch` quanta, no
+        unbounded outer loop) — the variant that compiles where the
+        1024-tile + memory-engine single-region lax_barrier program
+        crashes the remote-compile helper.  Semantics mirror
+        `run_simulation`'s device loop exactly: next boundary above the
+        laggard tile, empty quanta skipped, zero-progress with a tile
+        beyond the boundary jumps the window, else deadlock.  The batch
+        loop early-exits to the host on host-visible work (all done,
+        mailbox overflow, deadlock), so each ~100 ms tunneled dispatch is
+        amortized over up to K quanta instead of one."""
         n, all_done = self._host_barrier_loop(max_quanta)
         if not all_done:
             raise RuntimeError(f"exceeded max_quanta={max_quanta}")
@@ -726,58 +838,57 @@ class Simulator:
 
     def _hb_get_runner(self):
         if self._hb_runner is None:
-            from graphite_tpu.engine.step import _quantum_loop
+            from graphite_tpu.engine.step import barrier_host_batch
 
             params, trace = self.params, self.device_trace
+            qps = int(self.quantum_ps)
 
-            def qrun(st, qend):
-                return _quantum_loop(params, trace, st, qend)
+            def qrun(st, prev_qend, budget):
+                return barrier_host_batch(params, trace, st, prev_qend,
+                                          qps, budget)
 
             self._hb_runner = jax.jit(
                 qrun, donate_argnums=(0,) if self.donate else ())
         return self._hb_runner
 
     def _host_barrier_loop(self, max_quanta: int):
-        """Run up to max_quanta host-driven barrier quanta; returns
-        (quanta_executed, all_done).  Mutates self.state."""
+        """Run up to max_quanta host-driven barrier quanta in batches of
+        `barrier_batch` per dispatch; returns (quanta_executed,
+        all_done).  Mutates self.state.  The budget rides as a DYNAMIC
+        operand, so run_chunk-style partial budgets never recompile and
+        never overshoot."""
         import jax.numpy as jnp
 
         runner = self._hb_get_runner()
-        qps = int(self.quantum_ps)
         state = self.state
-        prev_qend = 0
+        prev_qend = jnp.asarray(0, jnp.int64)
         n = 0
         total_iters = 0
-        done, clocks, overflow = jax.device_get(
-            (state.done, state.core.clock_ps, state.net.overflow))
+        done = jax.device_get(state.done)
         while n < max_quanta and not done.all():
-            min_pending = int(clocks[~done].min())
-            qend = max(prev_qend + qps, (min_pending // qps + 1) * qps)
-            state, progress_d, iters_d = runner(
-                state, jnp.asarray(qend, jnp.int64))
-            n += 1
-            progress, iters, done, clocks, overflow = jax.device_get(
-                (progress_d, iters_d, state.done, state.core.clock_ps,
+            budget = min(self.barrier_batch, max_quanta - n)
+            state, prev_qend, nq_d, deadlock_d, iters_d = runner(
+                state, prev_qend, jnp.asarray(budget, jnp.int32))
+            nq, deadlock, iters, done, overflow = jax.device_get(
+                (nq_d, deadlock_d, iters_d, state.done,
                  state.net.overflow))
+            n += int(nq)
             total_iters += int(iters)
             if bool(overflow):
                 raise MailboxOverflowError(
                     "a (dst,src) mailbox ring overflowed; re-run with a "
                     "larger mailbox_depth")
-            if int(progress) == 0 and not done.all():
-                ahead = clocks[~done]
-                beyond = ahead[ahead >= qend]
-                if beyond.size:
-                    # a tile crossed the boundary executing one long
-                    # record: jump the window up to it
-                    prev_qend = ((int(beyond.min()) // qps + 1) * qps
-                                 - qps)
-                    continue
+            if bool(deadlock):
                 blocked = np.flatnonzero(~done).tolist()
                 raise DeadlockError(
                     f"no progress across a quantum; blocked tiles: "
                     f"{blocked[:16]}{'...' if len(blocked) > 16 else ''}")
-            prev_qend = qend
+            if int(nq) == 0 and not done.all():
+                # the device loop ran zero quanta without raising a flag:
+                # its entry condition should make this unreachable
+                raise DeadlockError(
+                    "host-barrier batch made no progress and raised no "
+                    "flag")
         self.state = state
         self.last_n_iterations = total_iters
         return n, bool(done.all())
@@ -957,14 +1068,14 @@ class Simulator:
                 "run would consume self.state); warm a separate "
                 "non-donating instance and adopt_runner() from it")
         if self.barrier_host:
-            # compile + execute the per-quantum region (the single-region
-            # program is the one that crashes at this scale); the output
-            # is discarded, self.state stays untouched
+            # compile + execute one single-quantum batch (the unbounded
+            # single-region program is the one that crashes at this
+            # scale); the output is discarded, self.state stays untouched
             import jax.numpy as jnp
 
-            qps = int(self.quantum_ps)
             out = self._hb_get_runner()(
-                self.state, jnp.asarray(qps, jnp.int64))
+                self.state, jnp.asarray(0, jnp.int64),
+                jnp.asarray(1, jnp.int32))
             jax.block_until_ready(out)
             return
         out = self._get_runner(max_quanta)(self.state)
@@ -989,6 +1100,7 @@ class Simulator:
                 or other.mesh != self.mesh
                 or other.donate != self.donate
                 or other.barrier_host != self.barrier_host
+                or other.barrier_batch != self.barrier_batch
                 or other.trace_batch is not self.trace_batch):
             raise ValueError(
                 "adopt_runner needs the same trace batch and identical "
@@ -1017,7 +1129,8 @@ class Simulator:
 
         Under `barrier_host` (the 1024-tile + memory-engine lax_barrier
         combination) the barrier loop runs host-side instead — identical
-        quantum semantics, one compiled region per quantum.
+        quantum semantics, one bounded compiled region per `barrier_batch`
+        quanta (early-exiting on host-visible work).
         """
         if self.barrier_host:
             return self._run_host_barrier(max_quanta)

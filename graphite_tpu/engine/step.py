@@ -57,6 +57,13 @@ I64 = jnp.int64
 FAR_FUTURE_PS = 2**62  # python int: folds to an inline literal, never a device-constant buffer
 ANY_SENDER = -1
 
+# Measured-safe ceiling for plain-run batching: the [T, KX] follow-on
+# gather goes superlinear past this (PERF.md unroll sweep on the
+# 1024-tile per-instruction ring: 8 -> 1.06M, 16 -> 1.76M, 32 -> 0.79M
+# instr/s).  The engine clamps the effective unroll here; the Simulator
+# warns when a config asks for more.
+PLAIN_UNROLL_MAX = 16
+
 
 @dataclasses.dataclass(frozen=True)
 class EngineParams:
@@ -92,7 +99,9 @@ class EngineParams:
     # lax.cond (big win on mixed compute/memory traces).  XLA double-
     # buffers the cond's carried outputs, so the Simulator disables the
     # gate when the memory state (directory sharer maps dominate at large
-    # tile counts) is too big to duplicate in HBM.
+    # tile counts) exceeds its (config-driven) mem_gate_bytes ceiling —
+    # above it the engines' PER-PHASE gating (MemParams.phase_gate,
+    # conds carrying only small state) takes over.
     mem_gate: bool = True
     # Commit up to this many consecutive PLAIN records (static
     # non-branch instruction costs — no machinery, memory, or predictor
@@ -942,8 +951,11 @@ def subquantum_iteration(
     if (params.plain_unroll > 1 and params.mem is None
             and params.iocoom is None and params.p2p_slack_ps is None
             and trace.length > 1):
-        # short traces (compressed benchmark skeletons) bound the window
-        KX = min(params.plain_unroll - 1, trace.length - 1)
+        # short traces (compressed benchmark skeletons) bound the window;
+        # PLAIN_UNROLL_MAX clamps configs past the measured-safe ceiling
+        # (the follow-on gather regresses superlinearly above it)
+        KX = min(params.plain_unroll - 1, PLAIN_UNROLL_MAX - 1,
+                 trace.length - 1)
         offs = jnp.arange(1, KX + 1, dtype=jnp.int32)
         pos_l = jnp.minimum(idx_l[:, None] + offs[None, :],
                             trace.length - 1)
@@ -1244,6 +1256,72 @@ def run_simulation(
         (state, jnp.asarray(0, I64), jnp.asarray(0, jnp.int32),
          jnp.asarray(False), jnp.asarray(False), jnp.asarray(0, jnp.int64)))
     return state, n_quanta, deadlock, n_iters
+
+
+def barrier_host_batch(
+    params: EngineParams,
+    trace: DeviceTrace,
+    state: SimState,
+    prev_qend: jax.Array,     # int64[] qend of the previous quantum
+    quantum_ps: int,
+    max_quanta: jax.Array,    # int32[] quanta budget for THIS dispatch
+):
+    """Up to `max_quanta` lax_barrier quanta as ONE compiled region — the
+    batched form of the host-driven barrier loop (Simulator.barrier_host).
+
+    The per-quantum host dispatch costs ~100 ms of tunnel overhead each
+    (896 quanta = the 8.3 s config-5 wall, PERF.md round 5); this bounded
+    device-side while_loop amortizes it ~K per dispatch and EARLY-EXITS
+    back to the host exactly when a quantum raises host-visible work:
+    every tile done, a mailbox overflow, or a genuine deadlock (zero
+    progress with no tile beyond the boundary).  Quantum semantics are
+    identical to the per-quantum host loop: next boundary above the
+    laggard tile, empty quanta skipped via the prev_qend floor, and a
+    zero-progress quantum with a tile beyond the boundary jumps the
+    window up to it (`lax_barrier_sync_server.h:12-36`).
+
+    Returns (state, prev_qend, n_quanta, deadlock, n_iterations); the
+    host threads prev_qend into the next dispatch so boundary progression
+    is seamless across batches.
+    """
+    qps = int(quantum_ps)
+
+    def next_boundary(clock):
+        return (clock // qps + 1) * qps
+
+    def cond(carry):
+        st, _, n, deadlock, _ = carry
+        return (
+            ~jnp.all(st.done)
+            & ~st.net.overflow
+            & ~deadlock
+            & (n < max_quanta)
+        )
+
+    def body(carry):
+        st, prev, n, deadlock, iters = carry
+        clocks = st.core.clock_ps
+        min_pending = jnp.min(jnp.where(~st.done, clocks,
+                                        jnp.asarray(2**62, I64)))
+        qend = jnp.maximum(prev + qps, next_boundary(min_pending))
+        st2, progress, blk_iters = _quantum_loop(params, trace, st, qend)
+        zero = (progress == 0) & jnp.any(~st2.done)
+        ahead_clock = jnp.min(jnp.where(
+            ~st2.done & (st2.core.clock_ps >= qend),
+            st2.core.clock_ps, jnp.asarray(2**62, I64)))
+        have_ahead = ahead_clock < 2**62
+        # a tile crossed the boundary executing one long record: jump the
+        # window so the NEXT quantum's floor lands just below it
+        qend_next = jnp.where(zero & have_ahead,
+                              next_boundary(ahead_clock) - qps, qend)
+        deadlock = zero & ~have_ahead
+        return st2, qend_next, n + 1, deadlock, iters + blk_iters
+
+    state, prev_qend, n, deadlock, iters = lax.while_loop(
+        cond, body,
+        (state, jnp.asarray(prev_qend, I64), jnp.asarray(0, jnp.int32),
+         jnp.asarray(False), jnp.asarray(0, jnp.int64)))
+    return state, prev_qend, n, deadlock, iters
 
 
 def make_simulation_runner(params: EngineParams, trace: DeviceTrace,

@@ -589,15 +589,23 @@ def protocol_live(ms, *extra) -> jax.Array:
     return live
 
 
+# phase order of the private-L2 engine's skip vector (MemState.phase_skips)
+PHASE_NAMES = ("requester", "home_evict", "home_start", "sharer",
+               "home_finish", "requester_fill")
+
+
 def mem_idle_out(mp: MemParams, ms, rec: "RecView", enabled) -> MemStepOut:
     """The engine step's result when there is provably nothing to do —
     no lane's record carries memory slots and no protocol state is live
     (`ms.live`).  Lets the caller skip the whole engine under a lax.cond
     on compute-only iterations (the engine costs ~600 us/iteration in
-    small kernels; see PERF.md)."""
+    small kernels; see PERF.md).  A whole-engine skip counts as a skip of
+    every phase in the gate-observability vector."""
     present = slots_present(mp, rec, enabled)
     final_slot = next_present_slot(present, ms.req.slot)
     mem_complete = (ms.req.phase == PHASE_IDLE) & (final_slot >= 3)
+    if ms.phase_skips is not None:
+        ms = ms.replace(phase_skips=ms.phase_skips + 1)
     return MemStepOut(
         ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
         slot_lat_ps=ms.req.slot_lat_ps,
@@ -709,15 +717,161 @@ def dir_stage_flush(d):
         sn=jnp.zeros_like(d.sn))
 
 
+class _DirAcc:
+    """Deferred directory writes of one gated home phase.
+
+    Under per-phase gating (MemParams.phase_gate) the home phases run
+    inside a lax.cond that must not carry the big [T, DS, DW] entry /
+    [T, DS, DW*SW] sharers stores (a cond's branch outputs are
+    double-buffered — the round-2 pathology that disabled the
+    whole-engine gate above 1 GB).  `_dir_update` therefore accumulates
+    its writes here as compact block-local per-lane deltas — one int64
+    entry-word delta and (unstaged mode only) one [Tl, DW*SW] sharers
+    set-row delta — which the cond returns and `_dir_apply` scatters
+    outside it.  Staged sharers writes keep going through the small
+    (skey, sval) table inside the cond.
+
+    Invariants (hold by construction in the three home phases):
+     - every `_dir_update` call of one phase targets the SAME per-lane
+       (sets, way) pair (checked by object identity on the pre-px.lo
+       operands at trace time);
+     - the calls' masks are pairwise disjoint per lane, so summing
+       new-minus-cur deltas read against the unmodified pre-phase store
+       is exact.
+    """
+
+    def __init__(self):
+        self._ref = None
+        self.sets = None
+        self.way = None
+        self.entry_delta = None
+        self.sharers_delta = None
+
+    def _bind(self, ref, sets_l, way_l):
+        # ref is the (sets, way) operand pair itself — holding the
+        # objects pins their identity for the check's lifetime (a bare
+        # id() tuple could be recycled after gc)
+        if self._ref is None:
+            self._ref, self.sets, self.way = ref, sets_l, way_l
+        elif not (self._ref[0] is ref[0] and self._ref[1] is ref[1]):
+            raise AssertionError(
+                "_DirAcc: a gated home phase issued _dir_update calls "
+                "with different (sets, way) operands — the deferred "
+                "delta plan assumes one target entry per lane per phase")
+
+    def add_entry(self, ref, sets_l, way_l, delta):
+        self._bind(ref, sets_l, way_l)
+        self.entry_delta = (delta if self.entry_delta is None
+                            else self.entry_delta + delta)
+
+    def add_sharers(self, ref, sets_l, way_l, row_delta):
+        self._bind(ref, sets_l, way_l)
+        self.sharers_delta = (row_delta if self.sharers_delta is None
+                              else self.sharers_delta + row_delta)
+
+    def pack(self, d):
+        """The cond-carried plan: (sets, way, entry_delta[, sharers_row
+        _delta]) — all block-local [Tl(, DW*SW)] arrays, zeros when the
+        phase made no writes of that kind."""
+        Tl = d.entry.shape[0]
+        sets = (self.sets if self.sets is not None
+                else jnp.zeros(Tl, jnp.int32))
+        way = (self.way if self.way is not None
+               else jnp.zeros(Tl, jnp.int32))
+        ed = (self.entry_delta if self.entry_delta is not None
+              else jnp.zeros(Tl, I64))
+        if d.skey is not None:
+            return (sets, way, ed)
+        row_shape = (d.sharers.shape[0], d.sharers.shape[2])
+        shd = (self.sharers_delta if self.sharers_delta is not None
+               else jnp.zeros(row_shape, U32))
+        return (sets, way, ed, shd)
+
+    @staticmethod
+    def zero_pack(d):
+        Tl = d.entry.shape[0]
+        base = (jnp.zeros(Tl, jnp.int32), jnp.zeros(Tl, jnp.int32),
+                jnp.zeros(Tl, I64))
+        if d.skey is not None:
+            return base
+        return base + (jnp.zeros((Tl, d.sharers.shape[2]), U32),)
+
+
+def _dir_apply(d, pack):
+    """Scatter a gated home phase's deferred delta plan into the big
+    directory stores — OUTSIDE the phase's lax.cond, so the stores are
+    never cond outputs.  Zero deltas (masked-off lanes, skipped phases)
+    add nothing; indices are per-lane rows, so the adds alias in
+    place."""
+    sets, way, entry_delta = pack[:3]
+    T = d.entry.shape[0]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    d = d.replace(entry=d.entry.at[tiles, sets, way].add(
+        entry_delta, unique_indices=True, indices_are_sorted=True))
+    if len(pack) > 3:
+        d = d.replace(sharers=d.sharers.at[tiles, sets].add(
+            pack[3], unique_indices=True, indices_are_sorted=True))
+    return d
+
+
+def _cond_nodir(pred, fn, ms):
+    """Run a directory-free engine phase (requester start, sharer serve,
+    requester fill) under a scalar-predicate lax.cond.  The directory is
+    detached from the carried operands entirely — these phases neither
+    read nor write it — so the cond cannot double-buffer the big
+    stores."""
+    d0 = ms.directory
+
+    def run(m):
+        return fn(m)
+
+    def skip(m):
+        return m, jnp.zeros((), jnp.int32)
+
+    ms2, prog = jax.lax.cond(pred, run, skip, ms.replace(directory=None))
+    return ms2.replace(directory=d0), prog
+
+
+def _cond_dir(pred, fn, ms):
+    """Run a home-side phase (evictions / starts / acks+finish) under a
+    scalar-predicate lax.cond.  The phase reads the big directory stores
+    (cond inputs — no double-buffering) but writes them only through a
+    `_DirAcc` delta plan the cond returns; `_dir_apply` lands the plan
+    outside.  Staged sharers writes ride the small (skey, sval) table,
+    which IS carried.  `fn(ms, acc) -> (ms, progress)` must leave
+    ms.directory.entry/.sharers untouched (it defers via acc)."""
+    d0 = ms.directory
+
+    def detach(m):
+        return m.replace(directory=m.directory.replace(
+            entry=None, sharers=None))
+
+    def run(m):
+        acc = _DirAcc()
+        m2, prog = fn(m.replace(directory=d0), acc)
+        return detach(m2), prog, acc.pack(d0)
+
+    def skip(m):
+        return m, jnp.zeros((), jnp.int32), _DirAcc.zero_pack(d0)
+
+    ms2, prog, pack = jax.lax.cond(pred, run, skip, detach(ms))
+    d = ms2.directory.replace(entry=d0.entry, sharers=d0.sharers)
+    return ms2.replace(directory=_dir_apply(d, pack)), prog
+
+
 def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
-                dstate=None, owner=None, sharers=None, nsharers=None):
+                dstate=None, owner=None, sharers=None, nsharers=None,
+                acc: "_DirAcc | None" = None):
     """Masked per-lane write of one directory entry.
 
     Add-a-delta scatters (new = cur + (new - cur) under mask): per-lane
     indices are unique (row = lane), so the add is exact and the scatter
     can update the loop-carried buffers in place.  The operands arrive
     replicated full-width; a sharded px applies only this device's home
-    rows."""
+    rows.  With `acc` set (per-phase gating) the entry-word and unstaged
+    sharers deltas are accumulated instead of scattered — the caller's
+    lax.cond returns them and `_dir_apply` lands them outside it."""
+    ref = (sets, way)
     sets, way, mask = px.lo((sets, way, mask))
     T = d.entry.shape[0]
     tiles = jnp.arange(T, dtype=jnp.int32)
@@ -739,9 +893,12 @@ def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
     if nsharers is not None:
         new = _dir_set_field(new, px.lo(nsharers), DIR_NSH_SHIFT, _ID_MASK)
     if new is not cur:
-        out = out.replace(entry=out.entry.at[tiles, sets, way].add(
-            jnp.where(mask, new - cur, jnp.zeros_like(cur)),
-            unique_indices=True, indices_are_sorted=True))
+        delta = jnp.where(mask, new - cur, jnp.zeros_like(cur))
+        if acc is not None:
+            acc.add_entry(ref, sets, way, delta)
+        else:
+            out = out.replace(entry=out.entry.at[tiles, sets, way].add(
+                delta, unique_indices=True, indices_are_sorted=True))
     if sharers is not None:
         new_sh = px.lo(sharers)                       # [Tl, SW]
         if out.skey is not None:
@@ -760,9 +917,13 @@ def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
             onehot = (jnp.arange(DW, dtype=jnp.int32)[None, :, None]
                       == way[:, None, None]) & mask[:, None, None]
             new3 = jnp.where(onehot, new_sh[:, None, :], row3)
-            out = out.replace(sharers=out.sharers.at[tiles, sets].add(
-                (new3 - row3).reshape(row.shape),
-                unique_indices=True, indices_are_sorted=True))
+            row_delta = (new3 - row3).reshape(row.shape)
+            if acc is not None:
+                acc.add_sharers(ref, sets, way, row_delta)
+            else:
+                out = out.replace(sharers=out.sharers.at[tiles, sets].add(
+                    row_delta,
+                    unique_indices=True, indices_are_sorted=True))
     return out
 
 
@@ -1116,49 +1277,133 @@ def memory_engine_step(
                                slot_done_now & ~s_is_icache)
         return ms, progress
 
-    for _ in range(max(int(mp.requester_unroll), 1)):
-        ms, progress = _requester_once(ms, progress)
-
     # The phase ORDER is chosen so a miss resolves in ONE engine iteration
     # when no queued transaction is ahead of it: the request written by
-    # phase (1) above is popped by (3), whose INV/FLUSH/WB fan-out is
+    # phase (1) is popped by (3), whose INV/FLUSH/WB fan-out is
     # served by (4), whose acks finish the transaction in (5), whose reply
     # fills the requester in (6) — all mailbox hand-offs are visible
     # same-iteration because each phase reads the matrices its predecessor
     # just wrote.  Simulated time rides IN the messages, so this ordering
     # only compresses wall-clock iterations (the old order needed 2 per
     # fan-out miss); the timing algebra is unchanged.
+    #
+    # Per-phase activity gating (mp.phase_gate): each phase runs under its
+    # OWN scalar-predicate lax.cond, computed from replicated control
+    # state (mailboxes, txn, requester phase) at that point in the
+    # sequence — so a phase a predecessor just fed still fires
+    # same-iteration, and under shard_map every device takes the same
+    # branch with no new collectives.  A phase with its predicate false is
+    # a provable no-op (every write is masked by the very condition the
+    # predicate disjoins over), so gating is bit-exact; the conds carry
+    # only small per-phase state — see _cond_nodir/_cond_dir.
+
+    gate = bool(getattr(mp, "phase_gate", False))
+
+    def _phase_requester(ms):
+        prog = jnp.zeros((), jnp.int32)
+        for _ in range(max(int(mp.requester_unroll), 1)):
+            ms, prog = _requester_once(ms, prog)
+        return ms, prog
+
+    # ======================================================================
+    # (1) requester slot starts (app-thread L1/L2 path)
+    # ======================================================================
+    # a lane that cannot start at block entry cannot start mid-unroll
+    # either (only phase 6 returns a lane to PHASE_IDLE), so one
+    # predicate covers the whole unrolled block
+    pred1 = jnp.any(active & (ms.req.phase == PHASE_IDLE)
+                    & (next_present(ms.req.slot) < 3))
+    if gate:
+        ms, p = _cond_nodir(pred1, _phase_requester, ms)
+    else:
+        ms, p = _phase_requester(ms)
+    progress = progress + p
 
     # ======================================================================
     # (2) homes consume one EVICT per iteration
     # ======================================================================
-    ms, progress = _home_evictions(mp, ms, dir_access_ps, enabled, progress,
-                                   px)
+    pred2 = (ms.mail.evict_type != MSG_NONE).any()
+    if gate:
+        ms, p = _cond_dir(
+            pred2,
+            lambda m, a: _home_evictions(
+                mp, m, dir_access_ps, enabled, jnp.zeros((), jnp.int32),
+                px, acc=a),
+            ms)
+    else:
+        ms, p = _home_evictions(mp, ms, dir_access_ps, enabled,
+                                jnp.zeros((), jnp.int32), px)
+    progress = progress + p
 
     # ======================================================================
     # (3) homes start transactions (pop request / resume saved)
     # ======================================================================
-    ms, progress = _home_starts(mp, ms, dram_lat_ps, dir_access_ps,
-                                sync_dir_l2, sync_dir_net, enabled, progress,
-                                px)
+    pred3 = ((ms.mail.req_type != MSG_NONE).any()
+             | (ms.txn.saved_valid & ~ms.txn.active).any())
+    if gate:
+        ms, p = _cond_dir(
+            pred3,
+            lambda m, a: _home_starts(
+                mp, m, dram_lat_ps, dir_access_ps, sync_dir_l2,
+                sync_dir_net, enabled, jnp.zeros((), jnp.int32), px,
+                acc=a),
+            ms)
+    else:
+        ms, p = _home_starts(mp, ms, dram_lat_ps, dir_access_ps,
+                             sync_dir_l2, sync_dir_net, enabled,
+                             jnp.zeros((), jnp.int32), px)
+    progress = progress + p
 
     # ======================================================================
     # (4) sharers consume one FWD per iteration
     # ======================================================================
-    ms, progress = _sharer_step(mp, ms, fmhz, enabled, progress,
-                                sync_l2_net, sync_l1d_l2, px)
+    pred4 = (ms.mail.fwd_type != MSG_NONE).any()
+    if gate:
+        ms, p = _cond_nodir(
+            pred4,
+            lambda m: _sharer_step(mp, m, fmhz, enabled,
+                                   jnp.zeros((), jnp.int32),
+                                   sync_l2_net, sync_l1d_l2, px),
+            ms)
+    else:
+        ms, p = _sharer_step(mp, ms, fmhz, enabled,
+                             jnp.zeros((), jnp.int32),
+                             sync_l2_net, sync_l1d_l2, px)
+    progress = progress + p
 
     # ======================================================================
     # (5) homes consume ACKs, finish transactions
     # ======================================================================
-    ms, progress = _home_acks_and_finish(mp, ms, dram_lat_ps, dir_access_ps,
-                                         enabled, progress, px)
+    pred5 = (ms.mail.ack_type != MSG_NONE).any() | ms.txn.active.any()
+    if gate:
+        ms, p = _cond_dir(
+            pred5,
+            lambda m, a: _home_acks_and_finish(
+                mp, m, dram_lat_ps, dir_access_ps, enabled,
+                jnp.zeros((), jnp.int32), px, acc=a),
+            ms)
+    else:
+        ms, p = _home_acks_and_finish(mp, ms, dram_lat_ps, dir_access_ps,
+                                      enabled, jnp.zeros((), jnp.int32),
+                                      px)
+    progress = progress + p
 
     # ======================================================================
     # (6) requesters consume replies (fill L2+L1, complete slot)
     # ======================================================================
-    ms, progress = _requester_fill(mp, ms, rec, clock_ps, fmhz, enabled,
-                                   progress, sync_l2_net, px)
+    pred6 = ((ms.req.phase == PHASE_WAIT_REPLY)
+             & (ms.mail.rep_type != MSG_NONE)).any()
+    if gate:
+        ms, p = _cond_nodir(
+            pred6,
+            lambda m: _requester_fill(mp, m, rec, clock_ps, fmhz, enabled,
+                                      jnp.zeros((), jnp.int32),
+                                      sync_l2_net, px),
+            ms)
+    else:
+        ms, p = _requester_fill(mp, ms, rec, clock_ps, fmhz, enabled,
+                                jnp.zeros((), jnp.int32), sync_l2_net, px)
+    progress = progress + p
 
     # ---- completion signal ----------------------------------------------
     final_slot = next_present(ms.req.slot)
@@ -1166,6 +1411,10 @@ def memory_engine_step(
     # protocol-liveness flag: lets the caller skip the whole engine on
     # iterations with no memory work (see mem_idle_out)
     ms = ms.replace(live=protocol_live(ms))
+    if gate:
+        skipped = 1 - jnp.stack(
+            [pred1, pred2, pred3, pred4, pred5, pred6]).astype(I64)
+        ms = ms.replace(phase_skips=ms.phase_skips + skipped)
     return MemStepOut(
         ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
         slot_lat_ps=ms.req.slot_lat_ps,
@@ -1335,7 +1584,7 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
 
 
 def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress,
-                    px: ParallelCtx = IDENT):
+                    px: ParallelCtx = IDENT, acc: "_DirAcc | None" = None):
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     mail = ms.mail
@@ -1367,7 +1616,8 @@ def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress,
         dstate,
     ).astype(jnp.uint8)
     d = _dir_update(d, sets, way, apply, px=px, dstate=new_dstate,
-                    owner=new_owner, sharers=new_sharers, nsharers=new_nsh)
+                    owner=new_owner, sharers=new_sharers, nsharers=new_nsh,
+                    acc=acc)
 
     # active same-line transaction: treat the eviction as the ack
     txn = ms.txn
@@ -1404,7 +1654,8 @@ def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress,
 
 
 def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
-                          enabled, progress, px: ParallelCtx = IDENT):
+                          enabled, progress, px: ParallelCtx = IDENT,
+                          acc: "_DirAcc | None" = None):
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     mail = ms.mail
@@ -1482,7 +1733,8 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         owner=jnp.where(exf, r, sh_owner),
         sharers=jnp.where(exf[:, None], rbit_words,
                           set_bit(cur_sharers, r, shf)),
-        nsharers=jnp.where(exf, 1, cur_nsh + (~had).astype(jnp.int32)))
+        nsharers=jnp.where(exf, 1, cur_nsh + (~had).astype(jnp.int32)),
+        acc=acc)
     # NULLIFY finish: the entry was already replaced at allocation; nothing
     # directory-side remains (`processNullifyReq` UNCACHED branch)
 
@@ -1541,7 +1793,7 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
 
 def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
                  sync_dir_l2, sync_dir_net, enabled, progress,
-                 px: ParallelCtx = IDENT):
+                 px: ParallelCtx = IDENT, acc: "_DirAcc | None" = None):
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     mail = ms.mail
@@ -1687,7 +1939,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     # when dfound).
     upd = is_new | imm
     d = _dir_update(
-        d, sets, alloc_way, upd, px=px,
+        d, sets, alloc_way, upd, px=px, acc=acc,
         tags=jnp.where(is_new, rline, v_line),
         dstate=jnp.where(
             imm, jnp.where(imm_ex, DIR_MODIFIED, DIR_SHARED),
@@ -1765,7 +2017,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         # drop the victim from the entry now — its INV/FLUSH ack is consumed
         # by this transaction, not the eviction path (one txn per home)
         d = _dir_update(
-            d, sets, alloc_way, sh_over, px=px,
+            d, sets, alloc_way, sh_over, px=px, acc=acc,
             sharers=v_sharers & ~victim_bits,
             nsharers=v_nsh - 1,
             owner=jnp.where(victim_is_owner, -1, v_owner),
@@ -1783,7 +2035,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         fwd_msg = jnp.where(sh_over_m, MSG_FLUSH_REQ, fwd_msg).astype(
             jnp.uint8)
         d = _dir_update(
-            d, sets, alloc_way, sh_over_m, px=px,
+            d, sets, alloc_way, sh_over_m, px=px, acc=acc,
             sharers=jnp.zeros((T, mp.sharer_words), U32),
             nsharers=jnp.zeros(T, jnp.int32),
             owner=jnp.full(T, -1, jnp.int32),

@@ -72,6 +72,10 @@ I64 = jnp.int64
 U32 = jnp.uint32
 FAR = 2**62
 
+# phase order of the shared-L2 engine's skip vector (ShL2State.phase_skips)
+SHL2_PHASE_NAMES = ("requester", "sharer", "home_evict", "home_finish",
+                    "home_start", "requester_fill")
+
 # L2 slice data state (`cache_line_info.h` ShL2CacheLineInfo): the line is
 # allocated (directory live) but its data is still in flight from DRAM
 DATA_INVALID = 5
@@ -162,18 +166,93 @@ def _rowsh_update(dsh, way, mask, new_sh):
         dsh.shape[0], W2SW)
 
 
-def _dir_scatter(d: ShL2Dir, px: ParallelCtx, sets, dw0, dw, dsh0, dsh):
-    """Apply the phase's accumulated full-width row updates block-locally:
+def _dir_apply_rows(d: ShL2Dir, px: ParallelCtx, sets, dwd, dshd):
+    """Scatter full-width embedded-directory ROW deltas block-locally:
     ONE add-a-delta scatter per array (per-lane rows unique, aliases in
-    place)."""
-    sets_l, dw0_l, dw_l, dsh0_l, dsh_l = px.lo((sets, dw0, dw, dsh0, dsh))
+    place).  Zero deltas — masked-off lanes, gated-off phases — add
+    nothing."""
+    sets_l, dwd_l, dshd_l = px.lo((sets, dwd, dshd))
     Tl = d.word.shape[0]
     lt = jnp.arange(Tl, dtype=jnp.int32)
     return d.replace(
         word=d.word.at[lt, sets_l].add(
-            dw_l - dw0_l, unique_indices=True, indices_are_sorted=True),
+            dwd_l, unique_indices=True, indices_are_sorted=True),
         sharers=d.sharers.at[lt, sets_l].add(
-            dsh_l - dsh0_l, unique_indices=True, indices_are_sorted=True))
+            dshd_l, unique_indices=True, indices_are_sorted=True))
+
+
+def _dir_scatter(d: ShL2Dir, px: ParallelCtx, sets, dw0, dw, dsh0, dsh,
+                 acc: "_RowAcc | None" = None):
+    """Apply the phase's accumulated full-width row updates — directly
+    (ungated path) or deferred into `acc` so a gated phase's lax.cond
+    returns the compact [T, W2(*SW)] row deltas instead of carrying the
+    big stores (see shl2_engine_step's per-phase gating)."""
+    if acc is not None:
+        acc.add(sets, dw - dw0, dsh - dsh0)
+        return d
+    return _dir_apply_rows(d, px, sets, dw - dw0, dsh - dsh0)
+
+
+class _RowAcc:
+    """Deferred embedded-directory row deltas of one gated home phase
+    (the shared-L2 analog of engine._DirAcc — the shl2 phases already
+    compute row-form deltas, so the plan is just (sets, Δword rows,
+    Δsharers rows), full-width replicated like the rows themselves)."""
+
+    def __init__(self):
+        self.plan = None
+
+    def add(self, sets, dwd, dshd):
+        if self.plan is not None:
+            raise AssertionError(
+                "_RowAcc: one _dir_scatter per gated shl2 phase")
+        self.plan = (sets, dwd, dshd)
+
+    def pack(self, d, n_tiles):
+        if self.plan is not None:
+            return self.plan
+        return _RowAcc.zero_pack(d, n_tiles)
+
+    @staticmethod
+    def zero_pack(d, n_tiles):
+        return (jnp.zeros(n_tiles, jnp.int32),
+                jnp.zeros((n_tiles, d.word.shape[2]), I64),
+                jnp.zeros((n_tiles, d.sharers.shape[2]), U32))
+
+
+def _cond_nodir(pred, fn, ms):
+    """Run a directory-free shl2 phase under a scalar-predicate lax.cond
+    with the embedded directory detached from the carried operands."""
+    d0 = ms.dir
+
+    def run(m):
+        return fn(m)
+
+    def skip(m):
+        return m, jnp.zeros((), jnp.int32)
+
+    ms2, prog = jax.lax.cond(pred, run, skip, ms.replace(dir=None))
+    return ms2.replace(dir=d0), prog
+
+
+def _cond_dir(pred, fn, ms, n_tiles, px):
+    """Run a home-side shl2 phase under a scalar-predicate lax.cond: the
+    embedded directory is read inside (cond input, no double-buffering)
+    but written only through the `_RowAcc` delta plan the cond returns;
+    `_dir_apply_rows` lands the plan outside.  `fn(ms, acc) ->
+    (ms, progress)` must leave ms.dir untouched."""
+    d0 = ms.dir
+
+    def run(m):
+        acc = _RowAcc()
+        m2, prog = fn(m.replace(dir=d0), acc)
+        return m2.replace(dir=None), prog, acc.pack(d0, n_tiles)
+
+    def skip(m):
+        return (m, jnp.zeros((), jnp.int32), _RowAcc.zero_pack(d0, n_tiles))
+
+    ms2, prog, plan = jax.lax.cond(pred, run, skip, ms.replace(dir=None))
+    return ms2.replace(dir=_dir_apply_rows(d0, px, *plan)), prog
 
 
 @struct.dataclass
@@ -212,6 +291,9 @@ class ShL2State:
     # bool[] — any protocol state outstanding; False lets the step skip
     # the engine entirely (see engine.mem_idle_out)
     live: jax.Array
+    # int64[6] — per-phase lax.cond skip counts under phase gating
+    # (SHL2_PHASE_NAMES order; see MemState.phase_skips)
+    phase_skips: jax.Array = None
     # MEMORY-NoC port-queue state when memory = emesh_hop_by_hop (see
     # engine.mem_net_send); None otherwise
     noc: "object" = None
@@ -305,149 +387,215 @@ def shl2_engine_step(
     def next_present(slot):
         return next_present_slot(present, slot)
 
-    slot = next_present(ms.req.slot)
-    has_slot = slot < 3
-    idle = ms.req.phase == PHASE_IDLE
-    starting = active & idle & has_slot
+    def _phase_requester(ms):
+        slot = next_present(ms.req.slot)
+        has_slot = slot < 3
+        idle = ms.req.phase == PHASE_IDLE
+        starting = active & idle & has_slot
 
-    s_is_icache = slot == 0
-    s_addr = jnp.where(
-        s_is_icache, rec.pc.astype(jnp.int32),
-        jnp.where(slot == 1, rec.addr0.astype(jnp.int32),
-                  rec.addr1.astype(jnp.int32)))
-    s_line = (s_addr.astype(jnp.uint32) >> mp.line_bits).astype(jnp.int32)
-    s_write = jnp.where(
-        s_is_icache, False,
-        jnp.where(slot == 1, (flags & FLAG_MEM0_WRITE) != 0,
-                  (flags & FLAG_MEM1_WRITE) != 0))
+        s_is_icache = slot == 0
+        s_addr = jnp.where(
+            s_is_icache, rec.pc.astype(jnp.int32),
+            jnp.where(slot == 1, rec.addr0.astype(jnp.int32),
+                      rec.addr1.astype(jnp.int32)))
+        s_line = (s_addr.astype(jnp.uint32) >> mp.line_bits).astype(jnp.int32)
+        s_write = jnp.where(
+            s_is_icache, False,
+            jnp.where(slot == 1, (flags & FLAG_MEM0_WRITE) != 0,
+                      (flags & FLAG_MEM1_WRITE) != 0))
 
-    ibuf_hit = starting & s_is_icache & (s_line == ms.req.instr_buf)
-    new_instr_buf = jnp.where(starting & s_is_icache, s_line,
-                              ms.req.instr_buf)
+        ibuf_hit = starting & s_is_icache & (s_line == ms.req.instr_buf)
+        new_instr_buf = jnp.where(starting & s_is_icache, s_line,
+                                  ms.req.instr_buf)
 
-    # L1 rows: block-local gathers, ONE exchange, full-width row ops
-    s_line_l = px.lo(s_line)
-    rows_l = (
-        ca.gather_row(ms.l1i, s_line_l, px.lo_const(mp.l1i.sets_mod)),
-        ca.gather_row(ms.l1d, s_line_l, px.lo_const(mp.l1d.sets_mod)),
-    )
-    (l1i_row, l1d_row), _ = _rows_exchange(px, rows_l)
-    l1i_hit, l1i_way, l1i_state = ca.row_lookup(l1i_row, s_line)
-    l1d_hit, l1d_way, l1d_state = ca.row_lookup(l1d_row, s_line)
-    l1_state = jnp.where(s_is_icache, l1i_state, l1d_state)
-    l1_permit = jnp.where(s_write, state_writable(l1_state),
-                          state_readable(l1_state))
-    do_l1 = starting & ~ibuf_hit
-    l1_hit_now = do_l1 & l1_permit
-    l1_miss = do_l1 & ~l1_permit
+        # L1 rows: block-local gathers, ONE exchange, full-width row ops
+        s_line_l = px.lo(s_line)
+        rows_l = (
+            ca.gather_row(ms.l1i, s_line_l, px.lo_const(mp.l1i.sets_mod)),
+            ca.gather_row(ms.l1d, s_line_l, px.lo_const(mp.l1d.sets_mod)),
+        )
+        (l1i_row, l1d_row), _ = _rows_exchange(px, rows_l)
+        l1i_hit, l1i_way, l1i_state = ca.row_lookup(l1i_row, s_line)
+        l1d_hit, l1d_way, l1d_state = ca.row_lookup(l1d_row, s_line)
+        l1_state = jnp.where(s_is_icache, l1i_state, l1d_state)
+        l1_permit = jnp.where(s_write, state_writable(l1_state),
+                              state_readable(l1_state))
+        do_l1 = starting & ~ibuf_hit
+        l1_hit_now = do_l1 & l1_permit
+        l1_miss = do_l1 & ~l1_permit
 
-    l1_dat = jnp.where(s_is_icache, ccyc(mp.l1i.data_and_tags_cycles),
-                       ccyc(mp.l1d.data_and_tags_cycles))
-    l1_tag = jnp.where(s_is_icache, ccyc(mp.l1i.tags_cycles),
-                       ccyc(mp.l1d.tags_cycles))
-    sclock = clock_ps + sync_core_l1
-    l1_hit_done_ps = sclock + l1_dat
+        l1_dat = jnp.where(s_is_icache, ccyc(mp.l1i.data_and_tags_cycles),
+                           ccyc(mp.l1d.data_and_tags_cycles))
+        l1_tag = jnp.where(s_is_icache, ccyc(mp.l1i.tags_cycles),
+                           ccyc(mp.l1d.tags_cycles))
+        sclock = clock_ps + sync_core_l1
+        l1_hit_done_ps = sclock + l1_dat
 
-    # MESI silent upgrade: a write to an EXCLUSIVE L1 line promotes to M
-    # with no messages (the write-hit path: E is writable)
-    promote = l1_hit_now & s_write & (l1_state == EXCLUSIVE)
-    l1d_row = ca.row_set_state(l1d_row, l1d_way, MODIFIED,
-                               promote & ~s_is_icache)
-    # hits refresh recency under LRU; round_robin's update is a no-op
-    if mp.l1i.replacement != "round_robin":
-        l1i_row = ca.row_touch(l1i_row, l1i_way, l1_hit_now & s_is_icache)
-    if mp.l1d.replacement != "round_robin":
-        l1d_row = ca.row_touch(l1d_row, l1d_way, l1_hit_now & ~s_is_icache)
-    l1i_upd = ca.scatter_row(ms.l1i, px.lo(l1i_row))
-    l1d_upd = ca.scatter_row(ms.l1d, px.lo(l1d_row))
+        # MESI silent upgrade: a write to an EXCLUSIVE L1 line promotes to M
+        # with no messages (the write-hit path: E is writable)
+        promote = l1_hit_now & s_write & (l1_state == EXCLUSIVE)
+        l1d_row = ca.row_set_state(l1d_row, l1d_way, MODIFIED,
+                                   promote & ~s_is_icache)
+        # hits refresh recency under LRU; round_robin's update is a no-op
+        if mp.l1i.replacement != "round_robin":
+            l1i_row = ca.row_touch(l1i_row, l1i_way, l1_hit_now & s_is_icache)
+        if mp.l1d.replacement != "round_robin":
+            l1d_row = ca.row_touch(l1d_row, l1d_way, l1_hit_now & ~s_is_icache)
+        l1i_upd = ca.scatter_row(ms.l1i, px.lo(l1i_row))
+        l1d_upd = ca.scatter_row(ms.l1d, px.lo(l1d_row))
 
-    # L1 miss: an upgrade (write to readable-but-unwritable line) keeps the
-    # line until the reply; a plain miss sends the request right away.  In
-    # both cases the L1 stays untouched here — the FILL path replaces it.
-    s_home = _l2_home(mp, s_line)
-    rq_type = jnp.where(s_write, MSG_EX_REQ, MSG_SH_REQ).astype(jnp.uint8)
-    req_send_ps = sclock + l1_tag + sync_l1_net
-    noc, rq_arrival = mem_net_send(
-        mp, ms.noc, tiles, s_home, mp.req_bits, req_send_ps, l1_miss,
-        enabled)
-    mail = ms.mail
-    rq_home = jnp.where(l1_miss, s_home, 0)
-    mail = mail.replace(
-        req_type=mail.req_type.at[rq_home, tiles].set(
-            jnp.where(l1_miss, rq_type, mail.req_type[rq_home, tiles])),
-        req_line=mail.req_line.at[rq_home, tiles].set(
-            jnp.where(l1_miss, s_line, mail.req_line[rq_home, tiles])),
-        req_time=mail.req_time.at[rq_home, tiles].set(
-            jnp.where(l1_miss, rq_arrival, mail.req_time[rq_home, tiles])),
-    )
+        # L1 miss: an upgrade (write to readable-but-unwritable line) keeps the
+        # line until the reply; a plain miss sends the request right away.  In
+        # both cases the L1 stays untouched here — the FILL path replaces it.
+        s_home = _l2_home(mp, s_line)
+        rq_type = jnp.where(s_write, MSG_EX_REQ, MSG_SH_REQ).astype(jnp.uint8)
+        req_send_ps = sclock + l1_tag + sync_l1_net
+        noc, rq_arrival = mem_net_send(
+            mp, ms.noc, tiles, s_home, mp.req_bits, req_send_ps, l1_miss,
+            enabled)
+        mail = ms.mail
+        rq_home = jnp.where(l1_miss, s_home, 0)
+        mail = mail.replace(
+            req_type=mail.req_type.at[rq_home, tiles].set(
+                jnp.where(l1_miss, rq_type, mail.req_type[rq_home, tiles])),
+            req_line=mail.req_line.at[rq_home, tiles].set(
+                jnp.where(l1_miss, s_line, mail.req_line[rq_home, tiles])),
+            req_time=mail.req_time.at[rq_home, tiles].set(
+                jnp.where(l1_miss, rq_arrival, mail.req_time[rq_home, tiles])),
+        )
 
-    slot_done_now = ibuf_hit | l1_hit_now
-    slot_done_ps = jnp.where(ibuf_hit, clock_ps + ccyc(1), l1_hit_done_ps)
-    req_state = ms.req.replace(
-        phase=jnp.where(l1_miss, PHASE_WAIT_REPLY, ms.req.phase),
-        line=jnp.where(l1_miss, s_line, ms.req.line),
-        is_write=jnp.where(l1_miss, s_write, ms.req.is_write),
-        component=jnp.where(
-            l1_miss, jnp.where(s_is_icache, MOD_L1I, MOD_L1D),
-            ms.req.component).astype(jnp.uint8),
-        clock_ps=jnp.where(l1_miss, req_send_ps, ms.req.clock_ps),
-        acc_ps=ms.req.acc_ps
-        + jnp.where(slot_done_now, slot_done_ps - clock_ps, 0),
-        slot_lat_ps=jnp.where(
-            (slot_done_now[:, None]
-             & (jnp.arange(3)[None, :] == slot[:, None])),
-            (slot_done_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
-        instr_buf=new_instr_buf,
-        slot=jnp.where(slot_done_now, slot + 1,
-                       jnp.where(starting, slot, ms.req.slot)),
-    )
-    counters = ms.counters.replace(
-        l1i_hits=ms.counters.l1i_hits
-        + ((l1_hit_now | ibuf_hit) & s_is_icache & enabled).astype(I64),
-        l1i_misses=ms.counters.l1i_misses
-        + (l1_miss & s_is_icache & enabled).astype(I64),
-        l1d_read_hits=ms.counters.l1d_read_hits
-        + (l1_hit_now & ~s_is_icache & ~s_write & enabled).astype(I64),
-        l1d_read_misses=ms.counters.l1d_read_misses
-        + (l1_miss & ~s_is_icache & ~s_write & enabled).astype(I64),
-        l1d_write_hits=ms.counters.l1d_write_hits
-        + (l1_hit_now & ~s_is_icache & s_write & enabled).astype(I64),
-        l1d_write_misses=ms.counters.l1d_write_misses
-        + (l1_miss & ~s_is_icache & s_write & enabled).astype(I64),
-    )
-    progress = progress + jnp.sum(slot_done_now | l1_miss, dtype=jnp.int32)
-    ms = ms.replace(l1i=l1i_upd, l1d=l1d_upd, mail=mail, req=req_state,
-                    counters=counters, noc=noc)
-    ms = _apply_functional(mp, ms, rec, slot, s_addr, s_write, slot_done_now)
+        slot_done_now = ibuf_hit | l1_hit_now
+        slot_done_ps = jnp.where(ibuf_hit, clock_ps + ccyc(1), l1_hit_done_ps)
+        req_state = ms.req.replace(
+            phase=jnp.where(l1_miss, PHASE_WAIT_REPLY, ms.req.phase),
+            line=jnp.where(l1_miss, s_line, ms.req.line),
+            is_write=jnp.where(l1_miss, s_write, ms.req.is_write),
+            component=jnp.where(
+                l1_miss, jnp.where(s_is_icache, MOD_L1I, MOD_L1D),
+                ms.req.component).astype(jnp.uint8),
+            clock_ps=jnp.where(l1_miss, req_send_ps, ms.req.clock_ps),
+            acc_ps=ms.req.acc_ps
+            + jnp.where(slot_done_now, slot_done_ps - clock_ps, 0),
+            slot_lat_ps=jnp.where(
+                (slot_done_now[:, None]
+                 & (jnp.arange(3)[None, :] == slot[:, None])),
+                (slot_done_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
+            instr_buf=new_instr_buf,
+            slot=jnp.where(slot_done_now, slot + 1,
+                           jnp.where(starting, slot, ms.req.slot)),
+        )
+        counters = ms.counters.replace(
+            l1i_hits=ms.counters.l1i_hits
+            + ((l1_hit_now | ibuf_hit) & s_is_icache & enabled).astype(I64),
+            l1i_misses=ms.counters.l1i_misses
+            + (l1_miss & s_is_icache & enabled).astype(I64),
+            l1d_read_hits=ms.counters.l1d_read_hits
+            + (l1_hit_now & ~s_is_icache & ~s_write & enabled).astype(I64),
+            l1d_read_misses=ms.counters.l1d_read_misses
+            + (l1_miss & ~s_is_icache & ~s_write & enabled).astype(I64),
+            l1d_write_hits=ms.counters.l1d_write_hits
+            + (l1_hit_now & ~s_is_icache & s_write & enabled).astype(I64),
+            l1d_write_misses=ms.counters.l1d_write_misses
+            + (l1_miss & ~s_is_icache & s_write & enabled).astype(I64),
+        )
+        prog = jnp.sum(slot_done_now | l1_miss, dtype=jnp.int32)
+        ms = ms.replace(l1i=l1i_upd, l1d=l1d_upd, mail=mail, req=req_state,
+                        counters=counters, noc=noc)
+        ms = _apply_functional(mp, ms, rec, slot, s_addr, s_write, slot_done_now)
+        return ms, prog
+
+    gate = bool(getattr(mp, "phase_gate", False))
+    # a lane that cannot start now cannot start later this iteration
+    # (only the fill phase returns a lane to PHASE_IDLE)
+    pred1 = jnp.any(active & (ms.req.phase == PHASE_IDLE)
+                    & (next_present(ms.req.slot) < 3))
+    if gate:
+        ms, p = _cond_nodir(pred1, _phase_requester, ms)
+    else:
+        ms, p = _phase_requester(ms)
+    progress = progress + p
 
     # ======================================================================
     # (2) L1 sharers serve INV/FLUSH/WB from homes
     # ======================================================================
-    ms, progress = _sharer_step(mp, ms, fmhz, enabled, progress,
-                                sync_l1_net, px)
+    pred2 = (ms.mail.fwd_type != MSG_NONE).any()
+    if gate:
+        ms, p = _cond_nodir(
+            pred2,
+            lambda m: _sharer_step(mp, m, fmhz, enabled,
+                                   jnp.zeros((), jnp.int32),
+                                   sync_l1_net, px),
+            ms)
+    else:
+        ms, p = _sharer_step(mp, ms, fmhz, enabled,
+                             jnp.zeros((), jnp.int32), sync_l1_net, px)
+    progress = progress + p
 
     # ======================================================================
     # (3) homes consume L1 evictions (directory + L2 dirty fill)
     # ======================================================================
-    ms, progress = _home_evictions(mp, ms, l2_access, enabled, progress, px)
+    pred3 = (ms.mail.evict_type != MSG_NONE).any()
+    if gate:
+        ms, p = _cond_dir(
+            pred3,
+            lambda m, a: _home_evictions(mp, m, l2_access, enabled,
+                                         jnp.zeros((), jnp.int32), px,
+                                         acc=a),
+            ms, T, px)
+    else:
+        ms, p = _home_evictions(mp, ms, l2_access, enabled,
+                                jnp.zeros((), jnp.int32), px)
+    progress = progress + p
 
     # ======================================================================
     # (4) homes consume acks / dram arrivals, finish transactions
     # ======================================================================
-    ms, progress = _home_finish(mp, ms, l2_access, sync_l2_net, enabled,
-                                progress, mesi, px)
+    pred4 = (ms.mail.ack_type != MSG_NONE).any() | ms.txn.active.any()
+    if gate:
+        ms, p = _cond_dir(
+            pred4,
+            lambda m, a: _home_finish(mp, m, l2_access, sync_l2_net,
+                                      enabled, jnp.zeros((), jnp.int32),
+                                      mesi, px, acc=a),
+            ms, T, px)
+    else:
+        ms, p = _home_finish(mp, ms, l2_access, sync_l2_net, enabled,
+                             jnp.zeros((), jnp.int32), mesi, px)
+    progress = progress + p
 
     # ======================================================================
     # (5) homes start transactions
     # ======================================================================
-    ms, progress = _home_starts(mp, ms, l2_access, sync_l2_net, enabled,
-                                progress, mesi, px)
+    pred5 = ((ms.mail.req_type != MSG_NONE).any()
+             | (ms.txn.saved_valid & ~ms.txn.active).any())
+    if gate:
+        ms, p = _cond_dir(
+            pred5,
+            lambda m, a: _home_starts(mp, m, l2_access, sync_l2_net,
+                                      enabled, jnp.zeros((), jnp.int32),
+                                      mesi, px, acc=a),
+            ms, T, px)
+    else:
+        ms, p = _home_starts(mp, ms, l2_access, sync_l2_net, enabled,
+                             jnp.zeros((), jnp.int32), mesi, px)
+    progress = progress + p
 
     # ======================================================================
     # (6) requesters consume replies (fill L1)
     # ======================================================================
-    ms, progress = _requester_fill(mp, ms, rec, clock_ps, fmhz, enabled,
-                                   progress, sync_l1_net, px)
+    pred6 = ((ms.req.phase == PHASE_WAIT_REPLY)
+             & (ms.mail.rep_type != MSG_NONE)).any()
+    if gate:
+        ms, p = _cond_nodir(
+            pred6,
+            lambda m: _requester_fill(mp, m, rec, clock_ps, fmhz, enabled,
+                                      jnp.zeros((), jnp.int32),
+                                      sync_l1_net, px),
+            ms)
+    else:
+        ms, p = _requester_fill(mp, ms, rec, clock_ps, fmhz, enabled,
+                                jnp.zeros((), jnp.int32), sync_l1_net, px)
+    progress = progress + p
 
     final_slot = next_present(ms.req.slot)
     mem_complete = (ms.req.phase == PHASE_IDLE) & (final_slot >= 3)
@@ -457,6 +605,10 @@ def shl2_engine_step(
 
     ms = ms.replace(live=protocol_live(
         ms, (ms.txn.dram_ready_ps < FAR).any()))
+    if gate:
+        skipped = 1 - jnp.stack(
+            [pred1, pred2, pred3, pred4, pred5, pred6]).astype(I64)
+        ms = ms.replace(phase_skips=ms.phase_skips + skipped)
     return MemStepOut(
         ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
         slot_lat_ps=ms.req.slot_lat_ps, progress=progress,
@@ -559,7 +711,7 @@ def _sharer_step(mp, ms: ShL2State, fmhz, enabled, progress, sync_l1_net,
 
 
 def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress,
-                    px: ParallelCtx = IDENT):
+                    px: ParallelCtx = IDENT, acc: "_RowAcc | None" = None):
     """L1 eviction notices update the embedded directory; dirty flushes
     land in the L2 slice (its line turns MODIFIED wrt DRAM)."""
     T = mp.n_tiles
@@ -596,7 +748,7 @@ def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress,
     dw = _row_update(dw, l2_way, apply, dstate=new_dstate, owner=new_owner,
                      nsharers=new_nsh)
     dsh = _rowsh_update(dsh, l2_way, apply, new_sharers)
-    d = _dir_scatter(ms.dir, px, sets, dw0, dw, dsh0, dsh)
+    d = _dir_scatter(ms.dir, px, sets, dw0, dw, dsh0, dsh, acc=acc)
     # dirty flush data lands in the slice
     l2row = ca.row_set_state(l2row, l2_way, MODIFIED, apply & is_flush)
     l2 = ca.scatter_row(ms.l2, px.lo(l2row))
@@ -623,7 +775,8 @@ def _home_evictions(mp, ms: ShL2State, l2_access, enabled, progress,
 
 
 def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
-                 progress, mesi, px: ParallelCtx = IDENT):
+                 progress, mesi, px: ParallelCtx = IDENT,
+                 acc: "_RowAcc | None" = None):
     """Consume acks + DRAM arrivals; finish when nothing is pending."""
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
@@ -719,7 +872,7 @@ def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
     dsh = _rowsh_update(dsh, l2_way, nlf,
                         jnp.zeros((T, mp.sharer_words), U32))
     l2 = ca.scatter_row(ms.l2, px.lo(l2row))
-    d = _dir_scatter(ms.dir, px, sets, dw0, dw, dsh0, dsh)
+    d = _dir_scatter(ms.dir, px, sets, dw0, dw, dsh0, dsh, acc=acc)
 
     # reply to the requester (the slice access was charged at txn start)
     rep_ready = txn.time_ps + sync_l2_net
@@ -754,7 +907,8 @@ def _home_finish(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
 
 
 def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
-                 progress, mesi, px: ParallelCtx = IDENT):
+                 progress, mesi, px: ParallelCtx = IDENT,
+                 acc: "_RowAcc | None" = None):
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
     mail = ms.mail
@@ -906,7 +1060,7 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
                          mp.dir_freq_mhz),
             0)
     l2 = ca.scatter_row(ms.l2, px.lo(l2row))
-    d = _dir_scatter(ms.dir, px, sets, dw0, dw, dsh0, dsh)
+    d = _dir_scatter(ms.dir, px, sets, dw0, dw, dsh0, dsh, acc=acc)
 
     activate = fan | data_missing | served | nullify_live
     txn = txn.replace(

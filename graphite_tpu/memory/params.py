@@ -261,6 +261,19 @@ class MemParams:
     # writes_per_iter * T * inner_block (overflow-impossible) and
     # auto-enables on big directories; single-device programs only.
     dir_stage_cap: int = 0
+    # Per-phase activity gating (round 6): each protocol phase runs under
+    # its OWN scalar-predicate lax.cond whose carried operands are only
+    # the small per-phase state — the big directory/sharers stores are
+    # read through the existing views and written outside the conds
+    # (home phases return compact per-lane delta plans; see
+    # engine._cond_dir), so the conds never double-buffer them and
+    # gating survives at the >= 1 GB scale where the whole-engine
+    # mem_gate must stay off.  Predicates are pure functions of
+    # replicated control state (mailboxes, txn, requester phase), so the
+    # sharded program takes identical branches on every device with no
+    # new collectives.  Simulator enables this by default; kept off here
+    # so direct engine-level users see the historical ungated program.
+    phase_gate: bool = False
 
     @property
     def req_bits(self) -> int:

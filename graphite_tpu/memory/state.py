@@ -225,6 +225,11 @@ class MemState:
     # bool[] — any protocol state outstanding (messages, transactions,
     # waiting requesters); False lets the step skip the engine entirely
     live: jax.Array
+    # int64[6] — per-phase lax.cond skip counts under phase gating
+    # (MemParams.phase_gate; engine.PHASE_NAMES order).  A whole-engine
+    # mem_gate skip counts every phase.  Replicated control state under
+    # shard_map (deterministic from replicated predicates).
+    phase_skips: jax.Array = None
     # per-port queue state of the MEMORY NoC when `[network] memory =
     # emesh_hop_by_hop` (models/network_hop_by_hop.NocState), else None
     noc: "object" = None
@@ -234,6 +239,11 @@ class MemState:
     # bucket collisions are a documented approximation shared with the
     # oracle).  None when track_miss_types is off.
     mt: "object" = None
+
+
+# the engines' protocol phase count (engine.PHASE_NAMES /
+# engine_shl2.SHL2_PHASE_NAMES index the skip vector)
+N_PHASES = 6
 
 
 def init_mem_common(mp: MemParams) -> dict:
@@ -296,6 +306,7 @@ def init_mem_common(mp: MemParams) -> dict:
         # +1 scratch word absorbing masked-off dummy writes
         func_mem=jnp.zeros(max(mp.func_mem_words, 1) + 1, jnp.uint32),
         func_errors=jnp.zeros((), I64),
+        phase_skips=jnp.zeros(N_PHASES, I64),
     )
 
 

@@ -26,6 +26,15 @@ one packet per queue lane (masked), which is how the engines drive it (one
 DRAM access per controller per subquantum iteration, one packet per router
 port per iteration).
 
+Masked-no-op invariant (load-bearing for the memory engines' per-phase
+activity gating): a call whose mask is all-False leaves the queue state
+BIT-IDENTICAL — masked lanes route to the scratch queue / contribute
+zero deltas and max-with-zero against nonnegative times, never a real
+mutation.  The gated engine phases (memory/engine.py, MemParams.
+phase_gate) skip whole calls whose masks are provably all-False; that
+skip is only bit-exact because of this invariant, so any new queue-state
+write added here must preserve it.
+
 Times are integer ns (the reference computes queue delays in ns/cycles at
 1 GHz — `dram_perf_model.cc:80-91`).
 """

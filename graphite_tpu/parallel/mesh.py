@@ -22,6 +22,22 @@ from graphite_tpu.engine.state import DeviceTrace, SimState
 TILE_AXIS = "tiles"
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: new API (jax >= 0.5,
+    check_vma) when present, else jax.experimental.shard_map
+    (check_rep).  Both checkers are disabled for the same reason (see
+    make_shard_map_runner): control state is replicated by construction
+    and the checker cannot see it."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm_exp
+
+    return sm_exp(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
 def make_tile_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """A 1D mesh over the tile axis.
 
@@ -52,6 +68,10 @@ _REPLICATED_STATE_FIELDS = {
     # functional word store: a global address space, replicated (the
     # coherence protocol serializes conflicting writes)
     "func_mem", "func_errors",
+    # gate observability: the [6] per-phase skip-count vector is global
+    # control state (and at 6-tile counts would otherwise be mistaken
+    # for a tile-major array by the shape heuristic below)
+    "phase_skips",
 }
 
 
@@ -210,21 +230,19 @@ def make_shard_map_runner(params, quantum_ps, max_quanta: int, mesh: Mesh,
             return run_simulation(params, tr, st, quantum_ps, max_quanta,
                                   trace_base=base, px=px)
 
-        sm = jax.shard_map(
+        sm = _shard_map(
             body, mesh=mesh,
             in_specs=(state_specs, trace_specs, P()),
-            out_specs=(state_specs, P(), P(), P()),
-            check_vma=False)
+            out_specs=(state_specs, P(), P(), P()))
         return jax.jit(sm)
 
     def body(st, tr):
         return run_simulation(params, tr, st, quantum_ps, max_quanta, px=px)
 
-    sm = jax.shard_map(
+    sm = _shard_map(
         body, mesh=mesh,
         in_specs=(state_specs, trace_specs),
-        out_specs=(state_specs, P(), P(), P()),
-        check_vma=False)
+        out_specs=(state_specs, P(), P(), P()))
     return jax.jit(sm)
 
 

@@ -120,7 +120,9 @@ def main() -> int:
                     break
                 _t.sleep(10)  # let the tunnel release the device, retry
             for line in out:
-                if line.startswith(("config", "  ")):
+                # forward result lines AND the per-config JSON line
+                # (phase-skip observability) to the captured output
+                if line.startswith(("config", "  ", "{")):
                     print(line)
             if p.returncode != 0:
                 failures += 1
@@ -145,6 +147,16 @@ def main() -> int:
               f"{res.total_instructions} instrs, {dt:.2f}s wall, "
               f"{res.total_instructions / dt / 1e6:.2f}M instr/s "
               f"{'PASS' if ok else 'FAIL'}")
+        # one machine-readable line per config so BENCH_r{N}-style
+        # captures track gate skip rates alongside throughput
+        import json
+
+        print(json.dumps({
+            "config": n,
+            "instr_per_s": round(res.total_instructions / dt),
+            "engine_iters": int(sim.last_n_iterations),
+            "phase_skips": sim.last_phase_skips,
+        }))
         if n == 5:
             # power modeling pass over the final counters (config 5)
             try:

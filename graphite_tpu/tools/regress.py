@@ -9,6 +9,13 @@ func_errors).  Exit code is nonzero if any config fails.
 
 Usage:
   python -m graphite_tpu.tools.regress [--tiles 8] [--quick]
+  python -m graphite_tpu.tools.regress --smoke   # tier-1 companion, CPU
+
+`--smoke` is the fast gating/dispatch attestation (runs in well under a
+minute on CPU with a warm XLA cache): the 16-tile per-phase-gated vs
+ungated engine pair must be bit-identical, and the batched host-barrier
+dispatch (barrier_batch > 1) must reproduce the per-quantum dispatch
+exactly.
 """
 
 from __future__ import annotations
@@ -53,13 +60,71 @@ def run_one(tiles, protocol, scheme, network, core, workload):
     return res
 
 
+def _compare(name, ra, rb):
+    """Bit-equality of two SimResults (clocks + memory counters)."""
+    import numpy as np
+
+    ok = (np.asarray(ra.clock_ps) == np.asarray(rb.clock_ps)).all()
+    if ra.mem_counters is not None:
+        for k in ra.mem_counters:
+            ok = ok and (np.asarray(ra.mem_counters[k])
+                         == np.asarray(rb.mem_counters[k])).all()
+    ok = ok and ra.n_quanta == rb.n_quanta
+    print(f"{name:44} {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+def smoke(tiles: int = 16) -> int:
+    """The tier-1 companion fast path: gated/ungated bit-exactness and
+    batched-barrier equivalence at 16 tiles on CPU."""
+    import time as _t
+
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.engine.simulator import Simulator
+    from graphite_tpu.trace import synthetic
+
+    t0 = _t.perf_counter()
+    failures = 0
+
+    # 1) per-phase gating is mechanism, not policy: gated vs ungated
+    #    engines must be bit-identical on coherence-heavy traffic
+    #    (mem_gate_bytes=0 forces the whole-engine gate OFF so the
+    #    per-phase conds are the only gating in the gated program)
+    sc = SimConfig(ConfigFile.from_string(config_text(
+        tiles, shared_mem=True, clock_scheme="lax")))
+    batch = synthetic.memory_stress_trace(
+        tiles, n_accesses=40, working_set_bytes=1 << 13,
+        write_fraction=0.4, shared_fraction=0.5, seed=7)
+    r_gate = Simulator(sc, batch, phase_gate=True, mem_gate_bytes=0).run()
+    r_flat = Simulator(sc, batch, phase_gate=False, mem_gate_bytes=0).run()
+    failures += _compare("phase-gated vs ungated (MSI, 16t)", r_gate,
+                         r_flat)
+
+    # 2) batched host-barrier dispatch == per-quantum dispatch
+    sc_b = SimConfig(ConfigFile.from_string(config_text(
+        tiles, shared_mem=True, clock_scheme="lax_barrier")))
+    r_b1 = Simulator(sc_b, batch, barrier_host=True, barrier_batch=1).run()
+    r_b8 = Simulator(sc_b, batch, barrier_host=True, barrier_batch=8).run()
+    failures += _compare("barrier_batch=8 vs per-quantum dispatch", r_b1,
+                         r_b8)
+
+    print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
+    return 1 if failures else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiles", type=int, default=8)
     ap.add_argument("--quick", action="store_true",
                     help="one representative config per axis instead of "
                     "the cross product")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast tier-1 companion: 16-tile gated/ungated "
+                    "pair + batched-barrier equivalence on CPU")
     args = ap.parse_args()
+
+    if args.smoke:
+        return smoke(args.tiles if args.tiles != 8 else 16)
 
     if args.quick:
         matrix = [
