@@ -226,6 +226,79 @@ scheme = lax
                     companions["coherence_1024_config"] = rung["config"]
                     break
 
+    # Batched-campaign throughput (round 7, sweep/ subsystem): a B-point
+    # timing-knob grid through ONE compiled program with traced knobs.
+    # The campaign comparison is COMPILE-INCLUSIVE on both sides,
+    # because that is what a knob sweep actually pays: with knobs baked
+    # static (the pre-round-7 tool), every grid point is a distinct XLA
+    # program — B compiles; the sweep pays one compile for the whole
+    # grid.  A representative single point's compile+run is measured as
+    # the sequential per-point cost.  Warm per-iteration rates ride
+    # along for transparency: on CPU the warm batched iteration does
+    # NOT beat the warm gated sequential iteration (vmap turns the
+    # activity-gating conds into both-branch selects — PERF.md round-7);
+    # the on-chip op-tail amortization claim is a TPU re-measurement
+    # item.  Skippable via BENCH_SWEEP=0; B via BENCH_SWEEP_B.
+    if os.environ.get("BENCH_SWEEP", "1") != "0":
+        from graphite_tpu.sweep import SweepRunner
+        from graphite_tpu.tools._template import config_text
+
+        B = int(os.environ.get("BENCH_SWEEP_B", "8"))
+        sw_tiles = int(os.environ.get("BENCH_SWEEP_TILES", "16"))
+        sc_sw = SimConfig(ConfigFile.from_string(config_text(
+            sw_tiles, shared_mem=True, clock_scheme="lax")))
+        sw_trace = synthetic.memory_stress_trace(
+            sw_tiles, n_accesses=24, working_set_bytes=1 << 13,
+            write_fraction=0.4, shared_fraction=0.5, seed=7)
+        points = [{"dram_latency_ns": 40 + 20 * i} for i in range(B)]
+        sweep = SweepRunner(sc_sw, [sw_trace], points)
+        t0 = time.perf_counter()
+        out = sweep.run()               # compile + run: the campaign cost
+        sweep_total_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = sweep.run()               # warm steady-state rate
+        sweep_warm_s = time.perf_counter() - t0
+        total_iters = max(int(out.n_iterations.sum()), 1)
+
+        # one representative off-default point of the sequential
+        # campaign: fresh static params -> its own compile, plus the run
+        import dataclasses as _dc3
+
+        seq = Simulator(sc_sw, sw_trace, mailbox_depth=sweep.mailbox_depth)
+        seq.params = _dc3.replace(
+            seq.params,
+            mem=_dc3.replace(seq.params.mem, dram_latency_ns=40))
+        t0 = time.perf_counter()
+        seq.run()
+        seq_point_s = time.perf_counter() - t0
+        seq_iters = max(int(seq.last_n_iterations), 1)
+        seq2 = Simulator(sc_sw, sw_trace,
+                         mailbox_depth=sweep.mailbox_depth)
+        seq2.params = seq.params
+        seq2.adopt_runner(seq)
+        t0 = time.perf_counter()
+        seq2.run()
+        seq_warm_s = time.perf_counter() - t0
+
+        ms_amort = 1000 * sweep_total_s / total_iters
+        ms_seq = 1000 * seq_point_s / seq_iters
+        companions.update({
+            "sweep_batch": B,
+            # steady-state campaign throughput (warm program)
+            "sims_per_s": round(B / sweep_warm_s, 3),
+            # compile-inclusive campaign economics (the headline):
+            # per-useful-iteration cost of the whole grid vs ONE
+            # sequential point's compile+run
+            "ms_per_iter_amortized": round(ms_amort, 4),
+            "ms_per_iter_sequential": round(ms_seq, 4),
+            "sweep_vs_sequential": round(ms_amort / ms_seq, 4),
+            # warm rates (no compiles anywhere) for transparency
+            "ms_per_iter_amortized_warm": round(
+                1000 * sweep_warm_s / total_iters, 4),
+            "ms_per_iter_sequential_warm": round(
+                1000 * seq_warm_s / seq_iters, 4),
+        })
+
     print(
         json.dumps(
             {

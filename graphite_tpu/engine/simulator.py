@@ -269,6 +269,17 @@ def auto_mailbox_depth(batch: "TraceBatch") -> int:
     return int(np.clip(bound, 2, 64))
 
 
+def mem_phase_names(params: EngineParams) -> tuple:
+    """The memory engine's protocol-phase names, in the skip-vector's
+    order (one source of truth for skip-counter labeling — Simulator's
+    last_phase_skips and the sweep runner's per-sim demux)."""
+    if params.mem.protocol.startswith("pr_l1_sh_l2"):
+        from graphite_tpu.memory.engine_shl2 import SHL2_PHASE_NAMES
+        return SHL2_PHASE_NAMES
+    from graphite_tpu.memory.engine import PHASE_NAMES
+    return PHASE_NAMES
+
+
 _STREAM_RUNNERS: dict = {}
 # Each cached wrapper pins a compiled executable (tens of MB of device
 # program + host tracing caches); long-lived processes sweeping many
@@ -765,12 +776,7 @@ class Simulator:
         if self.state.mem is None:
             return None
         skips = np.asarray(jax.device_get(self.state.mem.phase_skips))
-        if self.params.mem.protocol.startswith("pr_l1_sh_l2"):
-            from graphite_tpu.memory.engine_shl2 import (
-                SHL2_PHASE_NAMES as names,
-            )
-        else:
-            from graphite_tpu.memory.engine import PHASE_NAMES as names
+        names = mem_phase_names(self.params)
         return {n: int(v) for n, v in zip(names, skips.tolist())}
 
     def _get_runner(self, max_quanta: int):

@@ -152,8 +152,16 @@ def subquantum_iteration(
     quantum_end_ps: jax.Array,
     trace_base: jax.Array | None = None,
     px: ParallelCtx = IDENT,
+    knobs=None,
 ) -> tuple[SimState, jax.Array]:
     """Process one trace record per tile; returns (state, tiles_advanced).
+
+    With `knobs` (a sweep.Knobs pytree) set, the memory engines read
+    their timing scalars — DRAM latency, directory access cycles, NoC
+    hop latency, DVFS sync delay — from its TRACED leaves instead of
+    the static params, so one compiled program serves every timing
+    point of a sweep (sweep/knobs.py).  None keeps the historical
+    constant-folded program bit-identically.
 
     With `trace_base` (int32[T]) set, `trace` is a [T, W] WINDOW of the
     full record stream, row t starting at global record index
@@ -270,6 +278,9 @@ def subquantum_iteration(
             engine_step = shl2_engine_step
         else:
             engine_step = memory_engine_step
+        # knob lifting: swap the timing-scalar fields for the (traced)
+        # sweep knobs; geometry and every other static field untouched
+        mem_p = params.mem if knobs is None else knobs.apply_mem(params.mem)
         addr0, addr1 = fetched[6], fetched[7]
         rec = RecView(op=op, flags=flags, pc=pc, addr0=addr0, addr1=addr1,
                       aux0=aux0, aux1=aux1)
@@ -282,17 +293,17 @@ def subquantum_iteration(
         # coherence-dense, so the gate would rarely skip anyway).
         if params.mem_gate and not px.sharded:
             need_mem = state.mem.live | jnp.any(
-                active & slots_present(params.mem, rec, enabled).any(axis=1))
+                active & slots_present(mem_p, rec, enabled).any(axis=1))
             mem_out = lax.cond(
                 need_mem,
-                lambda _: engine_step(params.mem, state.mem, rec,
+                lambda _: engine_step(mem_p, state.mem, rec,
                                       core.clock_ps, core.freq_mhz,
                                       active, enabled),
-                lambda _: mem_idle_out(params.mem, state.mem, rec, enabled),
+                lambda _: mem_idle_out(mem_p, state.mem, rec, enabled),
                 None)
         else:
             mem_out = engine_step(
-                params.mem, state.mem, rec, core.clock_ps, core.freq_mhz,
+                mem_p, state.mem, rec, core.clock_ps, core.freq_mhz,
                 active, enabled, px=px)
         mem_state = mem_out.ms
         mem_ok = mem_out.mem_complete
@@ -1106,7 +1117,8 @@ def subquantum_iteration(
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 
 
-def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT):
+def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT,
+                  knobs=None):
     """Blocks of `inner_block` iterations until no tile makes progress.
     Returns (state, total_progress, n_iterations)."""
 
@@ -1114,7 +1126,7 @@ def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT):
         def body(carry, _):
             st, prog = carry
             st, adv = subquantum_iteration(params, trace, st, qend,
-                                           trace_base, px=px)
+                                           trace_base, px=px, knobs=knobs)
             return (st, prog + adv), None
 
         (state, progress), _ = lax.scan(
@@ -1176,14 +1188,20 @@ def run_simulation(
     params: EngineParams,
     trace: DeviceTrace,
     state: SimState,
-    quantum_ps: int | None,
+    quantum_ps: "int | jax.Array | None",
     max_quanta: int = 1_000_000,
     trace_base: jax.Array | None = None,
     px: ParallelCtx = IDENT,
+    knobs=None,
 ):
     """The whole simulation as ONE compiled region: an outer while_loop over
     lax-barrier quanta (the MCP barrier loop, `lax_barrier_sync_server.h`)
     wrapping the per-quantum progress loop.
+
+    `quantum_ps` may be a TRACED int64 scalar (the sweep's quantum knob):
+    boundary math is pure arithmetic, so a per-point quantum rides the
+    same compiled program.  `knobs` (sweep.Knobs) likewise threads traced
+    timing scalars into the memory engines; see subquantum_iteration.
 
     Device-driven on purpose: every host↔device round trip costs ~100 ms
     over a tunneled chip, so the host loop's per-quantum control reads made
@@ -1196,7 +1214,12 @@ def run_simulation(
     reference debugs with its progress trace, `pin/progress_trace.cc`).
     """
     INF_QEND = jnp.asarray(2**61, I64)
-    qps = None if quantum_ps is None else int(quantum_ps)
+    if quantum_ps is None:
+        qps = None
+    elif isinstance(quantum_ps, jax.Array):
+        qps = quantum_ps          # traced sweep knob (int64 scalar)
+    else:
+        qps = int(quantum_ps)
 
     def next_boundary(clock):
         return (clock // qps + 1) * qps
@@ -1221,7 +1244,8 @@ def run_simulation(
         else:
             qend = jnp.maximum(prev_qend + qps, next_boundary(min_pending))
         st2, progress, blk_iters = _quantum_loop(params, trace, st, qend,
-                                                 trace_base, px=px)
+                                                 trace_base, px=px,
+                                                 knobs=knobs)
         # Zero progress: if some non-done tile sits beyond qend (it crossed
         # the boundary executing one long record), jump the window up to it
         # — blocked peers may wait on its future sends.  Only when every

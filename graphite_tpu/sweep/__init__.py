@@ -1,0 +1,21 @@
+"""Batched simulation campaigns: vmap B simulations through one compiled
+step with traced timing knobs (zero recompiles across a knob grid).
+
+  Knobs / grid_points  — traced timing-scalar pytree (knobs.py)
+  pack_traces / PackedTraces — [B, T, L] trace packing (pack.py)
+  SweepRunner / SweepOutcome — the vmapped campaign driver (runner.py)
+"""
+
+from graphite_tpu.sweep.knobs import KNOB_FIELDS, Knobs, grid_points
+from graphite_tpu.sweep.pack import PackedTraces, pack_traces
+from graphite_tpu.sweep.runner import SweepOutcome, SweepRunner
+
+__all__ = [
+    "KNOB_FIELDS",
+    "Knobs",
+    "grid_points",
+    "PackedTraces",
+    "pack_traces",
+    "SweepOutcome",
+    "SweepRunner",
+]

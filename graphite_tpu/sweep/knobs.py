@@ -1,0 +1,128 @@
+"""Traced timing knobs: the dynamic half of the engine's parameter split.
+
+The engine's compile-time parameters conflate two different things:
+*geometry* (tile count, cache sets/ways, mesh width — array shapes, truly
+static) and *timing scalars* (DRAM latency, directory access cycles, NoC
+hop latency, DVFS synchronization delay, the lax_barrier quantum) that
+only ever enter the program as arithmetic operands.  Baking the timing
+scalars into the jit means a 20-point latency sweep pays 20 compiles and
+20 full per-iteration op tails (ROADMAP: config 5's ~0.2 ms dense floor
+is per-*program*).
+
+`Knobs` lifts the timing scalars into a pytree of traced int64 leaves so
+ONE compiled XLA program serves an entire grid of timing points: pass a
+scalar `Knobs` to `run_simulation(..., knobs=...)` for recompile-free
+point hopping, or a batched `[B]` `Knobs` under `vmap` (sweep/runner.py)
+to run B timing points simultaneously.  When `knobs` is None everywhere,
+the engines read the same values off the static params as plain Python
+ints — the historical constant-folded program, bit-identical by
+construction.
+
+Knob semantics (all integers):
+  dram_latency_ns     [dram] latency (`dram_perf_model.cc:80-115`)
+  dram_processing_ns  line_size / bandwidth + 1 (same model)
+  dir_access_cycles   [dram_directory] access_time staircase result
+  hop_latency_cycles  MEMORY-net per-hop router+link delay
+                      (`network_model_emesh_hop_counter.cc`)
+  sync_delay_cycles   [dvfs] synchronization_delay (cross-domain module
+                      handoffs, `cache.cc:559-567`)
+  quantum_ps          lax_barrier quantum (`carbon_sim.cfg:92-97`);
+                      ignored under the lax / lax_p2p schemes
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+I64 = jnp.int64
+
+# fields applied onto MemParams (quantum_ps rides the step loop instead)
+MEM_KNOB_FIELDS = (
+    "dram_latency_ns",
+    "dram_processing_ns",
+    "dir_access_cycles",
+    "hop_latency_cycles",
+    "sync_delay_cycles",
+)
+KNOB_FIELDS = MEM_KNOB_FIELDS + ("quantum_ps",)
+
+
+@struct.dataclass
+class Knobs:
+    """Timing scalars as a pytree of int64 leaves (scalar or [B])."""
+
+    dram_latency_ns: jax.Array
+    dram_processing_ns: jax.Array
+    dir_access_cycles: jax.Array
+    hop_latency_cycles: jax.Array
+    sync_delay_cycles: jax.Array
+    quantum_ps: jax.Array
+
+    @classmethod
+    def from_params(cls, params, quantum_ps: "int | None" = None) -> "Knobs":
+        """Baseline knob point read off static params (EngineParams or
+        MemParams).  Memoryless runs (EngineParams.mem None) get zeros
+        for the memory knobs — the engines never read them."""
+        mp = getattr(params, "mem", params)
+
+        def get(name):
+            return int(getattr(mp, name, 0) or 0) if mp is not None else 0
+
+        return cls(**{f: jnp.asarray(get(f), I64) for f in MEM_KNOB_FIELDS},
+                   quantum_ps=jnp.asarray(int(quantum_ps or 0), I64))
+
+    def apply_mem(self, mp):
+        """MemParams with the timing-scalar fields swapped for this
+        Knobs' (possibly traced) leaves.  Geometry, protocol strings and
+        every other static field pass through untouched; the replaced
+        instance lives only inside a trace (it is no longer hashable as
+        a jit-static argument)."""
+        return dataclasses.replace(
+            mp, **{f: getattr(self, f) for f in MEM_KNOB_FIELDS})
+
+    @classmethod
+    def stack(cls, base: "Knobs", points: "list[dict]") -> "Knobs":
+        """A batched [B] Knobs from override dicts over a baseline point.
+
+        Each dict maps knob-field name -> int; absent fields take the
+        baseline's value.  Row b of every leaf is point b."""
+        cols = {f: [] for f in KNOB_FIELDS}
+        for i, p in enumerate(points):
+            unknown = set(p) - set(KNOB_FIELDS)
+            if unknown:
+                raise ValueError(
+                    f"point {i}: unknown knob(s) {sorted(unknown)} "
+                    f"(valid: {', '.join(KNOB_FIELDS)})")
+            for f in KNOB_FIELDS:
+                cols[f].append(int(p.get(f, getattr(base, f))))
+        return cls(**{f: jnp.asarray(cols[f], I64) for f in KNOB_FIELDS})
+
+    @property
+    def batch(self) -> "int | None":
+        """B for a batched Knobs, None for a scalar point."""
+        shape = jnp.shape(self.dram_latency_ns)
+        return None if shape == () else int(shape[0])
+
+    def point(self, b: int) -> dict:
+        """Host dict of point b's values (for reports / JSON lines)."""
+        return {f: int(jnp.asarray(getattr(self, f)).reshape(-1)[b])
+                for f in KNOB_FIELDS}
+
+
+def grid_points(**axes) -> "list[dict]":
+    """Cross product of knob axes into override dicts, row-major in the
+    given keyword order: grid_points(dram_latency_ns=[50, 100],
+    hop_latency_cycles=[1, 2]) -> 4 points."""
+    unknown = set(axes) - set(KNOB_FIELDS)
+    if unknown:
+        raise ValueError(
+            f"unknown knob axis(es) {sorted(unknown)} "
+            f"(valid: {', '.join(KNOB_FIELDS)})")
+    names = list(axes)
+    return [dict(zip(names, vals))
+            for vals in itertools.product(*(axes[n] for n in names))]

@@ -1,0 +1,112 @@
+"""Trace packing: B same-geometry traces into one [B, T, L] batch.
+
+The sweep runner vmaps the quantum step over a leading sim axis, so the
+B traces must share one static shape.  Packing pads every field to the
+longest sim's record length the same way `TraceBatch.from_builders` pads
+tiles within one sim: `op` with NOP (the engine's stream-end sentinel,
+so shorter sims simply finish earlier — the per-sim "length mask" is the
+NOP tail itself), register fields with NO_REG, everything else with
+zeros.  Per-sim RNG seeds are carried as metadata so a campaign's JSON
+lines can name the trace that produced each row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from graphite_tpu.trace.schema import NO_REG, Op, TraceBatch
+
+
+@dataclasses.dataclass
+class PackedTraces:
+    """B stacked TraceBatches, [B, T, L] per field (host-side)."""
+
+    op: np.ndarray
+    flags: np.ndarray
+    pc: np.ndarray
+    addr0: np.ndarray
+    addr1: np.ndarray
+    size0: np.ndarray
+    size1: np.ndarray
+    aux0: np.ndarray
+    aux1: np.ndarray
+    dyn_ps: np.ndarray
+    rreg0: np.ndarray
+    rreg1: np.ndarray
+    wreg: np.ndarray
+    lengths: np.ndarray          # int64[B] pre-padding record length
+    seeds: "np.ndarray | None"   # int64[B] generator seeds (metadata)
+
+    _TRACE_FIELDS = tuple(f.name for f in dataclasses.fields(TraceBatch))
+
+    @property
+    def n_sims(self) -> int:
+        return self.op.shape[0]
+
+    @property
+    def n_tiles(self) -> int:
+        return self.op.shape[1]
+
+    @property
+    def length(self) -> int:
+        return self.op.shape[2]
+
+    def sim(self, b: int) -> TraceBatch:
+        """Sim b back as a standalone TraceBatch (padded length — the
+        NOP tail is semantically inert, see module docstring)."""
+        return TraceBatch(**{f: getattr(self, f)[b]
+                             for f in self._TRACE_FIELDS})
+
+    def device_traces(self):
+        """A [B, T, L] DeviceTrace pytree — vmap over axis 0 yields each
+        sim's ordinary [T, L] trace."""
+        import jax.numpy as jnp
+
+        from graphite_tpu.engine.state import DeviceTrace
+
+        return DeviceTrace(**{f: jnp.asarray(getattr(self, f))
+                              for f in self._TRACE_FIELDS})
+
+    def replicate(self, b: int) -> "PackedTraces":
+        """Sim 0 tiled to B rows — the one-trace x B-knob-points grid."""
+        if self.n_sims != 1:
+            raise ValueError("replicate() applies to a single-sim pack")
+        rep = {f: np.repeat(getattr(self, f), b, axis=0)
+               for f in self._TRACE_FIELDS}
+        return PackedTraces(**rep, lengths=np.repeat(self.lengths, b),
+                            seeds=(None if self.seeds is None
+                                   else np.repeat(self.seeds, b)))
+
+
+def pack_traces(batches: "list[TraceBatch]",
+                seeds: "list[int] | None" = None) -> PackedTraces:
+    """Pad B same-geometry TraceBatches to a common [B, T, L] layout."""
+    if not batches:
+        raise ValueError("pack_traces needs at least one trace")
+    T = batches[0].n_tiles
+    bad = [i for i, b in enumerate(batches) if b.n_tiles != T]
+    if bad:
+        raise ValueError(
+            f"all traces must share one tile count ({T}); sims {bad} "
+            "differ — a sweep shares ONE compiled geometry")
+    if seeds is not None and len(seeds) != len(batches):
+        raise ValueError("seeds length != number of traces")
+    L = max(b.length for b in batches)
+    B = len(batches)
+    out = {}
+    for f in PackedTraces._TRACE_FIELDS:
+        ref = getattr(batches[0], f)
+        arr = np.zeros((B, T, L), dtype=ref.dtype)
+        if f == "op":
+            arr[:] = np.uint8(Op.NOP)
+        elif f in ("rreg0", "rreg1", "wreg"):
+            arr[:] = NO_REG
+        for i, b in enumerate(batches):
+            arr[i, :, : b.length] = getattr(b, f)
+        out[f] = arr
+    return PackedTraces(
+        **out,
+        lengths=np.asarray([b.length for b in batches], np.int64),
+        seeds=None if seeds is None else np.asarray(seeds, np.int64))

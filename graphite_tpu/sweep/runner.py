@@ -1,0 +1,295 @@
+"""Batched simulation campaigns: B simulations through ONE compiled step.
+
+Graphite's whole reason to exist is simulation *throughput* — the
+reference parallelizes ONE simulation across host machines because
+architects run campaigns: design-space sweeps over timing parameters,
+traces, and seeds.  The TPU port has the inverse opportunity: the
+per-iteration op tail (ROADMAP: config 5's ~0.2 ms dense floor) is a
+per-*program* cost, so `vmap`ping B independent simulations through one
+program amortizes it B-ways — the batching shape that makes inference
+stacks fast.
+
+Mechanics:
+ - traces pack to a common [B, T, L] layout (sweep/pack.py); `vmap` maps
+   the device-side simulation loop (`engine/step.run_simulation`) over
+   the sim axis;
+ - timing knobs ride as a traced `[B]` Knobs pytree (sweep/knobs.py), so
+   a grid of timing points — DRAM latency, directory access, hop
+   latency, sync delay, quantum — shares the single compiled program
+   with ZERO recompiles;
+ - per-sim done/overflow/deadlock masks drive each sim's own while_loop
+   condition: under vmap's batching rule a finished sim's carry is
+   select-frozen, so every sim's final state is BIT-IDENTICAL to its own
+   sequential run (pinned in tests/test_sweep.py) and the batch
+   early-exits once the last live sim finishes;
+ - results demux back into B independent SimResults (plus per-sim
+   phase-skip counters and iteration counts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from graphite_tpu.sweep.knobs import Knobs
+from graphite_tpu.sweep.pack import PackedTraces, pack_traces
+
+
+@dataclasses.dataclass
+class SweepOutcome:
+    """One campaign's demuxed outputs."""
+
+    results: list                 # B SimResults (engine/simulator.py)
+    knobs: "Knobs"                # the [B] knob batch that ran
+    n_iterations: np.ndarray      # int64[B] subquantum iterations per sim
+    n_quanta: np.ndarray          # int32[B]
+    phase_skips: "list[dict] | None"  # per-sim gate skip counts (or None)
+    seeds: "np.ndarray | None" = None  # per-sim trace seeds (pack metadata)
+
+    def json_rows(self) -> "list[dict]":
+        """One JSON-able dict per sim (the CLI's output lines)."""
+        rows = []
+        for b, r in enumerate(self.results):
+            rows.append({
+                "sim": b,
+                **({"seed": int(self.seeds[b])}
+                   if self.seeds is not None else {}),
+                **self.knobs.point(b),
+                "completion_time_ns": r.completion_time_ps // 1000,
+                "total_instructions": r.total_instructions,
+                "n_quanta": int(self.n_quanta[b]),
+                "n_iterations": int(self.n_iterations[b]),
+                "func_errors": r.func_errors,
+            })
+        return rows
+
+
+class SweepRunner:
+    """Run B same-geometry simulations as one batched compiled program.
+
+    `traces`: a list of TraceBatch (or a PackedTraces).  `points`: knob
+    override dicts (sweep/knobs.py KNOB_FIELDS); with one trace and K > 1
+    points the trace is replicated across the grid.  Remaining kwargs
+    reach the underlying Simulator construction (mailbox_depth,
+    inner_block, phase_gate, ...); multi-chip tile sharding, streaming
+    and host-barrier modes are out of scope for the batched program.
+
+    Two batching programs, chosen by `shard_batch`:
+     - `vmap` over the sim axis (the default on one device): one
+       program, B-wide arrays.  vmap converts the engine's activity-
+       gating lax.conds into both-branch selects, so this program runs
+       UNGATED by default (gating is mechanism, not policy — results are
+       bit-identical either way; pass phase_gate=True to override).
+     - batch-axis `shard_map` when several devices are visible and B
+       divides evenly: each device runs B/ndev sims; with one sim per
+       device the per-device program is the plain UNBATCHED engine —
+       real lax.cond gating stays alive and sims run in parallel across
+       devices (host cores on the virtual CPU platform, chips on a TPU
+       slice).  `shard_batch=False` forces plain vmap.
+    """
+
+    def __init__(self, config, traces, points: "list[dict] | None" = None,
+                 *, mailbox_depth: "int | None" = None,
+                 shard_batch: "bool | None" = None, **sim_kwargs):
+        from graphite_tpu.engine.simulator import Simulator, \
+            auto_mailbox_depth
+
+        for bad in ("mesh", "stream", "barrier_host", "donate"):
+            # pop rather than test: an explicit falsy value (e.g.
+            # barrier_host=False) matches our own construction and must
+            # not collide with the kwargs passed below
+            if sim_kwargs.pop(bad, None):
+                raise ValueError(
+                    f"SweepRunner does not support {bad}= (the batched "
+                    "program is single-device and resident)")
+        pack = traces if isinstance(traces, PackedTraces) \
+            else pack_traces(list(traces))
+        if points and pack.n_sims == 1 and len(points) > 1:
+            pack = pack.replicate(len(points))
+        if points is not None and len(points) != pack.n_sims:
+            raise ValueError(
+                f"{len(points)} knob points for {pack.n_sims} traces — "
+                "counts must match (or pass one trace to replicate)")
+        self.pack = pack
+        B = pack.n_sims
+
+        # every sim must build the SAME engine program: the memory
+        # subsystem is built iff a trace touches memory, so mixed
+        # memory/memoryless campaigns cannot share one lowering
+        from graphite_tpu.trace.schema import FLAG_MEM0_VALID, \
+            FLAG_MEM1_VALID
+        mem_flags = FLAG_MEM0_VALID | FLAG_MEM1_VALID
+        has_mem = [bool(np.any(pack.flags[b] & mem_flags))
+                   for b in range(B)]
+        if len(set(has_mem)) != 1:
+            raise ValueError(
+                "all sims in a sweep must agree on touching memory "
+                f"(sims {[b for b in range(B) if has_mem[b] != has_mem[0]]}"
+                " differ): the memory engine is part of the compiled "
+                "program")
+
+        if mailbox_depth is None:
+            # one ring depth serves the whole batch (ring timing is
+            # depth-invariant below overflow, so per-sim equality holds)
+            mailbox_depth = max(auto_mailbox_depth(pack.sim(b))
+                                for b in range(B))
+
+        # batch-axis sharding layout: K sims per device (see class doc)
+        n_dev = len(jax.devices())
+        if shard_batch is None:
+            shard_batch = n_dev > 1 and B % n_dev == 0
+        if shard_batch and (n_dev <= 1 or B % n_dev != 0):
+            raise ValueError(
+                f"shard_batch needs B ({B}) divisible by the device "
+                f"count ({n_dev})")
+        self.shard_batch = bool(shard_batch)
+        self._sims_per_dev = B // n_dev if self.shard_batch else B
+        if self._sims_per_dev > 1 and has_mem[0]:
+            # the per-device program is vmapped: its gating conds become
+            # both-branch selects, so default them OFF (bit-identical
+            # results, measured faster; explicit kwargs win)
+            sim_kwargs.setdefault("phase_gate", False)
+            sim_kwargs.setdefault("mem_gate_bytes", 0)
+        self.sim = Simulator(config, pack.sim(0),
+                             mailbox_depth=mailbox_depth,
+                             barrier_host=False, **sim_kwargs)
+        self.mailbox_depth = mailbox_depth
+        base = Knobs.from_params(self.sim.params,
+                                 self.sim.quantum_ps)
+        points = points if points is not None else [{}] * B
+        if self.sim.quantum_ps is None:
+            # unbounded schemes (lax / lax_p2p) have no quantum for the
+            # knob to steer — reject rather than silently ignore it
+            bad_q = [i for i, p in enumerate(points) if "quantum_ps" in p]
+            if bad_q:
+                raise ValueError(
+                    f"point(s) {bad_q} sweep quantum_ps but the clock "
+                    "scheme has no lax_barrier quantum (the knob would "
+                    "be reported yet never enter the program)")
+        self.knobs = Knobs.stack(base, points)
+        if self.sim.quantum_ps is not None:
+            q = np.asarray(jax.device_get(self.knobs.quantum_ps))
+            if (q <= 0).any():
+                raise ValueError(
+                    f"quantum_ps knob points must be positive "
+                    f"(sims {np.flatnonzero(q <= 0).tolist()}): the "
+                    "boundary math divides by the quantum")
+        self.last_n_iterations = None
+        self._runner = None
+        self._runner_max_quanta = None
+        self._dtr = None      # device-resident [B, T, L] traces (cached)
+        self._states0 = None  # broadcast [B, ...] initial states (cached)
+
+    @property
+    def n_sims(self) -> int:
+        return self.pack.n_sims
+
+    def _get_runner(self, max_quanta: int):
+        if self._runner is None or self._runner_max_quanta != max_quanta:
+            from graphite_tpu.engine.step import run_simulation
+
+            params = self.sim.params
+            unbounded = self.sim.quantum_ps is None
+
+            def one(state, trace, kn):
+                q = None if unbounded else kn.quantum_ps
+                return run_simulation(params, trace, state, q, max_quanta,
+                                      knobs=kn)
+
+            if not self.shard_batch:
+                self._runner = jax.jit(jax.vmap(one))
+            else:
+                from jax.sharding import Mesh, PartitionSpec as P
+
+                from graphite_tpu.parallel.mesh import _shard_map
+
+                K = self._sims_per_dev
+                mesh = Mesh(np.array(jax.devices()), ("b",))
+
+                def per_device(state, trace, kn):
+                    if K > 1:
+                        return jax.vmap(one)(state, trace, kn)
+                    # one sim per device: strip the [1] batch dim and run
+                    # the plain UNBATCHED program — real lax.cond gating,
+                    # bit-identical to a sequential Simulator run
+                    squeeze = jax.tree_util.tree_map
+                    out = one(*(squeeze(lambda x: x[0],
+                                        t) for t in (state, trace, kn)))
+                    return squeeze(lambda x: x[None], out)
+
+                self._runner = jax.jit(_shard_map(
+                    per_device, mesh=mesh,
+                    in_specs=(P("b"), P("b"), P("b")),
+                    out_specs=P("b")))
+            self._runner_max_quanta = max_quanta
+        return self._runner
+
+    def run(self, max_quanta: int = 1_000_000) -> SweepOutcome:
+        from graphite_tpu.engine.simulator import (
+            DeadlockError, MailboxOverflowError, Simulator,
+        )
+
+        B = self.pack.n_sims
+        # B identical initial states (same config/geometry -> same init);
+        # the states and the [B, T, L] trace upload are cached so repeat
+        # run() calls (timed benchmark loops) measure the program, not a
+        # host->device re-upload of the campaign
+        if self._states0 is None:
+            self._states0 = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x[None], (B,) + x.shape),
+                self.sim.state)
+            self._dtr = self.pack.device_traces()
+        state, nq_d, deadlock_d, iters_d = self._get_runner(max_quanta)(
+            self._states0, self._dtr, self.knobs)
+        net_part, mem_part, ioc_part = Simulator._result_parts(state)
+        (nq, deadlock, overflow, done, core_h, net_h, mem_h, ioc_h,
+         iters) = jax.device_get((
+            nq_d, deadlock_d, state.net.overflow, state.done, state.core,
+            net_part, mem_part, ioc_part, iters_d))
+        if overflow.any():
+            raise MailboxOverflowError(
+                f"mailbox ring overflow in sim(s) "
+                f"{np.flatnonzero(overflow).tolist()}; re-run with a "
+                "larger mailbox_depth")
+        if deadlock.any():
+            raise DeadlockError(
+                f"no progress across a quantum in sim(s) "
+                f"{np.flatnonzero(deadlock).tolist()}")
+        undone = ~done.all(axis=1)
+        if undone.any():
+            raise RuntimeError(
+                f"sim(s) {np.flatnonzero(undone).tolist()} exceeded "
+                f"max_quanta={max_quanta}")
+        # self.sim.state keeps the PRISTINE initial state: repeat run()
+        # calls (timed benchmark loops) restart the campaign from zero
+        self.last_n_iterations = np.asarray(iters)
+
+        def row(tree, b):
+            return jax.tree_util.tree_map(lambda x: x[b], tree)
+
+        results = [
+            self.sim._results_host(
+                row(core_h, b), row(net_h, b),
+                None if mem_h is None else row(mem_h, b),
+                int(nq[b]),
+                None if ioc_h is None else row(ioc_h, b))
+            for b in range(B)
+        ]
+        phase_skips = None
+        if state.mem is not None:
+            from graphite_tpu.engine.simulator import mem_phase_names
+
+            skips = np.asarray(jax.device_get(state.mem.phase_skips))
+            names = mem_phase_names(self.sim.params)
+            phase_skips = [
+                {n: int(v) for n, v in zip(names, skips[b].tolist())}
+                for b in range(B)
+            ]
+        return SweepOutcome(results=results, knobs=self.knobs,
+                            n_iterations=np.asarray(iters),
+                            n_quanta=np.asarray(nq),
+                            phase_skips=phase_skips,
+                            seeds=self.pack.seeds)

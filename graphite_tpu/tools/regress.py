@@ -108,6 +108,26 @@ def smoke(tiles: int = 16) -> int:
     failures += _compare("barrier_batch=8 vs per-quantum dispatch", r_b1,
                          r_b8)
 
+    # 3) batched campaign == sequential runs (round 7, sweep/): B=4 sims
+    #    vmapped through ONE compiled program with per-sim traced knobs
+    #    must be bit-identical to 4 independent Simulator runs
+    from graphite_tpu.sweep import SweepRunner
+
+    seeds = (1, 2, 3, 4)
+    sweep_traces = [
+        synthetic.memory_stress_trace(
+            tiles, n_accesses=24, working_set_bytes=1 << 13,
+            write_fraction=0.4, shared_fraction=0.5, seed=s)
+        for s in seeds
+    ]
+    sweep = SweepRunner(sc, sweep_traces)
+    out = sweep.run()
+    for b, s in enumerate(seeds):
+        r_seq = Simulator(sc, sweep_traces[b],
+                          mailbox_depth=sweep.mailbox_depth).run()
+        failures += _compare(f"sweep B=4 sim {b} (seed {s}) vs sequential",
+                             out.results[b], r_seq)
+
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
     return 1 if failures else 0
 

@@ -37,3 +37,9 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import graphite_tpu  # noqa: E402,F401  (enables x64)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy variants excluded from the tier-1 run (-m 'not slow')")

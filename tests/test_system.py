@@ -79,6 +79,58 @@ class TestCheckpoint:
             np.testing.assert_array_equal(
                 r_ref.mem_counters[k], r2.mem_counters[k], err_msg=k)
 
+    def test_round6_state_roundtrips_bitwise(self, tmp_path):
+        """Explicit save -> load -> continue hardening for the round-6
+        state additions: per-phase gate skip counters (mem.phase_skips)
+        and the directory write-staging fields (directory.skey/sval/sn).
+        The loaded state must equal the saved one leaf-for-leaf, and the
+        continued run must finish bit-identical to an uninterrupted one
+        — including the skip counters themselves."""
+        import jax
+
+        sc = make_config()
+        batch = mem_workload()
+        # force the round-6 machinery on: per-phase conds live (the
+        # whole-engine gate off) + the staging table allocated
+        kw = dict(dir_stage=True, phase_gate=True, mem_gate_bytes=0)
+        ref = Simulator(sc, batch, **kw)
+        r_ref = ref.run()
+        ref_skips = ref.last_phase_skips
+
+        sim1 = Simulator(sc, batch, **kw)
+        done, nq = sim1.run_chunk(3)
+        assert not done
+        ckpt = str(tmp_path / "ckpt6.npz")
+        save_checkpoint(sim1, ckpt, n_quanta=nq)
+        sim2 = Simulator(sc, batch, **kw)
+        load_checkpoint(sim2, ckpt)
+
+        # staging is genuinely present in this state (the fields the
+        # round-6 work added must be exercised, not None-elided)
+        assert sim1.state.mem.directory.skey is not None
+        assert sim1.state.mem.directory.sn is not None
+        assert sim1.state.mem.phase_skips is not None
+
+        # leaf-for-leaf bit equality of the restored tree
+        flat1, _ = jax.tree_util.tree_flatten_with_path(sim1.state)
+        flat2, _ = jax.tree_util.tree_flatten_with_path(sim2.state)
+        assert len(flat1) == len(flat2)
+        for (p1, l1), (p2, l2) in zip(flat1, flat2):
+            assert p1 == p2
+            np.testing.assert_array_equal(
+                np.asarray(l1), np.asarray(l2), err_msg=str(p1))
+            assert np.asarray(l1).dtype == np.asarray(l2).dtype, p1
+
+        # continue: bit-identical completion, counters AND skip counters
+        r2 = sim2.run()
+        np.testing.assert_array_equal(r_ref.clock_ps, r2.clock_ps)
+        np.testing.assert_array_equal(
+            r_ref.instruction_count, r2.instruction_count)
+        for k in r_ref.mem_counters:
+            np.testing.assert_array_equal(
+                r_ref.mem_counters[k], r2.mem_counters[k], err_msg=k)
+        assert sim2.last_phase_skips == ref_skips
+
     def test_checkpoint_rejects_wrong_topology(self, tmp_path):
         sim4 = Simulator(make_config(4), mem_workload(4))
         ckpt = str(tmp_path / "c.npz")
