@@ -1,0 +1,31 @@
+"""Static analysis of lowered programs: jaxpr walker + invariant lints.
+
+Graphite's perf story rests on properties of the COMPILED program that
+Python-level code cannot see break: no big store rides a `lax.cond`
+output (round 6), every sweep knob stays a traced operand (round 7),
+absolute picosecond clocks never narrow below int64, batched programs
+don't pay for gating that vmap turned into selects, and no host
+callback hides in the device loop.  This package checks them all on
+`jax.make_jaxpr` output — `audit()` for the default config set,
+`walk.iter_eqns` / `rules.*` for bespoke assertions in tests.
+
+    from graphite_tpu.analysis import audit
+    report = audit()          # the four default-config programs
+    assert report.ok, report.findings
+
+CLI: `python -m graphite_tpu.tools.audit` (JSON-lines report).
+"""
+
+from graphite_tpu.analysis.audit import (  # noqa: F401
+    AuditReport, ProgramSpec, RuleResult, audit, audit_program,
+    clock_invar_indices, default_programs, spec_from_simulator,
+    spec_from_sweep,
+)
+from graphite_tpu.analysis.rules import (  # noqa: F401
+    Finding, cond_payload, host_sync, knob_fold, phase_conds,
+    time_dtype, vmap_gate,
+)
+from graphite_tpu.analysis.walk import (  # noqa: F401
+    aval_bytes, aval_sig, find_eqns, invar_path_strings, iter_eqns,
+    iter_eqns_with_site, subjaxprs, taint_narrowing, used_invar_mask,
+)

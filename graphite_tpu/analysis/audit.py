@@ -1,0 +1,367 @@
+"""The program auditor: lower a program, run every applicable lint.
+
+`audit()` is the API the tests and `tools/regress.py --smoke` call;
+`python -m graphite_tpu.tools.audit` is the CLI wrapper that emits the
+report as JSON lines.  A ProgramSpec bundles one lowered program (a
+ClosedJaxpr straight from `jax.make_jaxpr` — no compile needed, so the
+auditor runs anywhere, including CPU-only CI) with the context the
+rules need: which invars are absolute clocks (time-dtype taint
+sources), which are sweep knobs (knob-fold), which aval signatures are
+the big directory stores (cond-payload), and whether the program
+believes it is phase-gated (vmap-gate).
+
+The default program set mirrors the shapes every perf round is
+measured on: the per-phase-GATED private-L2 engine, the UNGATED one,
+the shared-L2 engine, and the B=4 vmapped sweep campaign.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from graphite_tpu.analysis import rules
+from graphite_tpu.analysis.walk import invar_path_strings  # noqa: F401
+
+# Invar leaves holding ABSOLUTE simulated times (taint sources for the
+# time-dtype rule).  Everything matching carries int64 picosecond
+# timestamps: running clocks, mailbox/protocol message arrival times,
+# sync-object release/arrival/wake times, in-flight DRAM ready times.
+# Deliberately NOT matched: *_stall_ps / acc_ps / *lat_ps (durations),
+# dyn_ps (per-record costs), quantum/slack scalars.
+CLOCK_LEAF_RE = re.compile(
+    r"(clock_ps|time_ps|_time$|release_ps|arrival_ps|wake_ps|done_ps"
+    r"|ready_ps|seq_ps)")
+
+# Generic cond-payload ceiling: comfortably above every legitimate
+# per-phase payload at audited shapes (mailbox matrices, net rings) and
+# far below the multi-GB directory stores the rule exists to keep out
+# of conds.  The CLI's --max-cond-bytes overrides it.
+DEFAULT_MAX_COND_BYTES = 64 << 20
+
+
+def clock_invar_indices(paths) -> "tuple[int, ...]":
+    return tuple(i for i, p in enumerate(paths)
+                 if CLOCK_LEAF_RE.search(p))
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One lowered program plus the context its lints need."""
+
+    name: str
+    closed: object                    # ClosedJaxpr
+    invar_paths: "list[str]"
+    n_tiles: int
+    expect_gated: bool = False
+    n_phases: int = 6
+    knob_invars: "dict | None" = None   # knob name -> invar indices
+    forbidden_cond_avals: tuple = ()    # ((shape, dtype), ...)
+    clock_invars: tuple = ()
+
+
+def _mem_forbidden_avals(sim):
+    """The big directory-store signatures of `sim`'s memory engine —
+    the stores the round-6 delta plans keep out of every cond.
+
+    Empty when the whole-engine mem_gate is ON: below its size ceiling
+    the gate's lax.cond deliberately carries the ENTIRE memory state —
+    directory included — and pays the double-buffer (that ceiling is
+    the design; see EngineParams.mem_gate).  The contract "no cond
+    output carries a directory store" is the BIG-state regime's
+    (mem_gate off, per-phase conds the only gating).
+
+    Signatures shared with a NON-directory state leaf are dropped: an
+    aval match cannot tell the store apart from, say, a cache meta
+    array of coincidentally equal geometry that legitimately rides the
+    phase conds (the shl2 embedded-dir word shares the L2 meta's
+    int64[T, S2, W2] aval BY CONSTRUCTION — its sharers rows are the
+    observable proxy, detached and re-applied together with it by
+    `_cond_dir`).  The phase-gating test picks collision-free geometry
+    for the same reason."""
+    import jax
+
+    if sim.params.mem is None or sim.params.mem_gate:
+        return ()
+    if sim.params.mem.protocol.startswith("pr_l1_sh_l2"):
+        from graphite_tpu.memory.engine_shl2 import dir_store_avals
+    else:
+        from graphite_tpu.memory.engine import dir_store_avals
+    sigs = dir_store_avals(sim.state.mem)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(sim.state)
+    non_dir = set()
+    for p, leaf in leaves:
+        path = jax.tree_util.keystr(p)
+        if ".directory." not in path and ".dir." not in path \
+                and hasattr(leaf, "shape"):
+            non_dir.add((tuple(leaf.shape), str(leaf.dtype)))
+    return tuple(s for s in sigs if s not in non_dir)
+
+
+def spec_from_simulator(name: str, sim,
+                        max_quanta: int = 4096) -> ProgramSpec:
+    """Lower a Simulator's single-device resident program into a spec."""
+    from graphite_tpu.engine.simulator import mem_phase_names
+
+    closed, paths = sim.lower(max_quanta)
+    expect_gated = (sim.params.mem is not None
+                    and bool(sim.params.mem.phase_gate))
+    n_phases = (len(mem_phase_names(sim.params))
+                if sim.params.mem is not None else 6)
+    return ProgramSpec(
+        name=name, closed=closed, invar_paths=paths,
+        n_tiles=sim.params.n_tiles, expect_gated=expect_gated,
+        n_phases=n_phases,
+        forbidden_cond_avals=_mem_forbidden_avals(sim),
+        clock_invars=clock_invar_indices(paths))
+
+
+def spec_from_sweep(name: str, runner,
+                    max_quanta: int = 4096) -> ProgramSpec:
+    """Lower a SweepRunner's batched campaign program into a spec,
+    mapping each sweep knob to its traced invar indices (knob-fold)."""
+    from graphite_tpu.engine.simulator import mem_phase_names
+    from graphite_tpu.sweep.knobs import KNOB_FIELDS
+
+    closed, paths = runner.lower(max_quanta)
+    knob_invars = {
+        f: [i for i, p in enumerate(paths) if p.endswith("." + f)]
+        for f in KNOB_FIELDS
+    }
+    if runner.sim.quantum_ps is None:
+        # unbounded clock schemes have no quantum for the knob to steer
+        knob_invars.pop("quantum_ps", None)
+    sim = runner.sim
+    mp = sim.params.mem
+    if mp is None:
+        # memoryless campaigns never read the memory knobs by design
+        # (Knobs.from_params zeroes them) — requiring them would fail
+        # every healthy memoryless sweep
+        from graphite_tpu.sweep.knobs import MEM_KNOB_FIELDS
+
+        for f in MEM_KNOB_FIELDS:
+            knob_invars.pop(f, None)
+    elif len(set(mp.module_domains)) == 1:
+        # single-DVFS-domain configs short-circuit every cross-domain
+        # handoff to a Python 0 (MemParams.sync_cycles), so the sync
+        # knob is structurally inert — not a folding bug.  Multi-domain
+        # configs keep it in the required set.
+        knob_invars.pop("sync_delay_cycles", None)
+    expect_gated = (sim.params.mem is not None
+                    and bool(sim.params.mem.phase_gate))
+    n_phases = (len(mem_phase_names(sim.params))
+                if sim.params.mem is not None else 6)
+    return ProgramSpec(
+        name=name, closed=closed, invar_paths=paths,
+        n_tiles=sim.params.n_tiles, expect_gated=expect_gated,
+        n_phases=n_phases, knob_invars=knob_invars,
+        forbidden_cond_avals=_mem_forbidden_avals(sim),
+        clock_invars=clock_invar_indices(paths))
+
+
+# ---------------------------------------------------------------------------
+# default program set
+# ---------------------------------------------------------------------------
+
+
+DEFAULT_PROGRAM_NAMES = ("gated-msi", "ungated-msi", "shl2-mesi",
+                         "sweep-b4")
+
+
+def default_programs(tiles: int = 8, max_quanta: int = 4096,
+                     names=None) -> "list[ProgramSpec]":
+    """The four audited shapes: gated, ungated, shl2, sweep B=4.
+
+    Small geometry on purpose — the lints are structural, so the
+    8-tile lowering carries the same program shape the 1024-tile
+    config-5 run compiles (the phase-gating test separately pins the
+    1024-tile shape).  `names` restricts to a subset of
+    DEFAULT_PROGRAM_NAMES (each lowering costs a few seconds of
+    tracing)."""
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.engine.simulator import Simulator
+    from graphite_tpu.sweep import SweepRunner
+    from graphite_tpu.tools._template import config_text
+    from graphite_tpu.trace import synthetic
+
+    if names is None:
+        names = DEFAULT_PROGRAM_NAMES
+    unknown = set(names) - set(DEFAULT_PROGRAM_NAMES)
+    if unknown:
+        raise ValueError(
+            f"unknown program(s) {sorted(unknown)} "
+            f"(available: {', '.join(DEFAULT_PROGRAM_NAMES)})")
+
+    batch = synthetic.memory_stress_trace(
+        tiles, n_accesses=16, working_set_bytes=1 << 12,
+        write_fraction=0.4, shared_fraction=0.5, seed=7)
+    # cache/directory geometry chosen so the directory entry/sharers
+    # avals are UNIQUE in the program (same trick as the phase-gating
+    # test) — a cache meta array of coincidentally equal shape would
+    # make the cond-payload signature check blind to the store
+    geometry = """
+[l1_icache/T1]
+cache_size = 4
+associativity = 2
+[l1_dcache/T1]
+cache_size = 8
+associativity = 4
+[l2_cache/T1]
+cache_size = 32
+associativity = 8
+[dram_directory]
+total_entries = 64
+associativity = 4
+"""
+    sc = SimConfig(ConfigFile.from_string(config_text(
+        tiles, shared_mem=True, clock_scheme="lax_barrier") + geometry))
+    sc_shl2 = SimConfig(ConfigFile.from_string(config_text(
+        tiles, shared_mem=True, protocol="pr_l1_sh_l2_mesi",
+        clock_scheme="lax_barrier")))
+    # mem_gate_bytes=0: phase conds are the ONLY gating — the config-5
+    # big-state regime the round-6 contract exists for
+    specs = []
+    if "gated-msi" in names:
+        specs.append(spec_from_simulator("gated-msi", Simulator(
+            sc, batch, phase_gate=True, mem_gate_bytes=0), max_quanta))
+    if "ungated-msi" in names:
+        specs.append(spec_from_simulator("ungated-msi", Simulator(
+            sc, batch, phase_gate=False, mem_gate_bytes=0), max_quanta))
+    if "shl2-mesi" in names:
+        specs.append(spec_from_simulator("shl2-mesi", Simulator(
+            sc_shl2, batch, phase_gate=True, mem_gate_bytes=0),
+            max_quanta))
+    if "sweep-b4" in names:
+        # the sweep config splits the modules over TWO DVFS domains so
+        # the sync_delay knob actually crosses a boundary — in a
+        # single-domain config it is structurally inert (MemParams.
+        # sync_cycles returns a Python 0) and spec_from_sweep would
+        # drop it from the required set
+        sc_sweep = SimConfig(ConfigFile.from_string(
+            config_text(tiles, shared_mem=True,
+                        clock_scheme="lax_barrier")
+            + geometry + """
+[dvfs]
+technology_node = 22
+max_frequency = 1.0
+synchronization_delay = 2
+[dvfs/domains]
+domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
+<1.0, DIRECTORY, NETWORK_USER, NETWORK_MEMORY>"
+"""))
+        sweep_traces = [
+            synthetic.memory_stress_trace(
+                tiles, n_accesses=16, working_set_bytes=1 << 12,
+                write_fraction=0.4, shared_fraction=0.5, seed=s)
+            for s in (1, 2, 3, 4)
+        ]
+        runner = SweepRunner(sc_sweep, sweep_traces, shard_batch=False)
+        specs.append(spec_from_sweep("sweep-b4", runner, max_quanta))
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# audit driver
+# ---------------------------------------------------------------------------
+
+RULE_NAMES = ("cond-payload", "knob-fold", "time-dtype", "vmap-gate",
+              "host-sync")
+
+
+@dataclasses.dataclass
+class RuleResult:
+    program: str
+    rule: str
+    findings: "list[rules.Finding]"
+
+    @property
+    def ok(self) -> bool:
+        return not any(f.severity == rules.SEV_ERROR
+                       for f in self.findings)
+
+    def to_json(self) -> dict:
+        return {"program": self.program, "rule": self.rule,
+                "status": "pass" if not self.findings
+                else ("fail" if not self.ok else "warn"),
+                "findings": [f.to_json() for f in self.findings]}
+
+
+@dataclasses.dataclass
+class AuditReport:
+    results: "list[RuleResult]"
+
+    @property
+    def findings(self) -> "list[rules.Finding]":
+        return [f for r in self.results for f in r.findings]
+
+    @property
+    def errors(self) -> "list[rules.Finding]":
+        return [f for f in self.findings
+                if f.severity == rules.SEV_ERROR]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def programs(self) -> "list[str]":
+        seen = []
+        for r in self.results:
+            if r.program not in seen:
+                seen.append(r.program)
+        return seen
+
+    def summary_rows(self) -> "list[dict]":
+        rows = []
+        for prog in self.programs():
+            rs = [r for r in self.results if r.program == prog]
+            n_err = sum(1 for r in rs for f in r.findings
+                        if f.severity == rules.SEV_ERROR)
+            n_warn = sum(1 for r in rs for f in r.findings
+                         if f.severity == rules.SEV_WARNING)
+            rows.append({"program": prog, "summary": True,
+                         "rules_run": len(rs), "errors": n_err,
+                         "warnings": n_warn, "ok": n_err == 0})
+        return rows
+
+
+def audit_program(spec: ProgramSpec, *,
+                  max_cond_bytes: "int | None" = DEFAULT_MAX_COND_BYTES,
+                  ) -> "list[RuleResult]":
+    """Run every applicable rule on one lowered program."""
+    results = []
+
+    def add(rule, findings):
+        for f in findings:
+            f.program = spec.name
+        results.append(RuleResult(spec.name, rule, findings))
+
+    add("cond-payload", rules.cond_payload(
+        spec.closed, max_bytes=max_cond_bytes,
+        forbidden=spec.forbidden_cond_avals))
+    if spec.knob_invars is not None:
+        add("knob-fold", rules.knob_fold(
+            spec.closed, spec.knob_invars, spec.invar_paths))
+    add("time-dtype", rules.time_dtype(
+        spec.closed, spec.clock_invars, spec.invar_paths))
+    add("vmap-gate", rules.vmap_gate(
+        spec.closed, spec.n_tiles, spec.expect_gated,
+        n_phases=spec.n_phases))
+    add("host-sync", rules.host_sync(spec.closed))
+    return results
+
+
+def audit(specs: "list[ProgramSpec] | None" = None, *,
+          tiles: int = 8,
+          max_cond_bytes: "int | None" = DEFAULT_MAX_COND_BYTES,
+          max_quanta: int = 4096) -> AuditReport:
+    """Audit `specs` (default: the four default-config programs).
+
+    Pure static analysis over `jax.make_jaxpr` output — no compile, no
+    execution, runs on CPU.  `report.ok` is False iff any error-severity
+    finding fired (warnings — e.g. vmap-gate — do not fail the audit)."""
+    if specs is None:
+        specs = default_programs(tiles, max_quanta)
+    results = []
+    for spec in specs:
+        results.extend(audit_program(spec, max_cond_bytes=max_cond_bytes))
+    return AuditReport(results)

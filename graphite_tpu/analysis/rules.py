@@ -1,0 +1,300 @@
+"""Jaxpr invariant lints: the rules the program auditor runs.
+
+Each rule checks one property Graphite's performance story depends on,
+on the LOWERED program (a ClosedJaxpr from `jax.make_jaxpr`) — the
+artifact the compiler actually sees, so a regression cannot hide behind
+a Python-level abstraction:
+
+  cond-payload  no lax.cond output may carry a big store (round 6: the
+                directory entry/sharers must ride `_DirAcc`/`_RowAcc`
+                delta plans, because XLA double-buffers cond outputs)
+  knob-fold     every sweep timing knob must be CONSUMED as a traced
+                operand (round 7: a knob the engine reads off static
+                params instead constant-folds — one recompile per grid
+                point and a silently wrong sweep report)
+  time-dtype    no integer narrowing of values derived from absolute
+                picosecond clocks (time_types.TIME_DTYPE discipline;
+                deltas/latencies are legitimately int32)
+  vmap-gate     a program built with phase_gate=True whose gating conds
+                lowered to both-branch selects (vmap batching) is paying
+                gating's bookkeeping and buying nothing (round-7 PERF
+                finding — SweepRunner defaults gates off under vmap)
+  host-sync     no callback/infeed/outfeed primitive inside the compiled
+                step (a host round trip costs ~100 ms over a tunneled
+                chip — the whole reason the quantum loop is
+                device-driven)
+
+Rules return `Finding` lists; `analysis/audit.py` assembles them into
+per-program reports and the `tools/audit.py` CLI emits them as JSON
+lines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from graphite_tpu.analysis.walk import (
+    aval_bytes, aval_sig, iter_eqns_with_site, taint_narrowing,
+    used_invar_mask,
+)
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one program site."""
+
+    rule: str
+    severity: str          # SEV_ERROR | SEV_WARNING
+    site: str              # primitive path, e.g. "while/body.cond"
+    message: str
+    program: "str | None" = None   # filled in by audit()
+    data: dict = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        out = {"rule": self.rule, "severity": self.severity,
+               "site": self.site, "message": self.message}
+        if self.program is not None:
+            out["program"] = self.program
+        if self.data:
+            out["data"] = self.data
+        return out
+
+    def __str__(self) -> str:
+        prog = f"{self.program}: " if self.program else ""
+        return f"[{self.rule}/{self.severity}] {prog}{self.message} " \
+               f"(at {self.site})"
+
+
+def _sig_matches(sig, forbidden_sig) -> bool:
+    """Aval signature match, ignoring leading batch axes: a vmapped
+    program carries the same store as [B, *shape]."""
+    if sig is None:
+        return False
+    shape, dtype = sig
+    fshape, fdtype = forbidden_sig
+    if dtype != fdtype or len(shape) < len(fshape):
+        return False
+    return tuple(shape[len(shape) - len(fshape):]) == tuple(fshape)
+
+
+# ---------------------------------------------------------------------------
+# rule 1: cond-payload
+# ---------------------------------------------------------------------------
+
+
+def cond_payload(jaxpr, *, max_bytes: "int | None" = None,
+                 forbidden=()) -> "list[Finding]":
+    """No lax.cond output may exceed `max_bytes` or match a `forbidden`
+    (shape, dtype) signature (the directory stores).
+
+    XLA double-buffers cond branch outputs, so a big array riding a cond
+    costs a full extra copy in HBM every iteration — the round-2
+    pathology that round 6's `_DirAcc`/`_RowAcc` delta plans exist to
+    avoid.  Checked for EVERY cond at EVERY nesting depth, not just the
+    one a test happens to sample.
+    """
+    forbidden = tuple((tuple(s), str(np.dtype(d))) for s, d in forbidden)
+    out = []
+    for site, eqn in iter_eqns_with_site(jaxpr):
+        if eqn.primitive.name != "cond":
+            continue
+        for k, v in enumerate(eqn.outvars):
+            sig = aval_sig(v.aval)
+            for fsig in forbidden:
+                if _sig_matches(sig, fsig):
+                    out.append(Finding(
+                        "cond-payload", SEV_ERROR, site,
+                        f"lax.cond output {k} carries a forbidden store "
+                        f"{sig[0]} {sig[1]} — it will be double-buffered "
+                        f"(round-6 _DirAcc/_RowAcc contract)",
+                        data={"output": k, "shape": list(sig[0]),
+                              "dtype": sig[1],
+                              "bytes": aval_bytes(v.aval)}))
+                    break
+            else:
+                b = aval_bytes(v.aval)
+                if max_bytes is not None and b > max_bytes:
+                    sig = sig or ((), "?")
+                    out.append(Finding(
+                        "cond-payload", SEV_ERROR, site,
+                        f"lax.cond output {k} is {b} bytes "
+                        f"({sig[0]} {sig[1]}) > max_cond_bytes="
+                        f"{max_bytes} — cond outputs are double-buffered",
+                        data={"output": k, "bytes": b,
+                              "shape": list(sig[0]), "dtype": sig[1]}))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 2: knob-fold
+# ---------------------------------------------------------------------------
+
+
+def knob_fold(jaxpr, knob_invars: "dict[str, list[int]]",
+              invar_paths=None) -> "list[Finding]":
+    """Every sweep knob's invar must be transitively consumed by the
+    lowered program.
+
+    A knob leaf that reaches the jit as an argument but feeds no eqn
+    means the engine read the STATIC param instead — the value is
+    constant-folded, the sweep reports knob points that never entered
+    the program, and every grid point recompiles (the round-7 zero-
+    recompile contract).
+    """
+    mask = used_invar_mask(jaxpr)
+    out = []
+    for name, idxs in sorted(knob_invars.items()):
+        if not idxs:
+            out.append(Finding(
+                "knob-fold", SEV_ERROR, "jaxpr.invars",
+                f"knob {name!r} has no traced invar at all — it was "
+                f"baked into the program as a literal",
+                data={"knob": name}))
+            continue
+        if not any(mask[i] for i in idxs if i < len(mask)):
+            paths = ([invar_paths[i] for i in idxs]
+                     if invar_paths else idxs)
+            out.append(Finding(
+                "knob-fold", SEV_ERROR, "jaxpr.invars",
+                f"knob {name!r} rides as a traced argument but nothing "
+                f"consumes it — the engine constant-folded the static "
+                f"param value instead (invars {paths})",
+                data={"knob": name, "invars": list(idxs)}))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 3: time-dtype
+# ---------------------------------------------------------------------------
+
+
+def time_dtype(jaxpr, clock_invars, invar_paths=None) -> "list[Finding]":
+    """No integer narrowing of values derived from absolute picosecond
+    clocks (the `clock_invars` taint sources — TIME_DTYPE leaves).
+
+    A 1 GHz tile overflows int32 picoseconds after ~2 ms of simulated
+    time, so absolute clocks are int64 everywhere (time_types.py).
+    Taint stops at subtraction — a difference of clocks is a delta,
+    which the engine legitimately keeps in int32 (DELTA_DTYPE).
+    """
+    j = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    n = len(j.invars)
+    in_taint = [False] * n
+    for i in clock_invars:
+        if i < n:
+            in_taint[i] = True
+    out = []
+
+    def on_finding(site, eqn, old, new):
+        out.append(Finding(
+            "time-dtype", SEV_ERROR, site,
+            f"value derived from an absolute picosecond clock is "
+            f"narrowed {np.dtype(old).name} -> {np.dtype(new).name} "
+            f"(TIME_DTYPE discipline: absolute times stay int64; only "
+            f"deltas may narrow)",
+            data={"from": np.dtype(old).name, "to": np.dtype(new).name}))
+
+    taint_narrowing(jaxpr, in_taint, on_finding)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 4: vmap-gate
+# ---------------------------------------------------------------------------
+
+
+def phase_conds(jaxpr, n_tiles: int) -> list:
+    """(site, eqn) of every cond that writes a mailbox type matrix —
+    the memory engines' per-phase gating conds (each protocol phase
+    writes at least one uint8[.., T, T] matrix, and nothing else in the
+    program emits one as a cond output; see tests/test_phase_gating)."""
+    out = []
+    for site, eqn in iter_eqns_with_site(jaxpr):
+        if eqn.primitive.name == "cond" \
+                and _mailbox_outputs(eqn, n_tiles):
+            out.append((site, eqn))
+    return out
+
+
+def _mailbox_outputs(eqn, n_tiles: int) -> list:
+    outs = []
+    for v in eqn.outvars:
+        sig = aval_sig(v.aval)
+        if sig and len(sig[0]) >= 2 and sig[0][-2:] == (n_tiles, n_tiles) \
+                and sig[1] == "uint8":
+            outs.append(sig)
+    return outs
+
+
+def vmap_gate(jaxpr, n_tiles: int, expect_gated: bool,
+              n_phases: int = 6) -> "list[Finding]":
+    """A phase_gate=True program whose gating conds did not survive
+    lowering is gating in name only.
+
+    `vmap` batches a cond's predicate, which rewrites the cond into
+    both-branch execution + `select_n` — every phase then runs every
+    iteration AND pays the select (PERF.md round 7 measured gated-vmap
+    ~2.8x slower than ungated-vmap; SweepRunner therefore defaults
+    gates OFF in vmapped programs).  Warning severity: the program is
+    correct, just paying for a mechanism that buys nothing.
+    """
+    if not expect_gated:
+        return []
+    conds = phase_conds(jaxpr, n_tiles)
+    if len(conds) >= n_phases:
+        return []
+    n_sel = sum(1 for _, e in iter_eqns_with_site(jaxpr)
+                if e.primitive.name == "select_n"
+                and _mailbox_outputs(e, n_tiles))
+    if not conds:
+        return [Finding(
+            "vmap-gate", SEV_WARNING, "jaxpr",
+            f"program was built with phase_gate=True but NO per-phase "
+            f"gating cond survived lowering ({n_sel} mailbox-shaped "
+            f"select_n eqns present) — batching turned the gates into "
+            f"both-branch selects; run the batched program ungated "
+            f"(SweepRunner's default) or shard the batch axis",
+            data={"phase_conds": 0, "mailbox_selects": n_sel})]
+    return [Finding(
+        "vmap-gate", SEV_WARNING, "jaxpr",
+        f"only {len(conds)} of {n_phases} per-phase gating conds "
+        f"survived lowering ({n_sel} mailbox-shaped select_n eqns "
+        f"present) — part of the engine runs both branches every "
+        f"iteration",
+        data={"phase_conds": len(conds), "mailbox_selects": n_sel})]
+
+
+# ---------------------------------------------------------------------------
+# rule 5: host-sync
+# ---------------------------------------------------------------------------
+
+_HOST_SYNC_NAMES = ("infeed", "outfeed")
+_HOST_SYNC_SUBSTR = ("callback",)
+
+
+def host_sync(jaxpr) -> "list[Finding]":
+    """No host round trip inside the compiled step.
+
+    callback/infeed/outfeed primitives block the device on the host
+    every iteration — ~100 ms per round trip over a tunneled chip,
+    which is why the quantum loop is device-driven (engine/step.
+    run_simulation) and why `barrier_host` batches its dispatches.
+    A debug print left in an engine phase reintroduces exactly that.
+    """
+    out = []
+    for site, eqn in iter_eqns_with_site(jaxpr):
+        name = eqn.primitive.name
+        if name in _HOST_SYNC_NAMES \
+                or any(s in name for s in _HOST_SYNC_SUBSTR):
+            out.append(Finding(
+                "host-sync", SEV_ERROR, site,
+                f"host-synchronizing primitive {name!r} inside the "
+                f"compiled step — every iteration would pay a "
+                f"host<->device round trip (~100 ms tunneled)",
+                data={"primitive": name}))
+    return out

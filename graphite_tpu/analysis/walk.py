@@ -1,0 +1,392 @@
+"""Reusable jaxpr traversal: the program auditor's walker.
+
+jax programs arrive as nested jaxprs: `cond` carries one branch jaxpr
+per arm, `while` a cond and a body, `scan`/`pjit`/`remat`/custom-
+derivative calls one inner jaxpr each — and `vmap` leaves no call at
+all (batching rewrites eqns in place, which is exactly why a gated
+cond can silently become a both-branch select under it).  Every
+auditor rule (analysis/rules.py) and every structural test assertion
+walks the SAME recursion below — the traversal the round-6
+phase-gating test used to keep as a private `_walk_eqns` helper.
+
+Three layers:
+ - `iter_eqns` / `iter_eqns_with_site`: flat iteration over every eqn
+   at every nesting depth (site strings name the path for findings);
+ - `call_arg_maps`: the structural operand<->sub-jaxpr wiring of the
+   call-like primitives, so dataflow analyses can cross call
+   boundaries instead of stopping at them;
+ - `used_invar_mask` / `taint_narrowing`: the two dataflow passes the
+   rules are built on — "is this input ever consumed?" (knob-fold)
+   and "does a value derived from this input get integer-narrowed?"
+   (time-dtype).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+
+def as_jaxpr(j):
+    """Normalize ClosedJaxpr | Jaxpr -> Jaxpr."""
+    inner = getattr(j, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner
+    return j
+
+
+def subjaxprs(eqn):
+    """Yield (tag, Jaxpr) for every sub-jaxpr in eqn.params.
+
+    Handles both ClosedJaxpr-valued params (cond branches, while
+    cond/body, scan/pjit jaxprs) and raw-Jaxpr values, singly or in
+    tuples/lists — the same duck-typing the primitives themselves use.
+    """
+    for name, val in eqn.params.items():
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for i, v in enumerate(vals):
+            tag = name if len(vals) == 1 else f"{name}[{i}]"
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield tag, inner
+            elif hasattr(v, "eqns"):
+                yield tag, v
+
+
+def iter_eqns_with_site(jaxpr, _site=""):
+    """Depth-first (eqn-order) walk yielding (site, eqn) at every
+    nesting depth.  `site` is a readable path like
+    "while/body.cond/branches[1].scatter-add"."""
+    j = as_jaxpr(jaxpr)
+    for eqn in j.eqns:
+        here = (f"{_site}.{eqn.primitive.name}" if _site
+                else eqn.primitive.name)
+        yield here, eqn
+        for tag, inner in subjaxprs(eqn):
+            yield from iter_eqns_with_site(inner, f"{here}/{tag}")
+
+
+def iter_eqns(jaxpr):
+    """Every eqn of `jaxpr` and all its sub-jaxprs, depth-first."""
+    for _, eqn in iter_eqns_with_site(jaxpr):
+        yield eqn
+
+
+def find_eqns(jaxpr, primitive_name: str):
+    """All (site, eqn) whose primitive is named `primitive_name`."""
+    return [(s, e) for s, e in iter_eqns_with_site(jaxpr)
+            if e.primitive.name == primitive_name]
+
+
+def aval_bytes(aval) -> int:
+    """Byte size of an abstract value (0 for non-array avals)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * np.dtype(dtype).itemsize
+
+
+def aval_sig(aval):
+    """Normalized (shape, dtype-string) signature, or None."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    return (tuple(int(d) for d in shape), str(np.dtype(dtype)))
+
+
+def invar_path_strings(args) -> "list[str]":
+    """keystr paths of `args`' pytree leaves, in flatten order — which
+    is exactly the invar order `jax.make_jaxpr(fn)(*args)` produces, so
+    path i names closed.jaxpr.invars[i] (None leaves drop from both)."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(args)
+    return [jax.tree_util.keystr(p) for p, _ in leaves]
+
+
+# ---------------------------------------------------------------------------
+# operand <-> sub-jaxpr wiring of the call-like primitives
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SubCall:
+    """One sub-jaxpr of a call-like eqn plus its wiring.
+
+    in_map[i]   = eqn operand index feeding inner invar i (None: none)
+    out_map[o]  = eqn outvar index fed by inner outvar o (None: none)
+    feedback[o] = inner invar index inner outvar o loops back into
+                  (while/scan carries), None otherwise
+    """
+
+    jaxpr: object
+    in_map: list
+    out_map: list
+    feedback: list
+
+
+def _direct(jaxpr, eqn):
+    j = as_jaxpr(jaxpr)
+    n_in, n_out = len(j.invars), len(j.outvars)
+    return SubCall(j, list(range(min(n_in, len(eqn.invars))))
+                   + [None] * max(0, n_in - len(eqn.invars)),
+                   [o if o < len(eqn.outvars) else None
+                    for o in range(n_out)],
+                   [None] * n_out)
+
+
+def call_arg_maps(eqn) -> "list[SubCall] | None":
+    """Structural wiring of a call-like eqn's sub-jaxprs.
+
+    Returns None when the primitive has no sub-jaxprs; conservative
+    1:1-mapped SubCalls for unknown call-likes whose arity lines up.
+    """
+    name = eqn.primitive.name
+    p = eqn.params
+    if name == "cond":
+        out = []
+        for br in p["branches"]:
+            j = as_jaxpr(br)
+            in_map = [k + 1 for k in range(len(j.invars))]  # skip pred
+            out_map = list(range(len(j.outvars)))
+            out.append(SubCall(j, in_map, out_map,
+                               [None] * len(j.outvars)))
+        return out
+    if name == "while":
+        cj, bj = as_jaxpr(p["cond_jaxpr"]), as_jaxpr(p["body_jaxpr"])
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        n_carry = len(bj.outvars)
+        # eqn.invars = cond_consts + body_consts + init_carry
+        cond_in = ([k for k in range(cn)]
+                   + [cn + bn + k for k in range(n_carry)])
+        body_in = ([cn + k for k in range(bn)]
+                   + [cn + bn + k for k in range(n_carry)])
+        return [
+            SubCall(cj, cond_in, [None] * len(cj.outvars),
+                    [None] * len(cj.outvars)),
+            SubCall(bj, body_in, list(range(n_carry)),
+                    [bn + k for k in range(n_carry)]),
+        ]
+    if name == "scan":
+        j = as_jaxpr(p["jaxpr"])
+        nc, ncar = p["num_consts"], p["num_carry"]
+        n_out = len(j.outvars)
+        return [SubCall(
+            j, list(range(len(j.invars))),
+            list(range(n_out)),
+            [nc + k if k < ncar else None for k in range(n_out)])]
+    if name in ("pjit", "closed_call", "core_call", "xla_call",
+                "custom_jvp_call", "custom_vjp_call", "remat",
+                "checkpoint", "custom_vjp_call_jaxpr", "remat2"):
+        j = p.get("jaxpr") or p.get("call_jaxpr") or p.get("fun_jaxpr")
+        if j is not None and hasattr(as_jaxpr(j), "eqns"):
+            return [_direct(j, eqn)]
+        return None
+    # unknown primitive: if it carries sub-jaxprs whose invar count
+    # matches the eqn's operand count, assume direct wiring
+    subs = list(subjaxprs(eqn))
+    if not subs:
+        return None
+    out = []
+    for _, j in subs:
+        jj = as_jaxpr(j)
+        if len(jj.invars) == len(eqn.invars):
+            out.append(_direct(jj, eqn))
+        else:
+            return []  # sub-jaxprs exist but wiring unknown: signal "opaque"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dataflow pass 1: is an input ever consumed?  (knob-fold)
+# ---------------------------------------------------------------------------
+
+
+def used_invar_mask(jaxpr, *, count_outvars=False, _memo=None) -> "list[bool]":
+    """Per-invar flag: does anything in the (recursively walked) program
+    consume this input?
+
+    An invar is "used" when it feeds any eqn — for call-like eqns, only
+    when the corresponding inner invar is itself used (recursively), so
+    a value merely threaded through a while carry untouched does not
+    count at the top level unless `count_outvars` (inner jaxprs pass
+    True: their outputs flow onward).  Over-approximates liveness (an
+    eqn computing a dead value still counts as a use) — make_jaxpr
+    output is not DCE'd, and tracing never records a value nothing
+    consumed, so the approximation errs loud, not silent.
+    """
+    if _memo is None:
+        _memo = {}
+    j = as_jaxpr(jaxpr)
+    key = (id(j), bool(count_outvars))
+    if key in _memo:
+        return _memo[key]
+    used = set()
+    if count_outvars:
+        for v in j.outvars:
+            if not isinstance(v, jax.core.Literal):
+                used.add(v)
+    for eqn in j.eqns:
+        subs = call_arg_maps(eqn)
+        if subs is None:
+            for v in eqn.invars:
+                if not isinstance(v, jax.core.Literal):
+                    used.add(v)
+        elif not subs:  # opaque call-like: conservatively all-used
+            for v in eqn.invars:
+                if not isinstance(v, jax.core.Literal):
+                    used.add(v)
+        else:
+            for sc in subs:
+                inner = used_invar_mask(sc.jaxpr, count_outvars=True,
+                                        _memo=_memo)
+                for i, u in enumerate(inner):
+                    if u and i < len(sc.in_map) \
+                            and sc.in_map[i] is not None:
+                        v = eqn.invars[sc.in_map[i]]
+                        if not isinstance(v, jax.core.Literal):
+                            used.add(v)
+    mask = [v in used for v in j.invars]
+    _memo[key] = mask
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# dataflow pass 2: forward time-taint + integer-narrowing detection
+# ---------------------------------------------------------------------------
+
+# Primitives through which "absolute simulated time" does NOT propagate:
+# differences (latencies/deltas — legitimately int32, time_types.
+# DELTA_DTYPE), ratios/remainders (quantum phases, ring slots),
+# predicates, bit twiddling, and index-producing reductions.
+TAINT_STOP = frozenset({
+    "sub", "div", "rem", "eq", "ne", "lt", "le", "gt", "ge",
+    "and", "or", "xor", "not", "shift_left", "shift_right_logical",
+    "shift_right_arithmetic", "argmin", "argmax", "reduce_and",
+    "reduce_or", "iota", "sign", "population_count", "clz",
+    "is_finite", "stop_gradient",
+})
+
+_INT_KINDS = ("i", "u")
+
+
+def _is_narrowing(old_dtype, new_dtype) -> bool:
+    o, n = np.dtype(old_dtype), np.dtype(new_dtype)
+    return (o.kind in _INT_KINDS and n.kind in _INT_KINDS
+            and n.itemsize < o.itemsize)
+
+
+def taint_narrowing(jaxpr, in_taint, on_finding=None, _site="",
+                    _depth=0) -> "list[bool]":
+    """Forward taint from `in_taint`-marked invars; report every integer
+    narrowing of a tainted value via `on_finding(site, eqn, old, new)`.
+
+    Taint propagates through value-preserving/monotone arithmetic (add,
+    mul, min/max, selects, data movement, scatters, reductions) and
+    crosses call boundaries (cond/while/scan/pjit) via `call_arg_maps`,
+    iterating loop carries to a fixpoint.  It STOPS at `TAINT_STOP` —
+    a difference of two absolute clocks is a delta, which the engine
+    legitimately keeps in int32 (time_types.DELTA_DTYPE).  Returns the
+    outvar taint mask.
+    """
+    j = as_jaxpr(jaxpr)
+    env = {}
+    for v, t in zip(j.invars, in_taint):
+        env[v] = bool(t)
+
+    def get(v):
+        return (not isinstance(v, jax.core.Literal)) and env.get(v, False)
+
+    for eqn in j.eqns:
+        site = (f"{_site}.{eqn.primitive.name}" if _site
+                else eqn.primitive.name)
+        tin = [get(v) for v in eqn.invars]
+        name = eqn.primitive.name
+        subs = call_arg_maps(eqn)
+        if subs:
+            out_taint = [False] * len(eqn.outvars)
+
+            def inner_taint(sc, jj, marks):
+                return [marks[sc.in_map[i]]
+                        if i < len(sc.in_map)
+                        and sc.in_map[i] is not None else False
+                        for i in range(len(jj.invars))]
+
+            # Stabilize loop-carry taint FIRST, at the eqn-operand
+            # level: a carry that becomes tainted in a later iteration
+            # taints that operand position for EVERY sub-jaxpr —
+            # including the while-COND's copy of it, which has no
+            # feedback edges of its own (a narrowing in the loop
+            # condition must still be reported).
+            tin_eff = list(tin)
+            for sc in subs:
+                if not any(f is not None for f in sc.feedback):
+                    continue
+                jj = as_jaxpr(sc.jaxpr)
+                for _ in range(len(jj.outvars) + 2):
+                    inner_out = taint_narrowing(
+                        jj, inner_taint(sc, jj, tin_eff), None, site,
+                        _depth + 1)
+                    changed = False
+                    for o, fb in enumerate(sc.feedback):
+                        if fb is None or not inner_out[o] \
+                                or fb >= len(sc.in_map):
+                            continue
+                        op_i = sc.in_map[fb]
+                        if op_i is not None and not tin_eff[op_i]:
+                            tin_eff[op_i] = True
+                            changed = True
+                    if not changed:
+                        break
+            # one reporting pass per sub-jaxpr with the stable marks
+            for sc in subs:
+                jj = as_jaxpr(sc.jaxpr)
+                inner_out = taint_narrowing(
+                    jj, inner_taint(sc, jj, tin_eff), on_finding, site,
+                    _depth + 1)
+                for o, t in enumerate(inner_out):
+                    if t and o < len(sc.out_map) \
+                            and sc.out_map[o] is not None:
+                        out_taint[sc.out_map[o]] = True
+            for v, t in zip(eqn.outvars, out_taint):
+                env[v] = t
+            continue
+        if subs == []:  # opaque call-like: conservative taint-through
+            t = any(tin)
+            for v in eqn.outvars:
+                env[v] = t
+            continue
+        if name == "convert_element_type":
+            old = getattr(eqn.invars[0].aval, "dtype", None)
+            new = eqn.params.get("new_dtype")
+            if tin[0] and old is not None and new is not None \
+                    and _is_narrowing(old, new):
+                if on_finding is not None:
+                    on_finding(site, eqn, old, new)
+                env[eqn.outvars[0]] = False  # reported; don't cascade
+            else:
+                env[eqn.outvars[0]] = tin[0]
+            continue
+        if name.startswith("scatter"):
+            # scatter(operand, indices, updates): tainted updates landing
+            # in a narrower accumulator is an int32 time accumulation
+            upd_i = 2 if len(eqn.invars) > 2 else len(eqn.invars) - 1
+            tgt = getattr(eqn.invars[0].aval, "dtype", None)
+            upd = getattr(eqn.invars[upd_i].aval, "dtype", None)
+            if tin[upd_i] and tgt is not None and upd is not None \
+                    and _is_narrowing(upd, tgt):
+                if on_finding is not None:
+                    on_finding(site, eqn, upd, tgt)
+                env[eqn.outvars[0]] = False
+            else:
+                env[eqn.outvars[0]] = tin[0] or tin[upd_i]
+            continue
+        tainted = any(tin) and name not in TAINT_STOP
+        for v in eqn.outvars:
+            env[v] = tainted
+    return [get(v) for v in j.outvars]

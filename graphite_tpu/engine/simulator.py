@@ -798,6 +798,49 @@ class Simulator:
             self._runner_max_quanta = max_quanta
         return self._runner
 
+    def lower(self, max_quanta: int = 4096):
+        """The compiled program as a ClosedJaxpr, plus its flat invar
+        paths — the program auditor's input (analysis/audit.py).
+
+        Lowers the program run() actually compiles: the single-region
+        device-driven loop, or — for barrier_host sims — the bounded
+        batched host-dispatch region (`engine/step.barrier_host_batch`,
+        with its dynamic prev_qend/budget operands), so audit verdicts
+        certify the executed artifact.  `jax.make_jaxpr` only: pure
+        tracing, no compile, so auditing works on CPU-only CI.  Path i
+        of the returned list names closed.jaxpr.invars[i] (state leaves
+        first, then trace leaves)."""
+        if self.mesh is not None or self.stream:
+            raise ValueError(
+                "lower() supports single-device resident programs only "
+                "(the auditable artifact is the one-region jaxpr)")
+        from graphite_tpu.analysis.walk import invar_path_strings
+
+        params = self.params
+        if self.barrier_host:
+            from graphite_tpu.engine.step import barrier_host_batch
+
+            qps = int(self.quantum_ps)
+
+            def fn(st, tr, prev_qend, budget):
+                return barrier_host_batch(params, tr, st, prev_qend,
+                                          qps, budget)
+
+            args = (self.state, self.device_trace,
+                    jnp.asarray(0, jnp.int64),
+                    jnp.asarray(self.barrier_batch, jnp.int32))
+        else:
+            from graphite_tpu.engine.step import run_simulation
+
+            qps = self.quantum_ps
+
+            def fn(st, tr):
+                return run_simulation(params, tr, st, qps, max_quanta)
+
+            args = (self.state, self.device_trace)
+        closed = jax.make_jaxpr(fn)(*args)
+        return closed, invar_path_strings(args)
+
     def run_chunk(self, n_quanta: int):
         """Run at most `n_quanta` quanta (for sampled/checkpointed runs).
 

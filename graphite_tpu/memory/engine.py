@@ -594,6 +594,21 @@ PHASE_NAMES = ("requester", "home_evict", "home_start", "sharer",
                "home_finish", "requester_fill")
 
 
+def dir_store_avals(ms) -> tuple:
+    """(shape, dtype) signatures of the big directory stores — the
+    [T, DS, DW] packed entry words and [T, DS, DW*SW] sharers bitvector
+    — that a gated home phase must NEVER return as lax.cond outputs
+    (they'd be double-buffered; the `_DirAcc` delta plan exists so the
+    cond carries compact per-lane deltas instead).  The program
+    auditor's cond-payload rule (analysis/rules.py) enforces this for
+    every cond in the lowered program."""
+    d = ms.directory
+    return (
+        (tuple(d.entry.shape), str(d.entry.dtype)),
+        (tuple(d.sharers.shape), str(d.sharers.dtype)),
+    )
+
+
 def mem_idle_out(mp: MemParams, ms, rec: "RecView", enabled) -> MemStepOut:
     """The engine step's result when there is provably nothing to do —
     no lane's record carries memory slots and no protocol state is live

@@ -76,6 +76,20 @@ FAR = 2**62
 SHL2_PHASE_NAMES = ("requester", "sharer", "home_evict", "home_finish",
                     "home_start", "requester_fill")
 
+
+def dir_store_avals(ms) -> tuple:
+    """(shape, dtype) signatures of the embedded directory's big stores
+    — the [T, S2, W2] packed words and [T, S2, W2*SW] sharer rows —
+    that a gated shl2 home phase must NEVER return as lax.cond outputs
+    (the `_RowAcc` row-delta plan carries them instead; see `_cond_dir`).
+    Enforced program-wide by the auditor's cond-payload rule
+    (analysis/rules.py)."""
+    d = ms.dir
+    return (
+        (tuple(d.word.shape), str(d.word.dtype)),
+        (tuple(d.sharers.shape), str(d.sharers.dtype)),
+    )
+
 # L2 slice data state (`cache_line_info.h` ShL2CacheLineInfo): the line is
 # allocated (directory live) but its data is still in flight from DRAM
 DATA_INVALID = 5

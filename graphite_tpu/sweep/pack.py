@@ -81,10 +81,34 @@ class PackedTraces:
 
 
 def pack_traces(batches: "list[TraceBatch]",
-                seeds: "list[int] | None" = None) -> PackedTraces:
-    """Pad B same-geometry TraceBatches to a common [B, T, L] layout."""
+                seeds: "list[int] | None" = None, *,
+                validate: bool = True) -> PackedTraces:
+    """Pad B same-geometry TraceBatches to a common [B, T, L] layout.
+
+    Every sim is statically validated first (trace/validate.py:
+    op-code range, SEND/RECV pairing, barrier participant-count
+    consistency) so a malformed campaign trace fails fast with a named
+    `TraceValidationError` instead of padding silently and deadlocking
+    — or crashing the TPU worker — minutes into the compiled run.
+    `validate=False` skips the pass (e.g. deliberately pathological
+    test traces)."""
     if not batches:
         raise ValueError("pack_traces needs at least one trace")
+    if validate:
+        from graphite_tpu.trace.validate import (
+            TraceValidationError, validate_batch,
+        )
+
+        seen: set = set()  # seed x grid campaigns repeat the same object
+        for i, b in enumerate(batches):
+            if id(b) in seen:
+                continue
+            seen.add(id(b))
+            try:
+                validate_batch(b)
+            except TraceValidationError as e:
+                raise TraceValidationError(
+                    f"sim {i}: {e}", findings=e.findings) from None
     T = batches[0].n_tiles
     bad = [i for i, b in enumerate(batches) if b.n_tiles != T]
     if bad:

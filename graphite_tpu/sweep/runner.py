@@ -48,16 +48,23 @@ class SweepOutcome:
     n_quanta: np.ndarray          # int32[B]
     phase_skips: "list[dict] | None"  # per-sim gate skip counts (or None)
     seeds: "np.ndarray | None" = None  # per-sim trace seeds (pack metadata)
+    # False for unbounded clock schemes (lax/lax_p2p): there is no
+    # quantum in the program, so reporting the knob would claim a value
+    # that never entered it
+    quantum_valid: bool = True
 
     def json_rows(self) -> "list[dict]":
         """One JSON-able dict per sim (the CLI's output lines)."""
         rows = []
         for b, r in enumerate(self.results):
+            point = self.knobs.point(b)
+            if not self.quantum_valid:
+                point.pop("quantum_ps", None)
             rows.append({
                 "sim": b,
                 **({"seed": int(self.seeds[b])}
                    if self.seeds is not None else {}),
-                **self.knobs.point(b),
+                **point,
                 "completion_time_ns": r.completion_time_ps // 1000,
                 "total_instructions": r.total_instructions,
                 "n_quanta": int(self.n_quanta[b]),
@@ -187,45 +194,89 @@ class SweepRunner:
     def n_sims(self) -> int:
         return self.pack.n_sims
 
+    def _runner_fn(self, max_quanta: int):
+        """The (unjitted) batched campaign function — `_get_runner`
+        jits it; `lower()` hands it to `jax.make_jaxpr` for the
+        program auditor."""
+        from graphite_tpu.engine.step import run_simulation
+
+        params = self.sim.params
+        unbounded = self.sim.quantum_ps is None
+
+        def one(state, trace, kn):
+            q = None if unbounded else kn.quantum_ps
+            return run_simulation(params, trace, state, q, max_quanta,
+                                  knobs=kn)
+
+        if not self.shard_batch:
+            return jax.vmap(one)
+
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from graphite_tpu.parallel.mesh import _shard_map
+
+        K = self._sims_per_dev
+        mesh = Mesh(np.array(jax.devices()), ("b",))
+
+        def per_device(state, trace, kn):
+            if K > 1:
+                return jax.vmap(one)(state, trace, kn)
+            # one sim per device: strip the [1] batch dim and run
+            # the plain UNBATCHED program — real lax.cond gating,
+            # bit-identical to a sequential Simulator run
+            squeeze = jax.tree_util.tree_map
+            out = one(*(squeeze(lambda x: x[0],
+                                t) for t in (state, trace, kn)))
+            return squeeze(lambda x: x[None], out)
+
+        return _shard_map(per_device, mesh=mesh,
+                          in_specs=(P("b"), P("b"), P("b")),
+                          out_specs=P("b"))
+
     def _get_runner(self, max_quanta: int):
         if self._runner is None or self._runner_max_quanta != max_quanta:
-            from graphite_tpu.engine.step import run_simulation
-
-            params = self.sim.params
-            unbounded = self.sim.quantum_ps is None
-
-            def one(state, trace, kn):
-                q = None if unbounded else kn.quantum_ps
-                return run_simulation(params, trace, state, q, max_quanta,
-                                      knobs=kn)
-
-            if not self.shard_batch:
-                self._runner = jax.jit(jax.vmap(one))
-            else:
-                from jax.sharding import Mesh, PartitionSpec as P
-
-                from graphite_tpu.parallel.mesh import _shard_map
-
-                K = self._sims_per_dev
-                mesh = Mesh(np.array(jax.devices()), ("b",))
-
-                def per_device(state, trace, kn):
-                    if K > 1:
-                        return jax.vmap(one)(state, trace, kn)
-                    # one sim per device: strip the [1] batch dim and run
-                    # the plain UNBATCHED program — real lax.cond gating,
-                    # bit-identical to a sequential Simulator run
-                    squeeze = jax.tree_util.tree_map
-                    out = one(*(squeeze(lambda x: x[0],
-                                        t) for t in (state, trace, kn)))
-                    return squeeze(lambda x: x[None], out)
-
-                self._runner = jax.jit(_shard_map(
-                    per_device, mesh=mesh,
-                    in_specs=(P("b"), P("b"), P("b")),
-                    out_specs=P("b")))
+            self._runner = jax.jit(self._runner_fn(max_quanta))
             self._runner_max_quanta = max_quanta
         return self._runner
+
+    def _batched_inputs(self):
+        """The [B, ...] initial states and [B, T, L] device traces,
+        built once and cached so repeat run() calls (timed benchmark
+        loops) measure the program, not a host->device re-upload."""
+        if self._states0 is None:
+            B = self.pack.n_sims
+            self._states0 = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x[None], (B,) + x.shape),
+                self.sim.state)
+            self._dtr = self.pack.device_traces()
+        return self._states0, self._dtr
+
+    def lower(self, max_quanta: int = 4096):
+        """The batched campaign program as a ClosedJaxpr plus its flat
+        invar paths (states first, then traces, then knob leaves) — the
+        program auditor's input (analysis/audit.py; the knob-fold rule
+        maps knob names to invars via the paths).
+
+        Pure tracing over abstract inputs: make_jaxpr only needs avals,
+        so audit-only callers never pay the [B, ...] state broadcast or
+        the [B, T, L] trace upload run() caches for execution."""
+        from graphite_tpu.analysis.walk import invar_path_strings
+        from graphite_tpu.engine.state import DeviceTrace
+        from graphite_tpu.sweep.pack import PackedTraces
+
+        B = self.pack.n_sims
+        states_abs = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct((B,) + jnp.shape(x),
+                                           jnp.result_type(x)),
+            self.sim.state)
+        dtr_abs = DeviceTrace(**{
+            f: jax.ShapeDtypeStruct(getattr(self.pack, f).shape,
+                                    getattr(self.pack, f).dtype)
+            for f in PackedTraces._TRACE_FIELDS})
+        closed = jax.make_jaxpr(self._runner_fn(max_quanta))(
+            states_abs, dtr_abs, self.knobs)
+        return closed, invar_path_strings((states_abs, dtr_abs,
+                                           self.knobs))
 
     def run(self, max_quanta: int = 1_000_000) -> SweepOutcome:
         from graphite_tpu.engine.simulator import (
@@ -233,17 +284,10 @@ class SweepRunner:
         )
 
         B = self.pack.n_sims
-        # B identical initial states (same config/geometry -> same init);
-        # the states and the [B, T, L] trace upload are cached so repeat
-        # run() calls (timed benchmark loops) measure the program, not a
-        # host->device re-upload of the campaign
-        if self._states0 is None:
-            self._states0 = jax.tree_util.tree_map(
-                lambda x: jnp.broadcast_to(x[None], (B,) + x.shape),
-                self.sim.state)
-            self._dtr = self.pack.device_traces()
+        # B identical initial states (same config/geometry -> same init)
+        states0, dtr = self._batched_inputs()
         state, nq_d, deadlock_d, iters_d = self._get_runner(max_quanta)(
-            self._states0, self._dtr, self.knobs)
+            states0, dtr, self.knobs)
         net_part, mem_part, ioc_part = Simulator._result_parts(state)
         (nq, deadlock, overflow, done, core_h, net_h, mem_h, ioc_h,
          iters) = jax.device_get((
@@ -292,4 +336,5 @@ class SweepRunner:
                             n_iterations=np.asarray(iters),
                             n_quanta=np.asarray(nq),
                             phase_skips=phase_skips,
-                            seeds=self.pack.seeds)
+                            seeds=self.pack.seeds,
+                            quantum_valid=self.sim.quantum_ps is not None)

@@ -1,0 +1,84 @@
+"""Program auditor CLI: lint the lowered default programs, JSON lines.
+
+Lowers the default config set — the per-phase-GATED private-L2 engine,
+the UNGATED one, the shared-L2 engine, and the B=4 vmapped sweep
+campaign — and runs every jaxpr invariant lint (analysis/rules.py)
+over each: cond-payload, knob-fold, time-dtype, vmap-gate, host-sync.
+Pure static analysis over `jax.make_jaxpr` output: no compile, no
+execution, runs on CPU-only CI in well under a minute.
+
+Output is JSON lines: one line per finding, then one summary line per
+program, then one trailing overall line.  Exit code 0 iff no
+error-severity finding fired (`--strict` also fails on warnings).
+
+Usage:
+  python -m graphite_tpu.tools.audit [--tiles 8] [--max-cond-bytes N]
+                                     [--strict] [--programs a,b,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="jaxpr invariant lints over the default programs")
+    ap.add_argument("--tiles", type=int, default=8,
+                    help="tile count for the audited geometries (the "
+                    "lints are structural; 8 carries the same program "
+                    "shape as 1024)")
+    ap.add_argument("--max-cond-bytes", type=int, default=None,
+                    help="generic cond-payload ceiling in bytes "
+                    "(default 64 MiB; directory stores are additionally "
+                    "matched by signature at any size)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings too (e.g. vmap-gate)")
+    ap.add_argument("--programs", default=None,
+                    help="comma-separated subset of program names "
+                    "(default: all four)")
+    args = ap.parse_args(argv)
+
+    # auditing is host-side static analysis — never touch a real chip
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import graphite_tpu  # noqa: F401  (x64)
+
+    from graphite_tpu.analysis.audit import (
+        DEFAULT_MAX_COND_BYTES, audit, default_programs,
+    )
+
+    t0 = time.perf_counter()
+    names = None
+    if args.programs:
+        names = [s.strip() for s in args.programs.split(",") if s.strip()]
+    try:
+        specs = default_programs(args.tiles, names=names)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    report = audit(specs, max_cond_bytes=(
+        args.max_cond_bytes if args.max_cond_bytes is not None
+        else DEFAULT_MAX_COND_BYTES))
+
+    for f in report.findings:
+        print(json.dumps(f.to_json()))
+    for row in report.summary_rows():
+        print(json.dumps(row))
+    ok = report.ok and not (args.strict and report.findings)
+    print(json.dumps({
+        "overall": True,
+        "ok": ok,
+        "programs": len(specs),
+        "errors": len(report.errors),
+        "warnings": len(report.findings) - len(report.errors),
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

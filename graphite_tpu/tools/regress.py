@@ -13,9 +13,11 @@ Usage:
 
 `--smoke` is the fast gating/dispatch attestation (runs in well under a
 minute on CPU with a warm XLA cache): the 16-tile per-phase-gated vs
-ungated engine pair must be bit-identical, and the batched host-barrier
+ungated engine pair must be bit-identical, the batched host-barrier
 dispatch (barrier_batch > 1) must reproduce the per-quantum dispatch
-exactly.
+exactly, the B=4 sweep must match sequential runs, and the program
+auditor's jaxpr invariant lints (graphite_tpu/analysis) must pass on
+the lowered default programs.
 """
 
 from __future__ import annotations
@@ -127,6 +129,22 @@ def smoke(tiles: int = 16) -> int:
                           mailbox_depth=sweep.mailbox_depth).run()
         failures += _compare(f"sweep B=4 sim {b} (seed {s}) vs sequential",
                              out.results[b], r_seq)
+
+    # 4) program auditor (round 8): the jaxpr invariant lints must pass
+    #    on the lowered default programs — both memory engines (gated,
+    #    ungated, shl2) and the B=4 sweep campaign.  Static analysis
+    #    only: make_jaxpr, no compile.
+    from graphite_tpu.analysis import audit
+
+    report = audit(tiles=8)
+    for row in report.summary_rows():
+        name = f"audit {row['program']}"
+        ok = row["ok"]
+        print(f"{name:44} {'PASS' if ok else 'FAIL'}"
+              + ("" if ok else f"  ({row['errors']} error(s))"))
+        failures += 0 if ok else 1
+    for f in report.findings:
+        print(f"    {f}")
 
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
     return 1 if failures else 0

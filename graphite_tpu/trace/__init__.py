@@ -16,6 +16,12 @@ from graphite_tpu.trace.schema import (
     TraceBuilder,
     MAX_MEM_OPS,
 )
+from graphite_tpu.trace.validate import (
+    TraceFinding,
+    TraceValidationError,
+    validate_batch,
+)
 from graphite_tpu.trace import synthetic
 
-__all__ = ["Op", "TraceBatch", "TraceBuilder", "MAX_MEM_OPS", "synthetic"]
+__all__ = ["Op", "TraceBatch", "TraceBuilder", "MAX_MEM_OPS", "synthetic",
+           "TraceFinding", "TraceValidationError", "validate_batch"]

@@ -1,0 +1,265 @@
+"""Pre-run trace validation: fail fast on malformed campaign traces.
+
+A malformed trace costs the most where it is cheapest to catch: a RECV
+with no matching SEND deadlocks a 1024-tile compiled program minutes
+into a run (and a SEND-carrying trace is exactly the shape that still
+crashes the TPU worker under the hbh NoC — ROADMAP), a barrier whose
+arrivals never reach its participant count hangs the last generation
+forever, and an out-of-range opcode scatters into whatever the engine's
+clipped gather happens to read.  This pass checks the STATIC properties
+a host can prove from the record arrays alone — op-code range,
+SEND/RECV pairing, barrier participant-count consistency — and raises
+a named `TraceValidationError` before anything is packed, uploaded, or
+compiled.  `sweep/pack.py` runs it on every sim of a campaign.
+
+Provable-deadlock conditions are errors; order-dependent hazards (e.g.
+mixed BARRIER_WAIT/ARRIVE remainders, which may or may not strand a
+blocking waiter depending on interleaving) are warnings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from graphite_tpu.trace.schema import Op
+
+ANY_SENDER = -1  # engine/step.py wildcard NET_RECV partner
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+
+class TraceValidationError(ValueError):
+    """A trace failed static validation; `.findings` holds the details."""
+
+    def __init__(self, message, findings=()):
+        super().__init__(message)
+        self.findings = list(findings)
+
+
+@dataclasses.dataclass
+class TraceFinding:
+    severity: str
+    kind: str       # "op-range" | "send-recv" | "barrier"
+    message: str
+    data: dict = dataclasses.field(default_factory=dict)
+
+    def __str__(self) -> str:
+        return f"[{self.kind}/{self.severity}] {self.message}"
+
+
+def _check_op_range(batch, out):
+    valid = np.isin(batch.op, [int(o) for o in Op])
+    if valid.all():
+        return
+    bad = np.argwhere(~valid)
+    vals = sorted({int(batch.op[t, i]) for t, i in bad[:64]})
+    out.append(TraceFinding(
+        SEV_ERROR, "op-range",
+        f"{len(bad)} record(s) carry opcodes outside the Op enum "
+        f"(values {vals[:8]}; first at tile {int(bad[0][0])} record "
+        f"{int(bad[0][1])})",
+        data={"count": int(len(bad)), "values": vals[:8],
+              "first": [int(bad[0][0]), int(bad[0][1])]}))
+
+
+def _check_send_recv(batch, out):
+    T = batch.n_tiles
+    op, aux0 = batch.op, batch.aux0
+    send = op == int(Op.SEND)
+    recv = op == int(Op.NET_RECV)
+    if not (send.any() or recv.any()):
+        return
+    tiles = np.broadcast_to(np.arange(T)[:, None], op.shape)
+
+    s_src, s_dst = tiles[send], aux0[send]
+    bad_dst = (s_dst < 0) | (s_dst >= T)
+    if bad_dst.any():
+        k = int(np.argmax(bad_dst))
+        out.append(TraceFinding(
+            SEV_ERROR, "send-recv",
+            f"{int(bad_dst.sum())} SEND(s) target tiles outside "
+            f"[0, {T}) (e.g. tile {int(s_src[k])} -> {int(s_dst[k])})",
+            data={"count": int(bad_dst.sum())}))
+    r_dst, r_src = tiles[recv], aux0[recv]
+    bad_src = (r_src < ANY_SENDER) | (r_src >= T)
+    if bad_src.any():
+        k = int(np.argmax(bad_src))
+        out.append(TraceFinding(
+            SEV_ERROR, "send-recv",
+            f"{int(bad_src.sum())} RECV(s) name senders outside "
+            f"[0, {T}) or ANY_SENDER (e.g. tile {int(r_dst[k])} <- "
+            f"{int(r_src[k])})",
+            data={"count": int(bad_src.sum())}))
+    if bad_dst.any() or bad_src.any():
+        return  # matrix math below assumes in-range partners
+
+    sends = np.zeros((T, T), np.int64)       # [src, dst]
+    np.add.at(sends, (s_src, s_dst), 1)
+    specific = r_src >= 0
+    recvs = np.zeros((T, T), np.int64)       # [src, dst]
+    np.add.at(recvs, (r_src[specific], r_dst[specific]), 1)
+    any_recvs = np.zeros(T, np.int64)
+    np.add.at(any_recvs, r_dst[~specific], 1)
+
+    # a specific RECV r<-s can only ever match a SEND s->r: more recvs
+    # than sends on a pair is a guaranteed deadlock
+    over = recvs > sends
+    if over.any():
+        pairs = np.argwhere(over)[:8]
+        out.append(TraceFinding(
+            SEV_ERROR, "send-recv",
+            f"{int(over.sum())} (sender, receiver) pair(s) RECV more "
+            f"messages than are ever SENT — guaranteed deadlock "
+            f"(e.g. tile {int(pairs[0][1])} receives "
+            f"{int(recvs[pairs[0][0], pairs[0][1]])} from tile "
+            f"{int(pairs[0][0])} which sends "
+            f"{int(sends[pairs[0][0], pairs[0][1]])})",
+            data={"pairs": [[int(s), int(d)] for s, d in pairs]}))
+    # total receives at a tile (specific + wildcard) bounded by total
+    # sends addressed to it
+    tot_recv = recvs.sum(axis=0) + any_recvs
+    tot_sent = sends.sum(axis=0)
+    starved = tot_recv > tot_sent
+    if starved.any():
+        t = int(np.argmax(starved))
+        out.append(TraceFinding(
+            SEV_ERROR, "send-recv",
+            f"tile(s) {np.flatnonzero(starved).tolist()[:8]} RECV more "
+            f"messages than are addressed to them (e.g. tile {t}: "
+            f"{int(tot_recv[t])} receives, {int(tot_sent[t])} sends in "
+            f"flight) — guaranteed deadlock",
+            data={"tiles": np.flatnonzero(starved).tolist()[:8]}))
+
+
+def _check_barriers(batch, out, n_barriers=None):
+    T = batch.n_tiles
+    op, aux0, aux1 = batch.op, batch.aux0, batch.aux1
+    init = op == int(Op.BARRIER_INIT)
+    wait = op == int(Op.BARRIER_WAIT)
+    arrive = op == int(Op.BARRIER_ARRIVE)
+    sync = op == int(Op.BARRIER_SYNC)
+    if not (init.any() or wait.any() or arrive.any() or sync.any()):
+        return
+
+    # the engine clips barrier ids to [0, n_barriers) (engine/step.py
+    # jnp.clip), so an out-of-range id silently ALIASES another barrier
+    # — corrupting counts the per-id analysis below models as distinct
+    any_bar = init | wait | arrive | sync
+    ids = aux0[any_bar]
+    bad = ids < 0
+    if n_barriers is not None:
+        bad = bad | (ids >= n_barriers)
+    if bad.any():
+        vals = sorted({int(v) for v in ids[bad]})[:8]
+        hi = f", {n_barriers})" if n_barriers is not None else ")"
+        out.append(TraceFinding(
+            SEV_ERROR, "barrier",
+            f"{int(bad.sum())} barrier record(s) use id(s) {vals} "
+            f"outside [0{hi} — the engine clips ids, silently aliasing "
+            f"another barrier",
+            data={"ids": vals}))
+        return
+
+    counts: dict = {}
+    for bar, cnt in zip(aux0[init].tolist(), aux1[init].tolist()):
+        counts.setdefault(int(bar), set()).add(int(cnt))
+
+    used = {}
+    for kind, mask in (("WAIT", wait), ("ARRIVE", arrive),
+                       ("SYNC", sync)):
+        for bar in aux0[mask].tolist():
+            used.setdefault(int(bar), {"WAIT": 0, "ARRIVE": 0,
+                                       "SYNC": 0})[kind] += 1
+    # highest release generation any BARRIER_SYNC rendezvouses with
+    # (engine/step.py: sync #g blocks until barrier_gen[bar] >= g, and
+    # barrier_gen advances only when arrivals reach the count)
+    max_sync_gen: dict = {}
+    for bar, gen in zip(aux0[sync].tolist(), aux1[sync].tolist()):
+        bar, gen = int(bar), int(gen)
+        max_sync_gen[bar] = max(max_sync_gen.get(bar, 0), gen)
+
+    uninit = sorted(set(used) - set(counts))
+    if uninit:
+        out.append(TraceFinding(
+            SEV_ERROR, "barrier",
+            f"barrier id(s) {uninit[:8]} are waited on but never "
+            f"BARRIER_INIT'd",
+            data={"ids": uninit[:8]}))
+    for bar, cs in sorted(counts.items()):
+        if len(cs) > 1:
+            out.append(TraceFinding(
+                SEV_ERROR, "barrier",
+                f"barrier {bar} is INIT'd with inconsistent participant "
+                f"counts {sorted(cs)}",
+                data={"id": bar, "counts": sorted(cs)}))
+            continue
+        cnt = next(iter(cs))
+        if not 1 <= cnt <= T:
+            out.append(TraceFinding(
+                SEV_ERROR, "barrier",
+                f"barrier {bar} participant count {cnt} outside "
+                f"[1, {T}]",
+                data={"id": bar, "count": cnt}))
+            continue
+        u = used.get(bar, {"WAIT": 0, "ARRIVE": 0, "SYNC": 0})
+        arrivals = u["WAIT"] + u["ARRIVE"]
+        # a SYNC targeting a generation beyond what the arrivals can
+        # ever release blocks forever (releases = arrivals // count)
+        releases = arrivals // cnt
+        want_gen = max_sync_gen.get(bar, 0)
+        if want_gen > releases:
+            out.append(TraceFinding(
+                SEV_ERROR, "barrier",
+                f"barrier {bar}: a BARRIER_SYNC waits for release "
+                f"generation {want_gen} but {arrivals} arrival(s) at "
+                f"participant count {cnt} release only {releases} "
+                f"generation(s) — guaranteed deadlock",
+                data={"id": bar, "generation": want_gen,
+                      "releases": releases, "arrivals": arrivals,
+                      "count": cnt}))
+        if arrivals % cnt == 0:
+            continue
+        if u["ARRIVE"] == 0 and u["SYNC"] == 0:
+            # pure blocking WAITs: the last generation can never reach
+            # the participant count — every straggler hangs
+            out.append(TraceFinding(
+                SEV_ERROR, "barrier",
+                f"barrier {bar}: {arrivals} BARRIER_WAITs with "
+                f"participant count {cnt} ({arrivals % cnt} stranded "
+                f"in the final generation) — guaranteed deadlock",
+                data={"id": bar, "arrivals": arrivals, "count": cnt}))
+        else:
+            out.append(TraceFinding(
+                SEV_WARNING, "barrier",
+                f"barrier {bar}: {arrivals} arrivals "
+                f"(WAIT+ARRIVE) are not a multiple of participant "
+                f"count {cnt} — the final generation never releases; "
+                f"deadlocks if any WAIT/SYNC lands in it",
+                data={"id": bar, "arrivals": arrivals, "count": cnt}))
+
+
+def validate_batch(batch, *, raise_on_error: bool = True,
+                   n_barriers: "int | None" = None,
+                   ) -> "list[TraceFinding]":
+    """Static validation of one TraceBatch; returns all findings.
+
+    With `raise_on_error` (the default), error-severity findings raise
+    `TraceValidationError` naming the first problem; warnings never
+    raise.  `n_barriers` (the Simulator's barrier-table size, default
+    64) tightens the barrier-id range check; negative ids are rejected
+    unconditionally (the engine clips ids, so out-of-range ones alias
+    another barrier)."""
+    out: "list[TraceFinding]" = []
+    _check_op_range(batch, out)
+    _check_send_recv(batch, out)
+    _check_barriers(batch, out, n_barriers)
+    errors = [f for f in out if f.severity == SEV_ERROR]
+    if errors and raise_on_error:
+        more = f" (+{len(errors) - 1} more)" if len(errors) > 1 else ""
+        raise TraceValidationError(
+            f"trace validation failed: {errors[0].message}{more}",
+            findings=out)
+    return out

@@ -174,19 +174,6 @@ ialu = 1
 # ---- program structure at the 1024-tile shape -----------------------------
 
 
-def _walk_eqns(jaxpr):
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for val in eqn.params.values():
-            vals = val if isinstance(val, (tuple, list)) else (val,)
-            for v in vals:
-                inner = getattr(v, "jaxpr", None)
-                if inner is not None and hasattr(inner, "eqns"):
-                    yield from _walk_eqns(inner)
-                elif hasattr(v, "eqns"):
-                    yield from _walk_eqns(v)
-
-
 def test_phase_cond_structure_1024_shape():
     """The acceptance shape: a 1024-tile program (CPU-scaled caches /
     directory) TRACES with per-phase conds — one cond per protocol phase
@@ -194,7 +181,12 @@ def test_phase_cond_structure_1024_shape():
     (cond branch outputs are double-buffered by XLA; keeping the big
     stores out of them is what lets gating survive where the >= 1 GB
     whole-engine gate disable used to apply).  Structural jaxpr
-    assertion, no TPU wall-clock needed."""
+    assertion, no TPU wall-clock needed.
+
+    Traversal and the cond-payload assertion are served by the SHARED
+    program-auditor pass (graphite_tpu/analysis) — the same walker and
+    rule `python -m graphite_tpu.tools.audit` runs on every config, so
+    there is one source of truth for jaxpr traversal."""
     T = 1024
     # geometries chosen so the directory entry/sharers avals are UNIQUE
     # in the program (l1i (32,2), l1d (32,4), l2 (64,8) meta vs entry
@@ -235,11 +227,11 @@ associativity = 4
         lambda st: subquantum_iteration(sim.params, sim.device_trace,
                                         st, qend))(sim.state)
 
-    d = sim.state.mem.directory
-    entry_sig = (d.entry.shape, d.entry.dtype)
-    sharers_sig = (d.sharers.shape, d.sharers.dtype)
+    from graphite_tpu.analysis import iter_eqns
+    from graphite_tpu.analysis.rules import cond_payload, phase_conds
+    from graphite_tpu.memory.engine import dir_store_avals
 
-    conds = [e for e in _walk_eqns(closed.jaxpr)
+    conds = [e for e in iter_eqns(closed)
              if e.primitive.name == "cond"]
     assert conds, "gated program lost its lax.conds"
 
@@ -247,27 +239,20 @@ associativity = 4
     # uint8[T, T] mailbox type matrix, and nothing else in the program
     # does (jax prunes unmodified pass-through cond outputs, so only the
     # matrices a phase actually writes appear)
-    def n_mail_outs(eqn):
-        return sum(1 for v in eqn.outvars
-                   if getattr(v.aval, "shape", None) == (T, T)
-                   and v.aval.dtype == jnp.uint8)
-
-    phase_conds = [e for e in conds if n_mail_outs(e) >= 1]
-    assert len(phase_conds) == 6, (
+    n_phase_conds = len(phase_conds(closed, T))
+    assert n_phase_conds == 6, (
         f"expected one cond per protocol phase (6), found "
-        f"{len(phase_conds)}")
+        f"{n_phase_conds}")
 
-    # no cond output may be (a copy of) the directory stores
-    for e in conds:
-        for v in e.outvars:
-            sig = (getattr(v.aval, "shape", None),
-                   getattr(v.aval, "dtype", None))
-            assert sig != entry_sig, (
-                "a lax.cond output carries the directory ENTRY store — "
-                "it would be double-buffered")
-            assert sig != sharers_sig, (
-                "a lax.cond output carries the directory SHARERS store "
-                "— the round-2 double-buffering pathology is back")
+    # no cond output may be (a copy of) the directory stores: the shared
+    # cond-payload rule, fed the engine's own store signatures (the
+    # geometry above keeps them unique in the program)
+    findings = cond_payload(closed,
+                            forbidden=dir_store_avals(sim.state.mem))
+    assert not findings, (
+        "a lax.cond output carries a directory store — the round-2 "
+        "double-buffering pathology is back:\n"
+        + "\n".join(str(f) for f in findings))
 
 
 # ---- batched host-barrier dispatch ----------------------------------------
