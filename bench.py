@@ -299,6 +299,49 @@ scheme = lax
                 1000 * seq_warm_s / seq_iters, 4),
         })
 
+    # Telemetry overhead (round 9, obs/ subsystem): warm per-iteration
+    # cost of recording a DENSE device timeline (every available series,
+    # S=256, sampled every barrier quantum — the worst case) vs
+    # telemetry=None on the same 16-tile coherence program, plus the
+    # timeline-derived summary fields CI tracks (peak USER-net injection
+    # rate, mean per-tile clock spread).  Skippable via BENCH_TELEMETRY=0.
+    if os.environ.get("BENCH_TELEMETRY", "1") != "0":
+        from graphite_tpu.obs import TelemetrySpec
+        from graphite_tpu.tools._template import config_text
+
+        tl_tiles = int(os.environ.get("BENCH_TELEMETRY_TILES", "16"))
+        sc_tl = SimConfig(ConfigFile.from_string(config_text(
+            tl_tiles, shared_mem=True, clock_scheme="lax_barrier")))
+        tl_trace = synthetic.memory_stress_trace(
+            tl_tiles, n_accesses=24, working_set_bytes=1 << 13,
+            write_fraction=0.4, shared_fraction=0.5, seed=7)
+        base = Simulator(sc_tl, tl_trace)
+        base.warmup()
+        t0 = time.perf_counter()
+        base.run()
+        base_s = time.perf_counter() - t0
+        base_iters = max(int(base.last_n_iterations), 1)
+        tel = Simulator(sc_tl, tl_trace, telemetry=TelemetrySpec(
+            sample_interval_ps=int(base.quantum_ps), n_samples=256))
+        tel.warmup()
+        t0 = time.perf_counter()
+        tel_res = tel.run()
+        tel_s = time.perf_counter() - t0
+        tel_iters = max(int(tel.last_n_iterations), 1)
+        ms_off = 1000 * base_s / base_iters
+        ms_on = 1000 * tel_s / tel_iters
+        tl_summary = tel_res.telemetry.summary()
+        companions.update({
+            "ms_per_iter_no_telemetry": round(ms_off, 4),
+            "ms_per_iter_telemetry": round(ms_on, 4),
+            "telemetry_overhead_pct": round(100 * (ms_on / ms_off - 1), 2),
+            "telemetry_samples": tl_summary["samples"],
+            "telemetry_peak_injection_per_ns": tl_summary.get(
+                "peak_injection_per_ns"),
+            "telemetry_mean_clock_spread_ps": tl_summary.get(
+                "mean_clock_spread_ps"),
+        })
+
     print(
         json.dumps(
             {

@@ -10,7 +10,7 @@ callback hides in the device loop.  This package checks them all on
 `walk.iter_eqns` / `rules.*` for bespoke assertions in tests.
 
     from graphite_tpu.analysis import audit
-    report = audit()          # the four default-config programs
+    report = audit()          # the five default-config programs
     assert report.ok, report.findings
 
 CLI: `python -m graphite_tpu.tools.audit` (JSON-lines report).

@@ -12,7 +12,10 @@ believes it is phase-gated (vmap-gate).
 
 The default program set mirrors the shapes every perf round is
 measured on: the per-phase-GATED private-L2 engine, the UNGATED one,
-the shared-L2 engine, and the B=4 vmapped sweep campaign.
+the shared-L2 engine, the B=4 vmapped sweep campaign, and the
+telemetry-recording gated engine (round 9 — the timeline ring must
+never ride a cond, and telemetry-off programs must carry no trace of
+the recording machinery).
 """
 
 from __future__ import annotations
@@ -58,6 +61,12 @@ class ProgramSpec:
     knob_invars: "dict | None" = None   # knob name -> invar indices
     forbidden_cond_avals: tuple = ()    # ((shape, dtype), ...)
     clock_invars: tuple = ()
+    # round 9: telemetry-ON programs add the ring's [S, n_series] aval
+    # to the cond-payload forbidden set; telemetry-OFF programs run the
+    # telemetry-off rule (no telemetry invar, no ring-aval equation —
+    # scanned against the canonical dense spec's ring sig)
+    expect_telemetry: bool = False
+    telemetry_sig: "tuple | None" = None   # ((S, n_series), dtype)
 
 
 def _mem_forbidden_avals(sim):
@@ -98,6 +107,28 @@ def _mem_forbidden_avals(sim):
     return tuple(s for s in sigs if s not in non_dir)
 
 
+def _telemetry_fields(sim):
+    """The telemetry policing shared by both spec builders:
+    (extra forbidden cond avals, expect_telemetry, telemetry_sig).
+
+    Telemetry-ON programs forbid the attached spec's actual ring as a
+    cond payload (the [S, n] store would be double-buffered per
+    iteration — the round-6 pathology the masked scatter-append
+    avoids).  Telemetry-OFF programs get the canonical DENSE spec's
+    ring sig (default S, every available series) — the shape an
+    accidentally-hard-coded internal recorder would materialize, so
+    the telemetry-off aval scan stays a live check instead of only
+    policing carry invars."""
+    tel = sim.telemetry_spec
+    if tel is not None:
+        return (tel.buffer_sig(),), True, tel.buffer_sig()
+    from graphite_tpu.obs.telemetry import TelemetrySpec
+
+    dense_sig = TelemetrySpec(sample_interval_ps=1).resolve(
+        sim.params).buffer_sig()
+    return (), False, dense_sig
+
+
 def spec_from_simulator(name: str, sim,
                         max_quanta: int = 4096) -> ProgramSpec:
     """Lower a Simulator's single-device resident program into a spec."""
@@ -108,12 +139,15 @@ def spec_from_simulator(name: str, sim,
                     and bool(sim.params.mem.phase_gate))
     n_phases = (len(mem_phase_names(sim.params))
                 if sim.params.mem is not None else 6)
+    tel_forbidden, expect_tel, tel_sig = _telemetry_fields(sim)
     return ProgramSpec(
         name=name, closed=closed, invar_paths=paths,
         n_tiles=sim.params.n_tiles, expect_gated=expect_gated,
         n_phases=n_phases,
-        forbidden_cond_avals=_mem_forbidden_avals(sim),
-        clock_invars=clock_invar_indices(paths))
+        forbidden_cond_avals=_mem_forbidden_avals(sim) + tel_forbidden,
+        clock_invars=clock_invar_indices(paths),
+        expect_telemetry=expect_tel,
+        telemetry_sig=tel_sig)
 
 
 def spec_from_sweep(name: str, runner,
@@ -151,12 +185,15 @@ def spec_from_sweep(name: str, runner,
                     and bool(sim.params.mem.phase_gate))
     n_phases = (len(mem_phase_names(sim.params))
                 if sim.params.mem is not None else 6)
+    tel_forbidden, expect_tel, tel_sig = _telemetry_fields(sim)
     return ProgramSpec(
         name=name, closed=closed, invar_paths=paths,
         n_tiles=sim.params.n_tiles, expect_gated=expect_gated,
         n_phases=n_phases, knob_invars=knob_invars,
-        forbidden_cond_avals=_mem_forbidden_avals(sim),
-        clock_invars=clock_invar_indices(paths))
+        forbidden_cond_avals=_mem_forbidden_avals(sim) + tel_forbidden,
+        clock_invars=clock_invar_indices(paths),
+        expect_telemetry=expect_tel,
+        telemetry_sig=tel_sig)
 
 
 # ---------------------------------------------------------------------------
@@ -165,12 +202,15 @@ def spec_from_sweep(name: str, runner,
 
 
 DEFAULT_PROGRAM_NAMES = ("gated-msi", "ungated-msi", "shl2-mesi",
-                         "sweep-b4")
+                         "sweep-b4", "gated-msi-tel")
 
 
 def default_programs(tiles: int = 8, max_quanta: int = 4096,
                      names=None) -> "list[ProgramSpec]":
-    """The four audited shapes: gated, ungated, shl2, sweep B=4.
+    """The five audited shapes: gated, ungated, shl2, sweep B=4, and
+    the telemetry-recording gated engine (round 9: the ring's aval joins
+    the cond-payload forbidden set; the other four — telemetry OFF —
+    additionally run the telemetry-off lint).
 
     Small geometry on purpose — the lints are structural, so the
     8-tile lowering carries the same program shape the 1024-tile
@@ -257,6 +297,13 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
         ]
         runner = SweepRunner(sc_sweep, sweep_traces, shard_batch=False)
         specs.append(spec_from_sweep("sweep-b4", runner, max_quanta))
+    if "gated-msi-tel" in names:
+        from graphite_tpu.obs import TelemetrySpec
+
+        specs.append(spec_from_simulator("gated-msi-tel", Simulator(
+            sc, batch, phase_gate=True, mem_gate_bytes=0,
+            telemetry=TelemetrySpec(sample_interval_ps=1_000_000,
+                                    n_samples=32)), max_quanta))
     return specs
 
 
@@ -265,7 +312,7 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
 # ---------------------------------------------------------------------------
 
 RULE_NAMES = ("cond-payload", "knob-fold", "time-dtype", "vmap-gate",
-              "host-sync")
+              "host-sync", "telemetry-off")
 
 
 @dataclasses.dataclass
@@ -347,6 +394,14 @@ def audit_program(spec: ProgramSpec, *,
         spec.closed, spec.n_tiles, spec.expect_gated,
         n_phases=spec.n_phases))
     add("host-sync", rules.host_sync(spec.closed))
+    if not spec.expect_telemetry:
+        # telemetry-OFF programs must carry no trace of the timeline
+        # machinery (ON programs instead police the ring via the
+        # cond-payload forbidden set, added by spec_from_*)
+        add("telemetry-off", rules.telemetry_off(
+            spec.closed, spec.invar_paths,
+            ring_sigs=((spec.telemetry_sig,)
+                       if spec.telemetry_sig is not None else ())))
     return results
 
 
@@ -354,7 +409,7 @@ def audit(specs: "list[ProgramSpec] | None" = None, *,
           tiles: int = 8,
           max_cond_bytes: "int | None" = DEFAULT_MAX_COND_BYTES,
           max_quanta: int = 4096) -> AuditReport:
-    """Audit `specs` (default: the four default-config programs).
+    """Audit `specs` (default: the five default-config programs).
 
     Pure static analysis over `jax.make_jaxpr` output — no compile, no
     execution, runs on CPU.  `report.ok` is False iff any error-severity

@@ -23,6 +23,14 @@ a Python-level abstraction:
                 step (a host round trip costs ~100 ms over a tunneled
                 chip — the whole reason the quantum loop is
                 device-driven)
+  telemetry-off a program lowered with telemetry=None must contain NO
+                trace of the timeline machinery: no telemetry-state
+                invar and no equation producing the ring's
+                [S, n_series] aval (round 9's knobs=None-style
+                contract — the default program stays bit-identical to
+                the pre-telemetry one).  Telemetry-ON programs instead
+                add the ring's aval to the cond-payload forbidden set:
+                no phase cond may ever carry the buffer.
 
 Rules return `Finding` lists; `analysis/audit.py` assembles them into
 per-program reports and the `tools/audit.py` CLI emits them as JSON
@@ -297,4 +305,53 @@ def host_sync(jaxpr) -> "list[Finding]":
                 f"compiled step — every iteration would pay a "
                 f"host<->device round trip (~100 ms tunneled)",
                 data={"primitive": name}))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 6: telemetry-off
+# ---------------------------------------------------------------------------
+
+
+def telemetry_off(jaxpr, invar_paths=None,
+                  ring_sigs=()) -> "list[Finding]":
+    """A telemetry=None program must record nothing.
+
+    Two checks: (a) no invar path names a telemetry-state leaf — the
+    None spec must contribute ZERO pytree leaves to the carry (the
+    SimState.telemetry=None contract), and (b) no equation anywhere in
+    the program produces a ring-buffer aval from `ring_sigs` (matched
+    modulo leading batch axes, like cond-payload's forbidden set) — a
+    ring materialized internally would mean the recording survived
+    constant folding.  Either finding breaks the round-7-style
+    "telemetry=None lowers the historical program bit-identically"
+    guarantee every overhead claim rests on.
+    """
+    out = []
+    for i, p in enumerate(invar_paths or ()):
+        if "telemetry" in p:
+            out.append(Finding(
+                "telemetry-off", SEV_ERROR, "jaxpr.invars",
+                f"telemetry-off program carries a telemetry-state "
+                f"invar {p!r} (index {i}) — the None spec must add no "
+                f"leaves to the carry",
+                data={"invar": i, "path": p}))
+    ring_sigs = tuple((tuple(s), str(np.dtype(d))) for s, d in ring_sigs)
+    if ring_sigs:
+        for site, eqn in iter_eqns_with_site(jaxpr):
+            for k, v in enumerate(eqn.outvars):
+                sig = aval_sig(v.aval)
+                for fs in ring_sigs:
+                    if _sig_matches(sig, fs):
+                        out.append(Finding(
+                            "telemetry-off", SEV_ERROR, site,
+                            f"telemetry-off program contains a "
+                            f"timeline-store equation "
+                            f"({eqn.primitive.name} output {k}, "
+                            f"{sig[0]} {sig[1]}) — the recording was "
+                            f"not constant-folded away",
+                            data={"primitive": eqn.primitive.name,
+                                  "output": k, "shape": list(sig[0]),
+                                  "dtype": sig[1]}))
+                        break
     return out

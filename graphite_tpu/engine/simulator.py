@@ -61,6 +61,11 @@ class SimResults:
     # iocoom detailed stall breakdown (`iocoom_core_model.cc:64-77`),
     # None for the simple core model
     detailed_stalls: "dict | None" = None
+    # device-recorded telemetry timeline (obs.Timeline) when the run was
+    # built with a TelemetrySpec, else None.  Pure observability: a
+    # telemetry-enabled run's other fields are bit-equal to its
+    # telemetry=None twin (pinned in tests/test_telemetry.py)
+    telemetry: "object | None" = None
 
     @property
     def total_instructions(self) -> int:
@@ -341,6 +346,7 @@ class Simulator:
         phase_gate: bool | None = None,
         mem_gate_bytes: int | None = None,
         barrier_batch: int | None = None,
+        telemetry=None,
     ):
         """`dir_stage`: force the directory write-staging path on/off
         (None = auto: on for single-device private-L2 runs whose sharers
@@ -372,6 +378,13 @@ class Simulator:
         ~100 ms tunnel dispatch ~K x; `engine/step.barrier_host_batch`).
         1 restores the per-quantum dispatch.  Config key:
         `[general] barrier_batch` (default 8).
+
+        `telemetry`: an `obs.TelemetrySpec` to record a device-resident
+        metric timeline inside the compiled loop (sampled on
+        `sample_interval_ps` simulated-time boundaries, zero host sync;
+        read back post-run via `Simulator.telemetry` /
+        `SimResults.telemetry`).  None — the default — lowers a
+        bit-identical program (the knobs=None contract).
 
         `donate=True` gives the input state's device buffers to XLA each
         run (halves big-state HBM residency — required for the 1024-tile
@@ -735,6 +748,47 @@ class Simulator:
         self._runner = None
         self._runner_max_quanta = None
         self._hb_runner = None
+        # device-resident telemetry timeline (graphite_tpu/obs): resolve
+        # the spec against this program's series set and seed the ring
+        # into the state carry; None records nothing and lowers the
+        # historical program bit-identically
+        self.telemetry_spec = None
+        if telemetry is not None:
+            self.attach_telemetry(telemetry)
+
+    def attach_telemetry(self, spec) -> None:
+        """Attach (or replace) a telemetry spec on a not-yet-run
+        instance: resolves the series selection against this program,
+        seeds the ring buffer into the state carry, and invalidates any
+        compiled runner (the spec is baked into the lowering).  Used by
+        `StatisticsManager`'s device backend to upgrade a plain sim."""
+        from graphite_tpu.obs.telemetry import TelemetrySpec, init_telemetry
+
+        if self.mesh is not None or self.stream:
+            raise ValueError(
+                "telemetry timelines support single-device resident runs "
+                "and batched sweeps only (the ring is not threaded "
+                "through the multi-chip exchange or the streaming window "
+                "loop; use the chunked StatisticsManager backend there)")
+        if not isinstance(spec, TelemetrySpec):
+            raise TypeError("telemetry must be an obs.TelemetrySpec")
+        spec = spec.resolve(self.params)
+        self.telemetry_spec = spec
+        self.state = self.state.replace(telemetry=init_telemetry(spec))
+        self._runner = None
+        self._runner_max_quanta = None
+        self._hb_runner = None
+
+    @property
+    def telemetry(self):
+        """The recorded timeline (obs.Timeline) of everything run so
+        far, or None when the sim records no telemetry."""
+        if self.telemetry_spec is None:
+            return None
+        from graphite_tpu.obs.telemetry import timeline_from_state
+
+        return timeline_from_state(self.telemetry_spec,
+                                   self.state.telemetry)
 
     @staticmethod
     def _resolve_mem_gate_bytes(cfg, mem_gate_bytes) -> int:
@@ -794,7 +848,8 @@ class Simulator:
 
                 self._runner = make_simulation_runner(
                     self.params, self.device_trace, self.quantum_ps,
-                    max_quanta, donate=self.donate)
+                    max_quanta, donate=self.donate,
+                    telemetry=self.telemetry_spec)
             self._runner_max_quanta = max_quanta
         return self._runner
 
@@ -817,6 +872,7 @@ class Simulator:
         from graphite_tpu.analysis.walk import invar_path_strings
 
         params = self.params
+        tel = self.telemetry_spec
         if self.barrier_host:
             from graphite_tpu.engine.step import barrier_host_batch
 
@@ -824,7 +880,7 @@ class Simulator:
 
             def fn(st, tr, prev_qend, budget):
                 return barrier_host_batch(params, tr, st, prev_qend,
-                                          qps, budget)
+                                          qps, budget, telemetry=tel)
 
             args = (self.state, self.device_trace,
                     jnp.asarray(0, jnp.int64),
@@ -835,7 +891,8 @@ class Simulator:
             qps = self.quantum_ps
 
             def fn(st, tr):
-                return run_simulation(params, tr, st, qps, max_quanta)
+                return run_simulation(params, tr, st, qps, max_quanta,
+                                      telemetry=tel)
 
             args = (self.state, self.device_trace)
         closed = jax.make_jaxpr(fn)(*args)
@@ -891,10 +948,11 @@ class Simulator:
 
             params, trace = self.params, self.device_trace
             qps = int(self.quantum_ps)
+            tel = self.telemetry_spec
 
             def qrun(st, prev_qend, budget):
                 return barrier_host_batch(params, trace, st, prev_qend,
-                                          qps, budget)
+                                          qps, budget, telemetry=tel)
 
             self._hb_runner = jax.jit(
                 qrun, donate_argnums=(0,) if self.donate else ())
@@ -966,16 +1024,33 @@ class Simulator:
         )
         net_part = (state.net.packets_sent, state.net.packets_received,
                     state.net.total_latency_ps)
-        return net_part, mem_part, ioc_part
+        tel_part = (
+            (state.telemetry.buf, state.telemetry.count)
+            if state.telemetry is not None else None
+        )
+        return net_part, mem_part, ioc_part, tel_part
+
+    def _timeline_host(self, tel_h):
+        """Demux an already-fetched (buf, count) pair into a Timeline —
+        keeps the ring inside run()'s ONE batched device→host fetch
+        (a separate read over a tunneled chip costs ~100 ms)."""
+        if tel_h is None or self.telemetry_spec is None:
+            return None
+        from graphite_tpu.obs.telemetry import Timeline
+
+        buf, count = tel_h
+        return Timeline.from_host_state(self.telemetry_spec,
+                                        np.asarray(buf), int(count))
 
     def _results_from_state(self, n_quanta: int) -> SimResults:
         """SimResults from the CURRENT state (after run_chunk loops)."""
         state = self.state
-        net_part, mem_part, ioc_part = self._result_parts(state)
-        core_h, net_h, mem_h, ioc_h = jax.device_get((
-            state.core, net_part, mem_part, ioc_part,
+        net_part, mem_part, ioc_part, tel_part = self._result_parts(state)
+        core_h, net_h, mem_h, ioc_h, tel_h = jax.device_get((
+            state.core, net_part, mem_part, ioc_part, tel_part,
         ))
-        return self._results_host(core_h, net_h, mem_h, n_quanta, ioc_h)
+        return self._results_host(core_h, net_h, mem_h, n_quanta, ioc_h,
+                                  telemetry=self._timeline_host(tel_h))
 
     def write_output(self, results: SimResults,
                      output_dir: str = "results") -> str:
@@ -1186,14 +1261,15 @@ class Simulator:
         state, n_quanta_dev, deadlock_dev, n_iters = self._get_runner(
             max_quanta)(self.state)
         # ONE batched device→host fetch for control flags + all summary
-        # counters (each separate read over a tunneled chip costs ~100 ms).
-        net_part, mem_part, ioc_part = self._result_parts(state)
+        # counters + the telemetry ring (each separate read over a
+        # tunneled chip costs ~100 ms).
+        net_part, mem_part, ioc_part, tel_part = self._result_parts(state)
         host = jax.device_get((
             n_quanta_dev, deadlock_dev, state.net.overflow, state.done,
-            state.core, net_part, mem_part, ioc_part, n_iters,
+            state.core, net_part, mem_part, ioc_part, tel_part, n_iters,
         ))
         (n_quanta, deadlock, overflow, done, core_h, net_h, mem_h,
-         ioc_h, self.last_n_iterations) = host
+         ioc_h, tel_h, self.last_n_iterations) = host
         if bool(overflow):
             raise MailboxOverflowError(
                 "a (dst,src) mailbox ring overflowed; re-run with a "
@@ -1208,10 +1284,11 @@ class Simulator:
         if not bool(done.all()):
             raise RuntimeError(f"exceeded max_quanta={max_quanta}")
         self.state = state
-        return self._results_host(core_h, net_h, mem_h, int(n_quanta), ioc_h)
+        return self._results_host(core_h, net_h, mem_h, int(n_quanta), ioc_h,
+                                  telemetry=self._timeline_host(tel_h))
 
     def _results_host(self, core, net_h, mem_h, n_quanta: int,
-                      ioc_h=None) -> SimResults:
+                      ioc_h=None, telemetry=None) -> SimResults:
         """Assemble SimResults from already-fetched host arrays."""
         clock = np.asarray(core.clock_ps)
         mem_counters = None
@@ -1248,5 +1325,6 @@ class Simulator:
             detailed_stalls=(
                 {k: np.asarray(v) for k, v in ioc_h.items()}
                 if ioc_h is not None else None),
+            telemetry=telemetry,
         )
 

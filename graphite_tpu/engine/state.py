@@ -147,6 +147,10 @@ class SimState:
     # lax_p2p pairing round counter (drives the pseudorandom partner draw;
     # carried unconditionally — one int32 scalar)
     p2p_round: "jax.Array" = None
+    # device-resident telemetry ring (obs/telemetry.TelemetryState) when
+    # the run records a timeline; None (no pytree leaves — the program
+    # lowers bit-identically to one with no telemetry at all) otherwise
+    telemetry: "object" = None
 
 
 @struct.dataclass

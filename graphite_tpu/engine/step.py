@@ -1113,6 +1113,9 @@ def subquantum_iteration(
         ioc=new_ioc,
         dvfs=new_dvfs,
         p2p_round=p2p_round,
+        # telemetry rides the carry untouched here; the OUTER quantum
+        # loop appends rows (obs.telemetry_tick) — None adds no leaves
+        telemetry=state.telemetry,
     )
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 
@@ -1193,6 +1196,7 @@ def run_simulation(
     trace_base: jax.Array | None = None,
     px: ParallelCtx = IDENT,
     knobs=None,
+    telemetry=None,
 ):
     """The whole simulation as ONE compiled region: an outer while_loop over
     lax-barrier quanta (the MCP barrier loop, `lax_barrier_sync_server.h`)
@@ -1212,7 +1216,17 @@ def run_simulation(
     Returns (state, n_quanta, deadlock flag) — deadlock means a quantum made
     zero progress while some tile was eligible to run (same condition the
     reference debugs with its progress trace, `pin/progress_trace.cc`).
+
+    `telemetry` (a RESOLVED obs.TelemetrySpec; state.telemetry must hold
+    the matching TelemetryState) appends one row to the device-resident
+    timeline ring whenever a quantum crosses a `sample_interval_ps`
+    simulated-time boundary — the reference's statistics-thread sampling
+    points, recorded with zero host sync.  None (the default) lowers a
+    bit-identical program (the round-7 knobs=None contract; enforced by
+    the telemetry-off audit lint).
     """
+    if telemetry is not None:
+        from graphite_tpu.obs.telemetry import telemetry_tick
     INF_QEND = jnp.asarray(2**61, I64)
     if quantum_ps is None:
         qps = None
@@ -1246,6 +1260,9 @@ def run_simulation(
         st2, progress, blk_iters = _quantum_loop(params, trace, st, qend,
                                                  trace_base, px=px,
                                                  knobs=knobs)
+        if telemetry is not None:
+            st2 = st2.replace(telemetry=telemetry_tick(
+                telemetry, st2, progress=progress, blk_iters=blk_iters))
         # Zero progress: if some non-done tile sits beyond qend (it crossed
         # the boundary executing one long record), jump the window up to it
         # — blocked peers may wait on its future sends.  Only when every
@@ -1289,6 +1306,7 @@ def barrier_host_batch(
     prev_qend: jax.Array,     # int64[] qend of the previous quantum
     quantum_ps: int,
     max_quanta: jax.Array,    # int32[] quanta budget for THIS dispatch
+    telemetry=None,
 ):
     """Up to `max_quanta` lax_barrier quanta as ONE compiled region — the
     batched form of the host-driven barrier loop (Simulator.barrier_host).
@@ -1307,7 +1325,13 @@ def barrier_host_batch(
     Returns (state, prev_qend, n_quanta, deadlock, n_iterations); the
     host threads prev_qend into the next dispatch so boundary progression
     is seamless across batches.
+
+    `telemetry` samples the device-resident timeline exactly as in
+    `run_simulation`; the ring's sampling cursor rides state.telemetry,
+    so recording is seamless across dispatches too.
     """
+    if telemetry is not None:
+        from graphite_tpu.obs.telemetry import telemetry_tick
     qps = int(quantum_ps)
 
     def next_boundary(clock):
@@ -1329,6 +1353,9 @@ def barrier_host_batch(
                                         jnp.asarray(2**62, I64)))
         qend = jnp.maximum(prev + qps, next_boundary(min_pending))
         st2, progress, blk_iters = _quantum_loop(params, trace, st, qend)
+        if telemetry is not None:
+            st2 = st2.replace(telemetry=telemetry_tick(
+                telemetry, st2, progress=progress, blk_iters=blk_iters))
         zero = (progress == 0) & jnp.any(~st2.done)
         ahead_clock = jnp.min(jnp.where(
             ~st2.done & (st2.core.clock_ps >= qend),
@@ -1350,12 +1377,13 @@ def barrier_host_batch(
 
 def make_simulation_runner(params: EngineParams, trace: DeviceTrace,
                            quantum_ps: int | None, max_quanta: int,
-                           donate: bool = False):
+                           donate: bool = False, telemetry=None):
     """`donate=True` hands the input state's buffers to XLA (halves the
     protocol state's HBM residency — the 1024-tile directory is 2.4 GB,
     and without donation input + output + scatter staging exceeds the
     chip; see PERF.md).  The caller's old state object is consumed."""
     def run(state: SimState):
-        return run_simulation(params, trace, state, quantum_ps, max_quanta)
+        return run_simulation(params, trace, state, quantum_ps, max_quanta,
+                              telemetry=telemetry)
 
     return jax.jit(run, donate_argnums=(0,) if donate else ())

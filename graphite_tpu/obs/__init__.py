@@ -1,0 +1,38 @@
+"""Observability: device-resident telemetry timelines.
+
+  TelemetrySpec      — what to record (interval, ring depth S, series)
+  TelemetryState     — the [S, n_series] ring riding SimState.telemetry
+  telemetry_tick     — the outer quantum loop's per-quantum update
+  Timeline           — one sim's demuxed chronological host rows
+  demux_timelines    — [B, ...] campaign state -> B Timelines
+
+    spec = TelemetrySpec(sample_interval_ps=10_000_000)   # 10 us
+    sim = Simulator(cfg, batch, telemetry=spec)
+    res = sim.run()
+    res.telemetry.summary()   # peak injection, clock spread, ...
+
+`telemetry=None` (the default) lowers to a bit-identical program —
+jaxpr-asserted in tests/test_telemetry.py and enforced by the
+`telemetry-off` audit lint (`python -m graphite_tpu.tools.audit`).
+"""
+
+from graphite_tpu.obs.telemetry import (  # noqa: F401
+    CORE_SERIES, LEVEL_SERIES, MEM_SERIES, SKIP_PREFIX, Timeline,
+    TelemetrySpec, TelemetryState, available_series, demux_timelines,
+    init_telemetry, telemetry_tick, timeline_from_state,
+)
+
+__all__ = [
+    "CORE_SERIES",
+    "LEVEL_SERIES",
+    "MEM_SERIES",
+    "SKIP_PREFIX",
+    "Timeline",
+    "TelemetrySpec",
+    "TelemetryState",
+    "available_series",
+    "demux_timelines",
+    "init_telemetry",
+    "telemetry_tick",
+    "timeline_from_state",
+]

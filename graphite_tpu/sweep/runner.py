@@ -48,6 +48,10 @@ class SweepOutcome:
     n_quanta: np.ndarray          # int32[B]
     phase_skips: "list[dict] | None"  # per-sim gate skip counts (or None)
     seeds: "np.ndarray | None" = None  # per-sim trace seeds (pack metadata)
+    # per-sim device-recorded timelines (obs.Timeline) when the campaign
+    # ran with a TelemetrySpec: the batched [B, S, n_series] ring demuxed
+    # sim-by-sim (each also rides its SimResults.telemetry)
+    timelines: "list | None" = None
     # False for unbounded clock schemes (lax/lax_p2p): there is no
     # quantum in the program, so reporting the knob would claim a value
     # that never entered it
@@ -81,8 +85,11 @@ class SweepRunner:
     override dicts (sweep/knobs.py KNOB_FIELDS); with one trace and K > 1
     points the trace is replicated across the grid.  Remaining kwargs
     reach the underlying Simulator construction (mailbox_depth,
-    inner_block, phase_gate, ...); multi-chip tile sharding, streaming
-    and host-barrier modes are out of scope for the batched program.
+    inner_block, phase_gate, telemetry, ...); multi-chip tile sharding,
+    streaming and host-barrier modes are out of scope for the batched
+    program.  `telemetry=obs.TelemetrySpec(...)` records one device
+    timeline PER SIM ([B, S, n_series] total), demuxed post-run into
+    `SweepOutcome.timelines` / each result's `.telemetry`.
 
     Two batching programs, chosen by `shard_batch`:
      - `vmap` over the sim axis (the default on one device): one
@@ -202,11 +209,12 @@ class SweepRunner:
 
         params = self.sim.params
         unbounded = self.sim.quantum_ps is None
+        tel = self.sim.telemetry_spec
 
         def one(state, trace, kn):
             q = None if unbounded else kn.quantum_ps
             return run_simulation(params, trace, state, q, max_quanta,
-                                  knobs=kn)
+                                  knobs=kn, telemetry=tel)
 
         if not self.shard_batch:
             return jax.vmap(one)
@@ -288,11 +296,12 @@ class SweepRunner:
         states0, dtr = self._batched_inputs()
         state, nq_d, deadlock_d, iters_d = self._get_runner(max_quanta)(
             states0, dtr, self.knobs)
-        net_part, mem_part, ioc_part = Simulator._result_parts(state)
+        net_part, mem_part, ioc_part, tel_part = \
+            Simulator._result_parts(state)
         (nq, deadlock, overflow, done, core_h, net_h, mem_h, ioc_h,
-         iters) = jax.device_get((
+         tel_h, iters) = jax.device_get((
             nq_d, deadlock_d, state.net.overflow, state.done, state.core,
-            net_part, mem_part, ioc_part, iters_d))
+            net_part, mem_part, ioc_part, tel_part, iters_d))
         if overflow.any():
             raise MailboxOverflowError(
                 f"mailbox ring overflow in sim(s) "
@@ -314,12 +323,27 @@ class SweepRunner:
         def row(tree, b):
             return jax.tree_util.tree_map(lambda x: x[b], tree)
 
+        timelines = None
+        if self.sim.telemetry_spec is not None and tel_h is not None:
+            from graphite_tpu.obs.telemetry import Timeline
+
+            # the whole [B, S, n_series] ring rode the ONE batched fetch
+            # above; demux sim-by-sim host-side (shard_map campaigns
+            # gather per-device buffers through the out_specs, so the
+            # same demux serves both batching programs)
+            buf_h, count_h = np.asarray(tel_h[0]), np.asarray(tel_h[1])
+            timelines = [
+                Timeline.from_host_state(self.sim.telemetry_spec,
+                                         buf_h[b], int(count_h[b]))
+                for b in range(B)
+            ]
         results = [
             self.sim._results_host(
                 row(core_h, b), row(net_h, b),
                 None if mem_h is None else row(mem_h, b),
                 int(nq[b]),
-                None if ioc_h is None else row(ioc_h, b))
+                None if ioc_h is None else row(ioc_h, b),
+                telemetry=None if timelines is None else timelines[b])
             for b in range(B)
         ]
         phase_skips = None
@@ -337,4 +361,5 @@ class SweepRunner:
                             n_quanta=np.asarray(nq),
                             phase_skips=phase_skips,
                             seeds=self.pack.seeds,
-                            quantum_valid=self.sim.quantum_ps is not None)
+                            quantum_valid=self.sim.quantum_ps is not None,
+                            timelines=timelines)

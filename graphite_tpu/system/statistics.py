@@ -18,6 +18,20 @@ Network utilization: per-interval injection rate on the USER network
 approximated from the protocol event counters).
 Progress trace (`pin/progress_trace.cc`): per-tile clock/record progress
 per sample.
+
+Two backends (round 9):
+ - `device`: the simulation runs as ONE compiled region recording a
+   device-resident telemetry timeline (graphite_tpu/obs — zero host sync,
+   the dispatch-tail fix), converted to the same `.trace` files in one
+   post-run pass.  Covers the counter-derived statistics (network
+   utilization); sample times are the quanta whose laggard clock crosses
+   the sampling interval — the reference's statistics-thread wakeups.
+ - `chunked`: the legacy host-driven sampling loop (one host<->device
+   round trip PER SAMPLE).  Stays as the fallback for live-STATE
+   snapshots the telemetry carry cannot afford: replication histograms
+   over the full L2 tags, per-tile progress rows, energy sampling.
+`backend="auto"` (the default) picks `device` exactly when every enabled
+statistic is counter-derived.
 """
 
 from __future__ import annotations
@@ -27,6 +41,15 @@ import os
 import numpy as np
 
 import jax
+
+
+def chunk_quanta(sampling_interval_ns: int, quantum_ps: int) -> int:
+    """Quanta per chunked-backend sample: the sampling interval floor-
+    divided by the barrier quantum, never below one quantum (the
+    reference's statistics thread wakes at barrier quanta only, so a
+    sub-quantum interval degrades to per-quantum sampling).  Pinned by
+    tests before the round-9 backend split."""
+    return max(1, (int(sampling_interval_ns) * 1000) // int(quantum_ps))
 
 
 class _StateEnergyView:
@@ -58,7 +81,8 @@ class _StateEnergyView:
 class StatisticsManager:
     """Drives a Simulator in sampling-interval chunks, writing traces."""
 
-    def __init__(self, sim, output_dir: str = "stats"):
+    def __init__(self, sim, output_dir: str = "stats",
+                 backend: str = "auto"):
         cfg = sim.config.cfg
         self.sim = sim
         self.enabled = cfg.get_bool("statistics_trace/enabled", False)
@@ -74,6 +98,18 @@ class StatisticsManager:
         # same sampling loop; writes power.trace when power_trace/enabled
         self.power_enabled = cfg.get_bool(
             "runtime_energy_modeling/power_trace/enabled", False)
+        if backend not in ("auto", "device", "chunked"):
+            raise ValueError(f"unknown statistics backend {backend!r} "
+                             "(expected 'auto', 'device' or 'chunked')")
+        if backend == "device" and not self.device_supported():
+            raise ValueError(
+                "the device-timeline backend covers counter-derived "
+                "statistics only (network_utilization under "
+                "[statistics_trace]); replication/utilization histograms, "
+                "per-tile progress rows and power sampling need live-state "
+                "snapshots the telemetry carry cannot afford — use "
+                "backend='chunked' (or 'auto') for those")
+        self.backend = backend
         self.out_dir = output_dir
         self._files: dict = {}
         self._prev_user_packets = 0.0
@@ -81,6 +117,25 @@ class StatisticsManager:
         self._prev_sample_ns = 0
         self._energy_monitor = None
         self._prev_energy_j = None
+
+    def device_supported(self) -> bool:
+        """True when every ENABLED statistic is counter-derived, i.e.
+        recordable from the carry by the device timeline: network
+        utilization yes; replication/utilization histograms (full L2
+        tag scans), per-tile progress rows and energy sampling no.
+        Meshed and streamed sims always fall back to the chunked loop
+        (the telemetry ring is not threaded through the multi-chip
+        exchange or the streaming window loop)."""
+        if self.sim.mesh is not None or self.sim.stream:
+            return False
+        if self.progress_enabled or self.power_enabled:
+            return False
+        if not self.enabled:
+            # nothing to record at all — the chunked loop degenerates
+            # to a plain run anyway, but there is no timeline to write
+            return False
+        unsupported = self.types - {"network_utilization"}
+        return not unsupported and "network_utilization" in self.types
 
     # -- trace files (`openTraceFiles`) ---------------------------------
     def _file(self, name: str):
@@ -233,17 +288,24 @@ class StatisticsManager:
         """Run the simulation to completion, sampling every interval.
 
         Requires lax_barrier (the reference demands the same:
-        `carbon_sim.cfg:397`); the chunk size is
-        sampling_interval / barrier quantum, so samples land on quantum
-        boundaries exactly as the reference's statistics thread does.
+        `carbon_sim.cfg:397`).  Backend dispatch: `device` records the
+        timeline inside ONE compiled run (zero host sync) and converts
+        it post-run; `chunked` drives the legacy host loop — chunk size
+        is sampling_interval / barrier quantum (`chunk_quanta`), so
+        samples land on quantum boundaries exactly as the reference's
+        statistics thread does.  `auto` picks `device` when every
+        enabled statistic is counter-derived.
         """
         sim = self.sim
         if sim.quantum_ps is None:
             raise ValueError(
                 "statistics sampling needs clock_skew_management/scheme = "
                 "lax_barrier (reference requirement)")
-        interval_ps = self.sampling_interval_ns * 1000
-        quanta_per_sample = max(1, interval_ps // sim.quantum_ps)
+        if self.backend == "device" or (self.backend == "auto"
+                                        and self.device_supported()):
+            return self._run_device(max_samples)
+        quanta_per_sample = chunk_quanta(self.sampling_interval_ns,
+                                         sim.quantum_ps)
         total_quanta = 0
         done = False
         for s in range(max_samples):
@@ -260,3 +322,57 @@ class StatisticsManager:
                 f"statistics run truncated: {max_samples} samples "
                 f"({total_quanta} quanta) without completing")
         return sim._results_from_state(total_quanta)
+
+    # -- device-timeline backend (round 9, graphite_tpu/obs) -------------
+    def _run_device(self, max_samples: int):
+        """One compiled telemetry-recording run, then a post-run pass
+        converting the timeline into the same `.trace` files the chunked
+        sampler writes — no per-sample host round trips."""
+        from graphite_tpu.obs import TelemetrySpec
+
+        sim = self.sim
+        series = ["time_ps", "packets_sent"]
+        if sim.state.mem is not None:
+            series += ["l2_misses", "invalidations", "evictions"]
+        sim.attach_telemetry(TelemetrySpec(
+            sample_interval_ps=self.sampling_interval_ns * 1000,
+            n_samples=max_samples, series=series))
+        results = sim.run()
+        self.write_timeline(results.telemetry)
+        self.close()
+        return results
+
+    def write_timeline(self, tl) -> None:
+        """Convert a recorded `obs.Timeline` into the chunked sampler's
+        `.trace` file formats (same rows, same normalization: per-ns
+        per-tile rates against the previous sample's timestamp)."""
+        if tl.wrapped:
+            raise ValueError(
+                "telemetry ring wrapped: the first "
+                f"{tl.n_total - len(tl)} sample(s) were overwritten — "
+                "raise max_samples (the ring depth) to cover the run")
+        T = max(self.sim.params.n_tiles, 1)
+        have_mem = all(s in tl.series
+                       for s in ("l2_misses", "invalidations", "evictions"))
+        prev_ns = 0
+        for i in range(len(tl)):
+            t_ns = int(tl.time_ns[i])
+            interval_ns = max(t_ns - prev_ns, 1)
+            prev_ns = t_ns
+            if "network_utilization" not in self.types or not self.enabled:
+                continue
+            rate = float(tl.col("packets_sent")[i]) / interval_ns / T
+            self._file("network_utilization_user").write(
+                f"{t_ns} {rate:.6f}\n")
+            if have_mem:
+                # the chunked backend's approximation applied to the
+                # recorded DELTAS (the formula is linear, so
+                # delta-of-approx == approx-of-delta)
+                mdelta = self._memory_message_count(
+                    {k: tl.col(k)[i:i + 1]
+                     for k in ("l2_misses", "invalidations", "evictions")})
+                f = self._file("network_utilization_memory")
+                if f.tell() == 0:
+                    f.write("# approximated from protocol counters "
+                            "(see _memory_message_count)\n")
+                f.write(f"{t_ns} {mdelta / interval_ns / T:.6f}\n")

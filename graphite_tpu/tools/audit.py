@@ -1,10 +1,12 @@
 """Program auditor CLI: lint the lowered default programs, JSON lines.
 
 Lowers the default config set — the per-phase-GATED private-L2 engine,
-the UNGATED one, the shared-L2 engine, and the B=4 vmapped sweep
-campaign — and runs every jaxpr invariant lint (analysis/rules.py)
-over each: cond-payload, knob-fold, time-dtype, vmap-gate, host-sync.
-Pure static analysis over `jax.make_jaxpr` output: no compile, no
+the UNGATED one, the shared-L2 engine, the B=4 vmapped sweep campaign,
+and the telemetry-recording gated engine — and runs every jaxpr
+invariant lint (analysis/rules.py) over each: cond-payload (with the
+telemetry ring's aval in the forbidden set for telemetry-on programs),
+knob-fold, time-dtype, vmap-gate, host-sync, telemetry-off.  Pure
+static analysis over `jax.make_jaxpr` output: no compile, no
 execution, runs on CPU-only CI in well under a minute.
 
 Output is JSON lines: one line per finding, then one summary line per
@@ -40,7 +42,7 @@ def main(argv=None) -> int:
                     help="exit nonzero on warnings too (e.g. vmap-gate)")
     ap.add_argument("--programs", default=None,
                     help="comma-separated subset of program names "
-                    "(default: all four)")
+                    "(default: all five)")
     args = ap.parse_args(argv)
 
     # auditing is host-side static analysis — never touch a real chip

@@ -15,9 +15,11 @@ Usage:
 minute on CPU with a warm XLA cache): the 16-tile per-phase-gated vs
 ungated engine pair must be bit-identical, the batched host-barrier
 dispatch (barrier_batch > 1) must reproduce the per-quantum dispatch
-exactly, the B=4 sweep must match sequential runs, and the program
-auditor's jaxpr invariant lints (graphite_tpu/analysis) must pass on
-the lowered default programs.
+exactly, the B=4 sweep must match sequential runs, telemetry recording
+must leave SimResults bit-identical (solo, gated + ungated) and the
+B=4 campaign's demuxed timelines must equal sequential telemetry runs,
+and the program auditor's jaxpr invariant lints
+(graphite_tpu/analysis) must pass on the lowered default programs.
 """
 
 from __future__ import annotations
@@ -130,7 +132,37 @@ def smoke(tiles: int = 16) -> int:
         failures += _compare(f"sweep B=4 sim {b} (seed {s}) vs sequential",
                              out.results[b], r_seq)
 
-    # 4) program auditor (round 8): the jaxpr invariant lints must pass
+    # 4) telemetry is pure observability (round 9): recording a dense
+    #    device timeline must leave every SimResults field bit-identical
+    #    (gated + ungated), and the B=4 campaign's demuxed [B, S, n]
+    #    timelines must equal 4 sequential telemetry runs' rows exactly
+    import numpy as np
+
+    from graphite_tpu.obs import TelemetrySpec
+
+    tel = TelemetrySpec(sample_interval_ps=1_000_000, n_samples=64)
+    for gate, label in ((True, "gated"), (False, "ungated")):
+        r_tel = Simulator(sc_b, batch, phase_gate=gate, mem_gate_bytes=0,
+                          telemetry=tel).run()
+        r_off = Simulator(sc_b, batch, phase_gate=gate,
+                          mem_gate_bytes=0).run()
+        failures += _compare(f"telemetry on vs off ({label} MSI, 16t)",
+                             r_tel, r_off)
+    sweep_tel = SweepRunner(sc_b, sweep_traces, telemetry=tel)
+    out_tel = sweep_tel.run()
+    for b, s in enumerate(seeds):
+        solo = Simulator(sc_b, sweep_traces[b],
+                         mailbox_depth=sweep_tel.mailbox_depth,
+                         phase_gate=False, mem_gate_bytes=0,
+                         telemetry=tel).run().telemetry
+        tl = out_tel.timelines[b]
+        ok = (tl.n_total == solo.n_total
+              and np.array_equal(tl.data, solo.data))
+        print(f"{f'sweep B=4 sim {b} timeline vs sequential':44} "
+              f"{'PASS' if ok else 'FAIL'}")
+        failures += 0 if ok else 1
+
+    # 5) program auditor (round 8): the jaxpr invariant lints must pass
     #    on the lowered default programs — both memory engines (gated,
     #    ungated, shl2) and the B=4 sweep campaign.  Static analysis
     #    only: make_jaxpr, no compile.

@@ -1,0 +1,86 @@
+"""Telemetry timeline reporter: render recorded timelines for CI/bench.
+
+Input: one or more `.npz` files saved via `obs.Timeline.save` — a solo
+run's timeline, or several (a campaign's demuxed `SweepOutcome.timelines`
+saved one file per sim).  Output (stdout):
+
+  --format json   one JSON line per sample (keys: sim, sample, time_ns,
+                  then one key per recorded series), then one summary
+                  line per timeline — the shape bench.py and the CI
+                  artifacts consume;
+  --format text   an aligned-text table per timeline (one row per
+                  sample) followed by its summary;
+  --summary       summaries only (either format).
+
+Usage:
+  python -m graphite_tpu.tools.report run.npz [sim0.npz sim1.npz ...]
+                                      [--format json|text] [--summary]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _text_table(tl) -> "list[str]":
+    """Aligned rows: sample index + time_ns + every non-time series."""
+    cols = ["sample", "time_ns"] + [s for s in tl.series
+                                    if s != "time_ps"]
+    rows = [[str(r["sample"]), str(r["time_ns"])]
+            + [str(r[s]) for s in cols[2:]] for r in tl.json_rows()]
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    lines = ["  ".join(c.rjust(w) for c, w in zip(cols, widths))]
+    for r in rows:
+        lines.append("  ".join(c.rjust(w) for c, w in zip(r, widths)))
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render device-recorded telemetry timelines")
+    ap.add_argument("files", nargs="+",
+                    help=".npz timeline file(s) (obs.Timeline.save); "
+                    "several files render as one campaign, sim-indexed "
+                    "in argument order")
+    ap.add_argument("--format", choices=("json", "text"), default="json")
+    ap.add_argument("--summary", action="store_true",
+                    help="emit per-timeline summaries only (peak "
+                    "injection rate, clock spread, stall quanta, ...)")
+    args = ap.parse_args(argv)
+
+    # pure host-side post-processing — never touch a chip
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from graphite_tpu.obs import Timeline
+
+    for b, path in enumerate(args.files):
+        tl = Timeline.load(path)
+        summary = {"sim": b, "file": path,
+                   "sample_interval_ps": tl.sample_interval_ps,
+                   **tl.summary()}
+        if args.format == "json":
+            if not args.summary:
+                for row in tl.json_rows():
+                    print(json.dumps({"sim": b, **row}))
+            print(json.dumps(summary))
+        else:
+            print(f"== sim {b}: {path} "
+                  f"(interval {tl.sample_interval_ps} ps, "
+                  f"{len(tl)} of {tl.n_total} samples"
+                  + (", ring WRAPPED" if tl.wrapped else "") + ")")
+            if not args.summary:
+                for line in _text_table(tl):
+                    print(line)
+            for k, v in summary.items():
+                if k not in ("sim", "file"):
+                    print(f"  {k:28} {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

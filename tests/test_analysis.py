@@ -354,12 +354,14 @@ def test_host_sync_clean_on_plain_program():
 
 
 def test_audit_default_programs_clean():
-    """The acceptance gate: gated, ungated, shl2 and sweep B=4 all pass
-    every rule — the same call `tools/regress.py --smoke` and
+    """The acceptance gate: gated, ungated, shl2, sweep B=4 and the
+    telemetry-recording gated engine all pass every rule — the same
+    call `tools/regress.py --smoke` and
     `python -m graphite_tpu.tools.audit` make."""
     report = audit(tiles=8)
     assert {r.program for r in report.results} == {
-        "gated-msi", "ungated-msi", "shl2-mesi", "sweep-b4"}
+        "gated-msi", "ungated-msi", "shl2-mesi", "sweep-b4",
+        "gated-msi-tel"}
     # the sweep program must get the knob-fold rule, the others not
     by_prog = {}
     for r in report.results:

@@ -181,6 +181,31 @@ enabled = true
         # the shared line is cached by all 4 tiles
         assert hist[3] >= 1
 
+    def test_memory_message_count_approximation_pinned(self):
+        """Pin the protocol-message approximation (2x misses req+rep +
+        2x invalidations + evictions) before the round-9 backend split:
+        the device-timeline backend reproduces the same formula over
+        recorded deltas, so a silent constant change would desync the
+        two backends' network_utilization_memory rows."""
+        sim = Simulator(make_config(), mem_workload())
+        stats = StatisticsManager(sim)
+        mc = {"l2_misses": np.array([3, 1]),
+              "invalidations": np.array([2, 0]),
+              "evictions": np.array([5])}
+        assert stats._memory_message_count(mc) == 2 * 4 + 2 * 2 + 5
+        assert stats._memory_message_count(None) == 0.0
+
+    def test_chunked_sampling_interval_arithmetic_pinned(self):
+        """Pin the chunked loop's interval -> quanta arithmetic
+        (sampling_interval floor-divided by the barrier quantum, never
+        below one quantum)."""
+        from graphite_tpu.system.statistics import chunk_quanta
+
+        assert chunk_quanta(10000, 1_000_000) == 10   # the defaults
+        assert chunk_quanta(2500, 1_000_000) == 2     # floor division
+        assert chunk_quanta(500, 1_000_000) == 1      # sub-quantum
+        assert chunk_quanta(1000, 1_000_000) == 1     # exactly one
+
 
 class TestLogAndOutput:
     def test_log_filters_and_files(self, tmp_path):
