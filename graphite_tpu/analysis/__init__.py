@@ -21,6 +21,12 @@ from graphite_tpu.analysis.audit import (  # noqa: F401
     clock_invar_indices, default_programs, spec_from_simulator,
     spec_from_sweep,
 )
+from graphite_tpu.analysis.cost import (  # noqa: F401
+    CostReport, ResidencyBudgetError, backend_memory_comparison,
+    budget_regression_fixture, check_budget, check_budgets, cost_report,
+    dynamic_cost, format_breakdown, load_budgets, peak_live_bytes,
+    residency_breakdown, save_budgets,
+)
 from graphite_tpu.analysis.rules import (  # noqa: F401
     Finding, cond_payload, host_sync, knob_fold, phase_conds,
     time_dtype, vmap_gate,

@@ -12,10 +12,11 @@ believes it is phase-gated (vmap-gate).
 
 The default program set mirrors the shapes every perf round is
 measured on: the per-phase-GATED private-L2 engine, the UNGATED one,
-the shared-L2 engine, the B=4 vmapped sweep campaign, and the
+the shared-L2 engine, the B=4 vmapped sweep campaign, the
 telemetry-recording gated engine (round 9 — the timeline ring must
 never ride a cond, and telemetry-off programs must carry no trace of
-the recording machinery).
+the recording machinery), and the combined sweep-B=4 + telemetry
+campaign (round 10 — the composition of the two).
 """
 
 from __future__ import annotations
@@ -67,6 +68,10 @@ class ProgramSpec:
     # scanned against the canonical dense spec's ring sig)
     expect_telemetry: bool = False
     telemetry_sig: "tuple | None" = None   # ((S, n_series), dtype)
+    # round 10: the engine's protocol-phase names in phase-cond program
+    # order, so the cost model (analysis/cost.py) can attribute the
+    # per-iteration kernel proxy phase-by-phase
+    phase_names: "tuple[str, ...]" = ()
 
 
 def _mem_forbidden_avals(sim):
@@ -137,8 +142,9 @@ def spec_from_simulator(name: str, sim,
     closed, paths = sim.lower(max_quanta)
     expect_gated = (sim.params.mem is not None
                     and bool(sim.params.mem.phase_gate))
-    n_phases = (len(mem_phase_names(sim.params))
-                if sim.params.mem is not None else 6)
+    phase_names = (tuple(mem_phase_names(sim.params))
+                   if sim.params.mem is not None else ())
+    n_phases = len(phase_names) if phase_names else 6
     tel_forbidden, expect_tel, tel_sig = _telemetry_fields(sim)
     return ProgramSpec(
         name=name, closed=closed, invar_paths=paths,
@@ -147,7 +153,8 @@ def spec_from_simulator(name: str, sim,
         forbidden_cond_avals=_mem_forbidden_avals(sim) + tel_forbidden,
         clock_invars=clock_invar_indices(paths),
         expect_telemetry=expect_tel,
-        telemetry_sig=tel_sig)
+        telemetry_sig=tel_sig,
+        phase_names=phase_names)
 
 
 def spec_from_sweep(name: str, runner,
@@ -183,8 +190,9 @@ def spec_from_sweep(name: str, runner,
         knob_invars.pop("sync_delay_cycles", None)
     expect_gated = (sim.params.mem is not None
                     and bool(sim.params.mem.phase_gate))
-    n_phases = (len(mem_phase_names(sim.params))
-                if sim.params.mem is not None else 6)
+    phase_names = (tuple(mem_phase_names(sim.params))
+                   if sim.params.mem is not None else ())
+    n_phases = len(phase_names) if phase_names else 6
     tel_forbidden, expect_tel, tel_sig = _telemetry_fields(sim)
     return ProgramSpec(
         name=name, closed=closed, invar_paths=paths,
@@ -193,7 +201,8 @@ def spec_from_sweep(name: str, runner,
         forbidden_cond_avals=_mem_forbidden_avals(sim) + tel_forbidden,
         clock_invars=clock_invar_indices(paths),
         expect_telemetry=expect_tel,
-        telemetry_sig=tel_sig)
+        telemetry_sig=tel_sig,
+        phase_names=phase_names)
 
 
 # ---------------------------------------------------------------------------
@@ -202,15 +211,18 @@ def spec_from_sweep(name: str, runner,
 
 
 DEFAULT_PROGRAM_NAMES = ("gated-msi", "ungated-msi", "shl2-mesi",
-                         "sweep-b4", "gated-msi-tel")
+                         "sweep-b4", "gated-msi-tel", "sweep-b4-tel")
 
 
 def default_programs(tiles: int = 8, max_quanta: int = 4096,
                      names=None) -> "list[ProgramSpec]":
-    """The five audited shapes: gated, ungated, shl2, sweep B=4, and
-    the telemetry-recording gated engine (round 9: the ring's aval joins
-    the cond-payload forbidden set; the other four — telemetry OFF —
-    additionally run the telemetry-off lint).
+    """The six audited shapes: gated, ungated, shl2, sweep B=4, the
+    telemetry-recording gated engine (round 9: the ring's aval joins
+    the cond-payload forbidden set; telemetry-OFF programs additionally
+    run the telemetry-off lint), and the COMBINED sweep-B=4 + telemetry
+    campaign (round 10: campaign timelines were previously only audited
+    solo, so the [B, S, n_series] ring under vmap never met the
+    cond-payload or knob-fold lints — the composition is audited now).
 
     Small geometry on purpose — the lints are structural, so the
     8-tile lowering carries the same program shape the 1024-tile
@@ -271,7 +283,7 @@ associativity = 4
         specs.append(spec_from_simulator("shl2-mesi", Simulator(
             sc_shl2, batch, phase_gate=True, mem_gate_bytes=0),
             max_quanta))
-    if "sweep-b4" in names:
+    if "sweep-b4" in names or "sweep-b4-tel" in names:
         # the sweep config splits the modules over TWO DVFS domains so
         # the sync_delay knob actually crosses a boundary — in a
         # single-domain config it is structurally inert (MemParams.
@@ -295,6 +307,7 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
                 write_fraction=0.4, shared_fraction=0.5, seed=s)
             for s in (1, 2, 3, 4)
         ]
+    if "sweep-b4" in names:
         runner = SweepRunner(sc_sweep, sweep_traces, shard_batch=False)
         specs.append(spec_from_sweep("sweep-b4", runner, max_quanta))
     if "gated-msi-tel" in names:
@@ -304,6 +317,18 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
             sc, batch, phase_gate=True, mem_gate_bytes=0,
             telemetry=TelemetrySpec(sample_interval_ps=1_000_000,
                                     n_samples=32)), max_quanta))
+    if "sweep-b4-tel" in names:
+        from graphite_tpu.obs import TelemetrySpec
+
+        # the combined campaign-timelines program: the [B, S, n_series]
+        # ring must stay off every cond AND every knob must stay live
+        # with the recording machinery in the loop body
+        runner_tel = SweepRunner(
+            sc_sweep, sweep_traces, shard_batch=False,
+            telemetry=TelemetrySpec(sample_interval_ps=1_000_000,
+                                    n_samples=32))
+        specs.append(spec_from_sweep("sweep-b4-tel", runner_tel,
+                                     max_quanta))
     return specs
 
 

@@ -285,6 +285,11 @@ def mem_phase_names(params: EngineParams) -> tuple:
     return PHASE_NAMES
 
 
+# run_streamed's default [T, W] window length — also the window bound
+# residency_breakdown prices for a streaming sim, so the two stay one
+# number.
+STREAM_WINDOW_RECORDS = 4096
+
 _STREAM_RUNNERS: dict = {}
 # Each cached wrapper pins a compiled executable (tens of MB of device
 # program + host tracing caches); long-lived processes sweeping many
@@ -764,20 +769,60 @@ class Simulator:
         `StatisticsManager`'s device backend to upgrade a plain sim."""
         from graphite_tpu.obs.telemetry import TelemetrySpec, init_telemetry
 
-        if self.mesh is not None or self.stream:
-            raise ValueError(
-                "telemetry timelines support single-device resident runs "
-                "and batched sweeps only (the ring is not threaded "
-                "through the multi-chip exchange or the streaming window "
-                "loop; use the chunked StatisticsManager backend there)")
         if not isinstance(spec, TelemetrySpec):
             raise TypeError("telemetry must be an obs.TelemetrySpec")
         spec = spec.resolve(self.params)
+        if self.mesh is not None or self.stream:
+            # the ONE residency-refusal exception type (analysis/cost.py):
+            # the message carries the analyzer's per-consumer breakdown so
+            # the caller sees exactly what the refused layout would cost
+            from graphite_tpu.analysis.cost import (
+                ResidencyBudgetError, format_breakdown,
+            )
+
+            raise ResidencyBudgetError(
+                "telemetry timelines support single-device resident runs "
+                "and batched sweeps only (the ring is not threaded "
+                "through the multi-chip exchange or the streaming window "
+                "loop; use the chunked StatisticsManager backend there); "
+                "refused residency: "
+                + format_breakdown(self.residency_breakdown(spec)))
         self.telemetry_spec = spec
         self.state = self.state.replace(telemetry=init_telemetry(spec))
         self._runner = None
         self._runner_max_quanta = None
         self._hb_runner = None
+
+    def residency_breakdown(self, telemetry_spec=None) -> dict:
+        """Per-consumer HBM residency estimate of THIS sim's layout
+        (analysis/cost.residency_breakdown): state pytree, resident
+        device trace (or one streaming window bound), telemetry ring.
+        `telemetry_spec` overrides the attached spec — attach_telemetry
+        prices the spec it is refusing before it is attached."""
+        from graphite_tpu.analysis.cost import residency_breakdown
+
+        spec = telemetry_spec if telemetry_spec is not None \
+            else self.telemetry_spec
+        if spec is not None and not spec.resolved:
+            spec = spec.resolve(self.params)
+        # the ring is itemized as its own consumer — strip it from the
+        # state pytree so an attached spec is not counted twice
+        state = self.state.replace(telemetry=None) \
+            if self.state.telemetry is not None else self.state
+        stream_bytes = None
+        if self.stream:
+            # run_streamed's default [T, W] window, double-buffered by
+            # the prefetch staging — pure arithmetic, never materialized
+            # (this runs inside refusal paths on memory-constrained
+            # devices, so it must not allocate what it is pricing)
+            per_record = sum(
+                np.dtype(getattr(self.trace_batch, f.name).dtype).itemsize
+                for f in dataclasses.fields(self.trace_batch))
+            stream_bytes = (2 * self.params.n_tiles
+                            * STREAM_WINDOW_RECORDS * per_record)
+        return residency_breakdown(
+            state=state, trace=self.device_trace,
+            telemetry_spec=spec, stream_window_bytes=stream_bytes)
 
     @property
     def telemetry(self):
@@ -865,12 +910,22 @@ class Simulator:
         tracing, no compile, so auditing works on CPU-only CI.  Path i
         of the returned list names closed.jaxpr.invars[i] (state leaves
         first, then trace leaves)."""
+        from graphite_tpu.analysis.walk import invar_path_strings
+
+        fn, args = self._auditable_fn(max_quanta)
+        closed = jax.make_jaxpr(fn)(*args)
+        return closed, invar_path_strings(args)
+
+    def _auditable_fn(self, max_quanta: int = 4096):
+        """(fn, args) of the program run() actually executes — lower()
+        traces it with make_jaxpr; the cost model's backend cross-check
+        (analysis/cost.backend_memory_comparison) jits and compiles the
+        SAME pair, so the static estimate and memory_analysis() always
+        describe one artifact."""
         if self.mesh is not None or self.stream:
             raise ValueError(
                 "lower() supports single-device resident programs only "
                 "(the auditable artifact is the one-region jaxpr)")
-        from graphite_tpu.analysis.walk import invar_path_strings
-
         params = self.params
         tel = self.telemetry_spec
         if self.barrier_host:
@@ -895,8 +950,7 @@ class Simulator:
                                       telemetry=tel)
 
             args = (self.state, self.device_trace)
-        closed = jax.make_jaxpr(fn)(*args)
-        return closed, invar_path_strings(args)
+        return fn, args
 
     def run_chunk(self, n_quanta: int):
         """Run at most `n_quanta` quanta (for sampled/checkpointed runs).
@@ -1068,7 +1122,7 @@ class Simulator:
                 f.write(f"{key} = {value}\n")
         return out_path
 
-    def run_streamed(self, window_records: int = 4096,
+    def run_streamed(self, window_records: int = STREAM_WINDOW_RECORDS,
                      max_quanta: int = 1_000_000,
                      max_windows: int = 1_000_000) -> SimResults:
         """Like run(), but the trace streams host->HBM in [T, W] windows

@@ -146,6 +146,17 @@ class TelemetrySpec:
         is ON; the telemetry-off rule when it must be absent)."""
         return ((int(self.n_samples), self.n_series), "int64")
 
+    def ring_bytes(self) -> int:
+        """Per-sim device residency of this spec's TelemetryState: the
+        [S, n_series] ring + the prev snapshot + the five scalar
+        cursors, all int64.  The ONE size model the residency budget
+        consumes (analysis/cost.residency_breakdown) — a campaign pays
+        B x this, which is why `attach_telemetry` refuses layouts that
+        cannot afford the ring."""
+        (S, n), dtype = self.buffer_sig()
+        item = np.dtype(dtype).itemsize
+        return S * n * item + n * item + 5 * item
+
     def delta_mask(self) -> np.ndarray:
         """bool[n_series]: True where the series records a delta."""
         return np.array([s not in LEVEL_SERIES for s in self.series],

@@ -103,11 +103,18 @@ class SweepRunner:
        real lax.cond gating stays alive and sims run in parallel across
        devices (host cores on the virtual CPU platform, chips on a TPU
        slice).  `shard_batch=False` forces plain vmap.
+
+    `hbm_budget_bytes` (else `[general] hbm_budget_bytes`, 0 = off)
+    arms the pre-compile residency fail-fast: the campaign's estimated
+    footprint (B x state + resident traces + telemetry rings) above the
+    budget raises `analysis.cost.ResidencyBudgetError` — with the
+    per-consumer breakdown — before any tracing starts.
     """
 
     def __init__(self, config, traces, points: "list[dict] | None" = None,
                  *, mailbox_depth: "int | None" = None,
-                 shard_batch: "bool | None" = None, **sim_kwargs):
+                 shard_batch: "bool | None" = None,
+                 hbm_budget_bytes: "int | None" = None, **sim_kwargs):
         from graphite_tpu.engine.simulator import Simulator, \
             auto_mailbox_depth
 
@@ -196,6 +203,50 @@ class SweepRunner:
         self._runner_max_quanta = None
         self._dtr = None      # device-resident [B, T, L] traces (cached)
         self._states0 = None  # broadcast [B, ...] initial states (cached)
+        # Pre-compile residency fail-fast (round 10): the campaign's HBM
+        # bill is B x per-sim state + the resident [B, T, L] traces +
+        # B telemetry rings — all known BEFORE tracing, so a sweep of
+        # big sims with timelines refuses as a NAMED error here instead
+        # of a device OOM minutes into compile.  Budget: kwarg, else
+        # `[general] hbm_budget_bytes`, else 0 (disabled).
+        if hbm_budget_bytes is None:
+            hbm_budget_bytes = self.sim.config.cfg.get_int(
+                "general/hbm_budget_bytes", 0)
+        self.hbm_budget_bytes = int(hbm_budget_bytes)
+        if self.hbm_budget_bytes:
+            from graphite_tpu.analysis.cost import (
+                ResidencyBudgetError, format_breakdown,
+            )
+
+            breakdown = self.residency_breakdown()
+            if breakdown["total"] > self.hbm_budget_bytes:
+                raise ResidencyBudgetError(
+                    f"campaign residency exceeds hbm_budget_bytes="
+                    f"{self.hbm_budget_bytes} before compile (B="
+                    f"{self.pack.n_sims}): "
+                    + format_breakdown(breakdown)
+                    + " — shrink the batch, stream fewer consumers "
+                    "(drop telemetry or shorten traces), or raise "
+                    "`[general] hbm_budget_bytes`")
+
+    def residency_breakdown(self) -> "dict[str, int]":
+        """Per-consumer HBM estimate of this campaign's resident layout
+        (analysis/cost.residency_breakdown): B x per-sim state, the
+        packed [B, T, L] traces, B telemetry rings.  The same itemized
+        dict the pre-compile fail-fast prints."""
+        from graphite_tpu.analysis.cost import residency_breakdown
+        from graphite_tpu.sweep.pack import PackedTraces
+
+        trace_arrays = {f: getattr(self.pack, f)
+                        for f in PackedTraces._TRACE_FIELDS}
+        # the ring is itemized as its own consumer — strip it from the
+        # per-sim state so an attached spec is not counted twice
+        state = self.sim.state.replace(telemetry=None) \
+            if self.sim.state.telemetry is not None else self.sim.state
+        return residency_breakdown(
+            state=state, trace=trace_arrays,
+            batch=self.pack.n_sims,
+            telemetry_spec=self.sim.telemetry_spec)
 
     @property
     def n_sims(self) -> int:

@@ -1,21 +1,33 @@
-"""Program auditor CLI: lint the lowered default programs, JSON lines.
+"""Program auditor CLI: lint + cost the lowered default programs.
 
 Lowers the default config set — the per-phase-GATED private-L2 engine,
 the UNGATED one, the shared-L2 engine, the B=4 vmapped sweep campaign,
-and the telemetry-recording gated engine — and runs every jaxpr
-invariant lint (analysis/rules.py) over each: cond-payload (with the
-telemetry ring's aval in the forbidden set for telemetry-on programs),
-knob-fold, time-dtype, vmap-gate, host-sync, telemetry-off.  Pure
-static analysis over `jax.make_jaxpr` output: no compile, no
+the telemetry-recording gated engine, and the combined sweep+telemetry
+campaign — and runs every jaxpr invariant lint (analysis/rules.py) over
+each: cond-payload (with the telemetry ring's aval in the forbidden set
+for telemetry-on programs), knob-fold, time-dtype, vmap-gate, host-sync,
+telemetry-off.  Each program's STATIC COST report (analysis/cost.py —
+per-iteration kernel proxy with per-phase attribution, bytes moved,
+peak-live residency) is emitted as a JSON line alongside the lint rows.
+Pure static analysis over `jax.make_jaxpr` output: no compile, no
 execution, runs on CPU-only CI in well under a minute.
 
-Output is JSON lines: one line per finding, then one summary line per
-program, then one trailing overall line.  Exit code 0 iff no
-error-severity finding fired (`--strict` also fails on warnings).
+`--budget` additionally gates every cost report against the checked-in
+BUDGETS.json ceilings (exit nonzero on any excess, the offending
+equation named); `--budget-update` refreshes the baselines after an
+intentional change.  `--regression-fixture` swaps in the known-bad
+inflated-carry program — the gate must trip on it (the CI self-test).
+
+Output is JSON lines: one line per lint finding, one cost line and one
+summary line per program, then one trailing overall line.  Exit code 0
+iff no error-severity finding fired (`--strict` also fails on warnings).
 
 Usage:
   python -m graphite_tpu.tools.audit [--tiles 8] [--max-cond-bytes N]
                                      [--strict] [--programs a,b,...]
+                                     [--budget | --budget-update]
+                                     [--budgets-file PATH]
+                                     [--regression-fixture]
 """
 
 from __future__ import annotations
@@ -29,7 +41,8 @@ import time
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="jaxpr invariant lints over the default programs")
+        description="jaxpr invariant lints + static cost/budget gates "
+        "over the default programs")
     ap.add_argument("--tiles", type=int, default=8,
                     help="tile count for the audited geometries (the "
                     "lints are structural; 8 carries the same program "
@@ -42,14 +55,43 @@ def main(argv=None) -> int:
                     help="exit nonzero on warnings too (e.g. vmap-gate)")
     ap.add_argument("--programs", default=None,
                     help="comma-separated subset of program names "
-                    "(default: all five)")
+                    "(default: all six)")
+    ap.add_argument("--budget", action="store_true",
+                    help="gate each cost report against BUDGETS.json "
+                    "ceilings (exit nonzero on any excess)")
+    ap.add_argument("--budget-update", action="store_true",
+                    help="refresh BUDGETS.json baselines+ceilings from "
+                    "this run's measurements (after an INTENTIONAL "
+                    "change; merges, so --programs subsets are safe)")
+    ap.add_argument("--budgets-file", default=None,
+                    help="override the BUDGETS.json path (default: "
+                    "repo root)")
+    ap.add_argument("--regression-fixture", action="store_true",
+                    help="audit the known-bad inflated-carry fixture "
+                    "instead of the real gated-msi program — the budget "
+                    "gate MUST exit nonzero (CI self-test)")
     args = ap.parse_args(argv)
+    if args.budget and args.budget_update:
+        ap.error("--budget and --budget-update are mutually exclusive "
+                 "(gate against the ceilings OR refresh them, not both)")
+    if args.regression_fixture and args.budget_update:
+        # the fixture deliberately reuses the real program's name so the
+        # gate runs against its checked-in ceilings — writing its
+        # inflated measurements back would corrupt the real baseline and
+        # turn the CI self-test green on a broken gate
+        ap.error("--regression-fixture is a read-only self-test; it "
+                 "cannot be combined with --budget-update")
+    # the fixture exists only to prove the gate trips — without the gate
+    # its lints all pass and the self-test would be vacuously green
+    if args.regression_fixture:
+        args.budget = True
 
     # auditing is host-side static analysis — never touch a real chip
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     import graphite_tpu  # noqa: F401  (x64)
 
+    from graphite_tpu.analysis import cost
     from graphite_tpu.analysis.audit import (
         DEFAULT_MAX_COND_BYTES, audit, default_programs,
     )
@@ -59,24 +101,53 @@ def main(argv=None) -> int:
     if args.programs:
         names = [s.strip() for s in args.programs.split(",") if s.strip()]
     try:
-        specs = default_programs(args.tiles, names=names)
+        if args.regression_fixture:
+            specs = [cost.budget_regression_fixture(args.tiles)]
+        else:
+            specs = default_programs(args.tiles, names=names)
     except ValueError as e:
         raise SystemExit(str(e))
     report = audit(specs, max_cond_bytes=(
         args.max_cond_bytes if args.max_cond_bytes is not None
         else DEFAULT_MAX_COND_BYTES))
 
+    # static cost reports ride alongside the lint rows unconditionally
+    # (walking a lowered jaxpr is cheap; the budget GATE is opt-in)
+    cost_reports = [cost.cost_report(s) for s in specs]
+    budget_findings = []
+    if args.budget or args.budget_update:
+        if args.budget_update:
+            path = cost.save_budgets(cost_reports, args.budgets_file)
+            print(json.dumps({"budgets_updated": True, "path": path,
+                              "programs": [r.program
+                                           for r in cost_reports]}))
+        else:
+            try:
+                budgets = cost.load_budgets(args.budgets_file)
+            except FileNotFoundError as e:
+                raise SystemExit(
+                    f"no budgets file ({e}); create one with "
+                    f"--budget-update")
+            budget_findings = cost.check_budgets(cost_reports, budgets)
+
     for f in report.findings:
+        print(json.dumps(f.to_json()))
+    for rep in cost_reports:
+        print(json.dumps(rep.to_json()))
+    for f in budget_findings:
         print(json.dumps(f.to_json()))
     for row in report.summary_rows():
         print(json.dumps(row))
-    ok = report.ok and not (args.strict and report.findings)
+    n_budget_err = len(budget_findings)
+    ok = (report.ok and not n_budget_err
+          and not (args.strict and report.findings))
     print(json.dumps({
         "overall": True,
         "ok": ok,
         "programs": len(specs),
-        "errors": len(report.errors),
+        "errors": len(report.errors) + n_budget_err,
         "warnings": len(report.findings) - len(report.errors),
+        "budget_errors": n_budget_err,
         "wall_s": round(time.perf_counter() - t0, 1),
     }))
     return 0 if ok else 1

@@ -18,8 +18,12 @@ dispatch (barrier_batch > 1) must reproduce the per-quantum dispatch
 exactly, the B=4 sweep must match sequential runs, telemetry recording
 must leave SimResults bit-identical (solo, gated + ungated) and the
 B=4 campaign's demuxed timelines must equal sequential telemetry runs,
-and the program auditor's jaxpr invariant lints
-(graphite_tpu/analysis) must pass on the lowered default programs.
+the program auditor's jaxpr invariant lints (graphite_tpu/analysis)
+must pass on the lowered default programs, and every default program's
+static cost report must sit within the checked-in BUDGETS.json
+ceilings (the round-10 budget gate — kernel proxy, bytes/iter, peak
+residency; tools/audit.py --budget-update refreshes after an
+intentional change).
 """
 
 from __future__ import annotations
@@ -164,11 +168,12 @@ def smoke(tiles: int = 16) -> int:
 
     # 5) program auditor (round 8): the jaxpr invariant lints must pass
     #    on the lowered default programs — both memory engines (gated,
-    #    ungated, shl2) and the B=4 sweep campaign.  Static analysis
-    #    only: make_jaxpr, no compile.
-    from graphite_tpu.analysis import audit
+    #    ungated, shl2), the B=4 sweep campaign, and the telemetry
+    #    programs.  Static analysis only: make_jaxpr, no compile.
+    from graphite_tpu.analysis import audit, default_programs
 
-    report = audit(tiles=8)
+    specs = default_programs(8)
+    report = audit(specs)
     for row in report.summary_rows():
         name = f"audit {row['program']}"
         ok = row["ok"]
@@ -177,6 +182,28 @@ def smoke(tiles: int = 16) -> int:
         failures += 0 if ok else 1
     for f in report.findings:
         print(f"    {f}")
+
+    # 6) budget gate (round 10): every default program's static cost
+    #    report (analysis/cost.py) must sit within the checked-in
+    #    BUDGETS.json ceilings — kernel proxy, bytes/iter, peak
+    #    residency.  The same lowered specs as rung 5; no compile.
+    from graphite_tpu.analysis import cost as _cost
+
+    try:
+        budgets = _cost.load_budgets()
+    except FileNotFoundError:
+        print(f"{'budget BUDGETS.json':44} FAIL  (missing — run "
+              f"tools/audit.py --budget-update)")
+        failures += 1
+    else:
+        for spec in specs:
+            rep = _cost.cost_report(spec)
+            trips = _cost.check_budget(rep, budgets)
+            name = f"budget {rep.program}"
+            print(f"{name:44} {'PASS' if not trips else 'FAIL'}")
+            for f in trips:
+                print(f"    {f}")
+            failures += 1 if trips else 0
 
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
     return 1 if failures else 0

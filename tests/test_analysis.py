@@ -354,20 +354,26 @@ def test_host_sync_clean_on_plain_program():
 
 
 def test_audit_default_programs_clean():
-    """The acceptance gate: gated, ungated, shl2, sweep B=4 and the
-    telemetry-recording gated engine all pass every rule — the same
-    call `tools/regress.py --smoke` and
-    `python -m graphite_tpu.tools.audit` make."""
+    """The acceptance gate: gated, ungated, shl2, sweep B=4, the
+    telemetry-recording gated engine AND the combined sweep+telemetry
+    campaign all pass every rule — the same call
+    `tools/regress.py --smoke` and `python -m graphite_tpu.tools.audit`
+    make."""
     report = audit(tiles=8)
     assert {r.program for r in report.results} == {
         "gated-msi", "ungated-msi", "shl2-mesi", "sweep-b4",
-        "gated-msi-tel"}
-    # the sweep program must get the knob-fold rule, the others not
+        "gated-msi-tel", "sweep-b4-tel"}
+    # the sweep programs must get the knob-fold rule, the others not
     by_prog = {}
     for r in report.results:
         by_prog.setdefault(r.program, set()).add(r.rule)
     assert "knob-fold" in by_prog["sweep-b4"]
+    assert "knob-fold" in by_prog["sweep-b4-tel"]
     assert "knob-fold" not in by_prog["gated-msi"]
+    # the combined campaign records telemetry, so the telemetry-off
+    # lint must NOT run on it (the ring is policed via cond-payload)
+    assert "telemetry-off" not in by_prog["sweep-b4-tel"]
+    assert "telemetry-off" in by_prog["sweep-b4"]
     assert report.ok and not report.findings, "\n".join(
         str(f) for f in report.findings)
 

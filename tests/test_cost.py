@@ -1,0 +1,369 @@
+"""Static cost & residency model + budget gates (analysis/cost.py).
+
+Three layers under test: the cost walk itself (hand-built programs with
+known byte/eqn counts — the model's semantics are pinned exactly), the
+budget gate (a clean program stays within its own ceilings; the
+known-regression inflated-carry fixture trips them with the offending
+equation named; BUDGETS.json round-trips through the CLI's
+--budget-update), and the residency layer (per-consumer breakdown, the
+SweepRunner pre-compile fail-fast, and the unified
+ResidencyBudgetError the telemetry refusals now raise).  The CPU
+oracle test cross-checks the static estimate against the backend's own
+`compiled.memory_analysis()` within the documented tolerance.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from graphite_tpu.analysis import cost
+from graphite_tpu.analysis.audit import default_programs
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine.simulator import Simulator
+from graphite_tpu.sweep import SweepRunner
+from graphite_tpu.tools._template import config_text
+from graphite_tpu.trace import synthetic
+
+TILES = 8
+
+GEOMETRY = """
+[l1_icache/T1]
+cache_size = 4
+associativity = 2
+[l1_dcache/T1]
+cache_size = 8
+associativity = 4
+[l2_cache/T1]
+cache_size = 32
+associativity = 8
+[dram_directory]
+total_entries = 64
+associativity = 4
+"""
+
+
+def _config(**over):
+    return SimConfig(ConfigFile.from_string(config_text(
+        TILES, shared_mem=True, clock_scheme="lax_barrier") + GEOMETRY))
+
+
+def _trace(seed=7):
+    return synthetic.memory_stress_trace(
+        TILES, n_accesses=16, working_set_bytes=1 << 12,
+        write_fraction=0.4, shared_fraction=0.5, seed=seed)
+
+
+@pytest.fixture(scope="module")
+def gated_spec():
+    """The gated-MSI audited program, lowered once per module."""
+    return default_programs(TILES, names=("gated-msi",))[0]
+
+
+@pytest.fixture(scope="module")
+def gated_report(gated_spec):
+    return cost.cost_report(gated_spec)
+
+
+# ---------------------------------------------------------------------------
+# the cost walk: exact semantics on hand-built programs
+# ---------------------------------------------------------------------------
+
+
+class TestCostWalk:
+    def test_peak_live_scan_exact(self):
+        """Straight-line liveness: x [8 KB] -> y = x+1 -> z = y+x.
+        At z both x and y are live plus z's output: 3 x 8 KB."""
+        def f(x):
+            y = x + 1.0
+            return y + x
+
+        closed = jax.make_jaxpr(f)(jnp.ones(1024))
+        assert cost.peak_live_bytes(closed) == 3 * 8192
+
+    def test_peak_counts_loop_carry_double_buffer(self):
+        """A while carrying an 8 KB buffer: operand + loop output +
+        the body's own transient — the double-buffer the round-6
+        cond-payload contract prices."""
+        def f(x):
+            return jax.lax.while_loop(
+                lambda c: c.sum() < 10, lambda c: c + 1.0, x)
+
+        closed = jax.make_jaxpr(f)(jnp.ones(1024))
+        assert cost.peak_live_bytes(closed) == 3 * 8192
+
+    def test_dynamic_cost_scan_multiplier(self):
+        """scan length multiplies its body's eqns and bytes."""
+        def f(x):
+            def step(c, _):
+                return c + 1.0, ()
+            out, _ = jax.lax.scan(step, x, None, length=10)
+            return out
+
+        closed = jax.make_jaxpr(f)(jnp.ones(1024))
+        dc = cost.dynamic_cost(closed)
+        # one add per scan step: 10 eqns, 10 x (in 8192 + out 8192;
+        # the +1.0 literal carries no bytes)
+        assert dc.eqns == 10
+        assert dc.bytes_moved == 10 * (8192 + 8192)
+
+    def test_dynamic_cost_cond_takes_heavy_branch(self):
+        """cond costs its heaviest arm (the dense-iteration view), not
+        both arms."""
+        def f(p, x):
+            return jax.lax.cond(p, lambda v: v * 2.0 + 1.0,
+                                lambda v: v, x)
+
+        closed = jax.make_jaxpr(f)(True, jnp.ones(1024))
+        dc = cost.dynamic_cost(closed)
+        # heavy branch: mul + add = 2 eqns (identity arm: 0), plus the
+        # cond output copy counted as traffic
+        assert dc.eqns == 2
+
+    def test_free_primitives_excluded_from_kernel_proxy(self):
+        def f(x):
+            return jnp.reshape(x, (32, 32)).astype(jnp.float32)
+
+        closed = jax.make_jaxpr(f)(jnp.ones(1024))
+        assert cost.dynamic_cost(closed).eqns == 0
+
+    def test_main_loop_body_finds_quantum_loop(self, gated_spec):
+        body = cost.main_loop_body(gated_spec.closed)
+        assert body is not None
+        # the quantum loop holds the engine: most of the program's eqns
+        from graphite_tpu.analysis.walk import iter_eqns
+
+        assert sum(1 for _ in iter_eqns(body)) > 1000
+
+
+# ---------------------------------------------------------------------------
+# the report: real-program structure
+# ---------------------------------------------------------------------------
+
+
+class TestCostReport:
+    def test_report_metrics_present_and_positive(self, gated_report):
+        m = gated_report.metrics()
+        assert set(m) == set(cost.BUDGET_METRICS)
+        assert all(v > 0 for v in m.values()), m
+
+    def test_phase_attribution_covers_all_phases(self, gated_report):
+        """The per-iteration kernel proxy attributes one entry per
+        protocol phase, named from the engine's own phase list."""
+        from graphite_tpu.memory.engine import PHASE_NAMES
+
+        assert {p.name for p in gated_report.phase_costs} \
+            == set(PHASE_NAMES)
+        assert all(p.eqns > 0 for p in gated_report.phase_costs)
+        assert gated_report.base_kernels_per_iter > 0
+
+    def test_ungated_program_has_no_phase_rows(self):
+        spec = default_programs(TILES, names=("ungated-msi",))[0]
+        rep = cost.cost_report(spec)
+        assert rep.phase_costs == []
+        assert rep.base_kernels_per_iter == rep.kernels_per_iter
+
+    def test_top_eqns_sorted_and_sited(self, gated_report):
+        tops = gated_report.top_eqns
+        assert tops == sorted(tops, key=lambda r: r["out_bytes"],
+                              reverse=True)
+        assert all("site" in r and "primitive" in r for r in tops)
+
+    def test_report_json_roundtrips(self, gated_report):
+        row = json.loads(json.dumps(gated_report.to_json()))
+        assert row["cost"] is True and row["program"] == "gated-msi"
+        assert row["phases"][0]["eqns"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the budget gate
+# ---------------------------------------------------------------------------
+
+
+class TestBudgetGate:
+    def test_clean_program_within_own_ceilings(self, gated_report,
+                                               tmp_path):
+        p = str(tmp_path / "b.json")
+        cost.save_budgets([gated_report], p)
+        assert cost.check_budget(gated_report,
+                                 cost.load_budgets(p)) == []
+
+    def test_missing_entry_is_an_error(self, gated_report):
+        findings = cost.check_budget(gated_report, {})
+        assert len(findings) == 1
+        assert "no budget entry" in findings[0].message
+
+    def test_checked_in_budgets_cover_all_default_programs(self):
+        from graphite_tpu.analysis.audit import DEFAULT_PROGRAM_NAMES
+
+        budgets = cost.load_budgets()
+        assert set(DEFAULT_PROGRAM_NAMES) <= set(budgets)
+        for name in DEFAULT_PROGRAM_NAMES:
+            entry = budgets[name]
+            assert set(entry["ceiling"]) == set(cost.BUDGET_METRICS)
+            for m in cost.BUDGET_METRICS:
+                assert entry["ceiling"][m] > entry["measured"][m]
+
+    def test_regression_fixture_trips_gate_naming_eqn(self, gated_report,
+                                                      tmp_path):
+        """The known-regression fixture: the gated-MSI program with a
+        96 MB buffer riding an extra while carry must blow the peak
+        budget, and the finding must name the offending equation."""
+        p = str(tmp_path / "b.json")
+        cost.save_budgets([gated_report], p)
+        fix = cost.budget_regression_fixture(TILES)
+        frep = cost.cost_report(fix)
+        findings = cost.check_budget(frep, cost.load_budgets(p))
+        metrics_hit = {f.data["metric"] for f in findings}
+        assert "peak_bytes" in metrics_hit
+        peak = next(f for f in findings
+                    if f.data["metric"] == "peak_bytes")
+        suspect = peak.data["suspect"]
+        # the inflated carried buffer is the named suspect
+        assert suspect["out_bytes"] >= 90 << 20
+        assert "while" in suspect["site"]
+        assert suspect["site"] in peak.message \
+            or suspect["primitive"] in peak.message
+
+    def test_budget_update_cli_roundtrip(self, tmp_path):
+        """--budget-update writes a file --budget then passes against;
+        tightening a ceiling below the measurement makes the SAME run
+        exit nonzero (the gate is live, not decorative)."""
+        from graphite_tpu.tools.audit import main
+
+        p = str(tmp_path / "budgets.json")
+        assert main(["--programs", "gated-msi", "--budget-update",
+                     "--budgets-file", p]) == 0
+        assert main(["--programs", "gated-msi", "--budget",
+                     "--budgets-file", p]) == 0
+        data = json.load(open(p))
+        data["gated-msi"]["ceiling"]["kernels_per_iter"] = 1
+        json.dump(data, open(p, "w"))
+        assert main(["--programs", "gated-msi", "--budget",
+                     "--budgets-file", p]) == 1
+
+
+# ---------------------------------------------------------------------------
+# the CPU oracle: static estimate vs compiled.memory_analysis()
+# ---------------------------------------------------------------------------
+
+
+class TestMemoryAnalysisOracle:
+    def test_gated_msi_static_vs_backend(self, gated_report):
+        """Acceptance gate: the static residency estimate for the
+        gated-MSI program agrees with the backend's own accounting
+        within the documented tolerance (cost.ARG_OUT_TOL for
+        arguments/outputs; peak within [1, PEAK_OVER_FACTOR] x the
+        backend total — the live-range scan ignores aliasing, so it
+        over-estimates but must never under-estimate)."""
+        sim = Simulator(_config(), _trace(), phase_gate=True,
+                        mem_gate_bytes=0)
+        fn, args = sim._auditable_fn(4096)
+        rep = gated_report
+        cmp = cost.backend_memory_comparison(fn, args, rep)
+        assert cmp is not None and cmp["backend"] == "cpu"
+        arg_err = abs(rep.arg_bytes - cmp["argument_bytes"]) \
+            / cmp["argument_bytes"]
+        out_err = abs(rep.out_bytes - cmp["output_bytes"]) \
+            / cmp["output_bytes"]
+        assert arg_err <= cost.ARG_OUT_TOL, (rep.arg_bytes, cmp)
+        assert out_err <= cost.ARG_OUT_TOL, (rep.out_bytes, cmp)
+        backend_total = (cmp["argument_bytes"] + cmp["output_bytes"]
+                         + cmp["temp_bytes"])
+        ratio = rep.peak_bytes / backend_total
+        assert 1.0 <= ratio <= cost.PEAK_OVER_FACTOR, (ratio, cmp)
+        # the comparison is recorded in the report, as documented
+        assert rep.memory_cmp is cmp
+
+
+# ---------------------------------------------------------------------------
+# residency: breakdown, fail-fast, unified refusals
+# ---------------------------------------------------------------------------
+
+
+class TestResidency:
+    def test_breakdown_itemizes_consumers(self):
+        from graphite_tpu.obs import TelemetrySpec
+
+        tel = TelemetrySpec(sample_interval_ps=1_000_000, n_samples=32)
+        sim = Simulator(_config(), _trace(), telemetry=tel)
+        d = sim.residency_breakdown()
+        assert d["state"] > 0 and d["trace"] > 0
+        assert d["telemetry"] == sim.telemetry_spec.ring_bytes()
+        assert d["total"] == d["state"] + d["trace"] + d["telemetry"]
+
+    def test_ring_bytes_accounting(self):
+        from graphite_tpu.obs import TelemetrySpec
+
+        sim = Simulator(_config(), _trace())
+        spec = TelemetrySpec(sample_interval_ps=1_000_000,
+                             n_samples=32).resolve(sim.params)
+        n = spec.n_series
+        assert spec.ring_bytes() == 32 * n * 8 + n * 8 + 5 * 8
+
+    def test_sweep_fail_fast_raises_named_error(self):
+        """The pre-compile fail-fast: a campaign whose estimated
+        residency exceeds the configured HBM budget refuses with the
+        per-consumer breakdown BEFORE any tracing."""
+        traces = [_trace(s) for s in (1, 2, 3, 4)]
+        with pytest.raises(cost.ResidencyBudgetError) as ei:
+            SweepRunner(_config(), traces, shard_batch=False,
+                        hbm_budget_bytes=1024)
+        msg = str(ei.value)
+        assert "state" in msg and "trace" in msg and "B=4" in msg
+
+    def test_sweep_budget_config_key_and_pass(self):
+        """`[general] hbm_budget_bytes` arms the same check; a budget
+        above the estimate builds normally and exposes the breakdown."""
+        traces = [_trace(s) for s in (1, 2)]
+        sc = SimConfig(ConfigFile.from_string(
+            config_text(TILES, shared_mem=True,
+                        clock_scheme="lax_barrier") + GEOMETRY
+            + "[general]\nhbm_budget_bytes = 1024\n"))
+        with pytest.raises(cost.ResidencyBudgetError):
+            SweepRunner(sc, traces, shard_batch=False)
+        runner = SweepRunner(_config(), traces, shard_batch=False,
+                             hbm_budget_bytes=1 << 40)
+        d = runner.residency_breakdown()
+        assert d["total"] <= 1 << 40
+        assert d["state"] > 0 and d["trace"] > 0
+
+    def test_attach_telemetry_refusal_is_residency_error(self):
+        """The stream/mesh telemetry rejections raise the SAME unified
+        exception type, message carrying the breakdown (and still a
+        ValueError: legacy callers keep working)."""
+        from graphite_tpu.obs import TelemetrySpec
+
+        sim = Simulator(_config(), _trace(), stream=True)
+        with pytest.raises(cost.ResidencyBudgetError,
+                           match="single-device resident") as ei:
+            sim.attach_telemetry(
+                TelemetrySpec(sample_interval_ps=1_000_000,
+                              n_samples=32))
+        msg = str(ei.value)
+        assert "telemetry" in msg and "=" in msg
+        assert isinstance(ei.value, ValueError)
+
+    def test_telemetry_breakdown_scales_with_batch(self):
+        """Campaign residency itemizes B telemetry rings, and the state
+        item does NOT double-count the ring riding the state carry."""
+        from graphite_tpu.obs import TelemetrySpec
+
+        tel = TelemetrySpec(sample_interval_ps=1_000_000, n_samples=32)
+        traces = [_trace(s) for s in (1, 2, 3, 4)]
+        runner = SweepRunner(_config(), traces, shard_batch=False,
+                             telemetry=tel)
+        d = runner.residency_breakdown()
+        assert d["telemetry"] == 4 * runner.sim.telemetry_spec.ring_bytes()
+        bare = cost.tree_bytes(runner.sim.state.replace(telemetry=None))
+        assert d["state"] == 4 * bare
+
+
+def test_budget_regression_fixture_cli_exits_nonzero(tmp_path):
+    """CLI-level acceptance: `--budget --regression-fixture` must exit
+    nonzero against the real checked-in BUDGETS.json."""
+    from graphite_tpu.tools.audit import main
+
+    assert main(["--budget", "--regression-fixture"]) == 1
