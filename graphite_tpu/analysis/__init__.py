@@ -27,9 +27,17 @@ from graphite_tpu.analysis.cost import (  # noqa: F401
     dynamic_cost, format_breakdown, load_budgets, peak_live_bytes,
     residency_breakdown, save_budgets,
 )
+from graphite_tpu.analysis.identity import (  # noqa: F401
+    DiffEntry, canonical_lines, diff_or_none, fingerprint, same_program,
+    structural_diff,
+)
+from graphite_tpu.analysis.registry import (  # noqa: F401
+    ProgramRecord, check_lock, load_lock, lock_regression_fixture,
+    record_from_spec, save_lock,
+)
 from graphite_tpu.analysis.rules import (  # noqa: F401
     Finding, cond_payload, host_sync, knob_fold, phase_conds,
-    time_dtype, vmap_gate,
+    scatter_determinism, time_dtype, vmap_gate,
 )
 from graphite_tpu.analysis.walk import (  # noqa: F401
     aval_bytes, aval_sig, find_eqns, invar_path_strings, iter_eqns,

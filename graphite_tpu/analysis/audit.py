@@ -72,6 +72,10 @@ class ProgramSpec:
     # order, so the cost model (analysis/cost.py) can attribute the
     # per-iteration kernel proxy phase-by-phase
     phase_names: "tuple[str, ...]" = ()
+    # round 11: vmapped campaign programs put the WHOLE program in the
+    # scatter-determinism rule's scope (solo programs only police
+    # shard_map interiors)
+    batched: bool = False
 
 
 def _mem_forbidden_avals(sim):
@@ -202,7 +206,8 @@ def spec_from_sweep(name: str, runner,
         clock_invars=clock_invar_indices(paths),
         expect_telemetry=expect_tel,
         telemetry_sig=tel_sig,
-        phase_names=phase_names)
+        phase_names=phase_names,
+        batched=not runner.shard_batch or runner._sims_per_dev > 1)
 
 
 # ---------------------------------------------------------------------------
@@ -212,6 +217,48 @@ def spec_from_sweep(name: str, runner,
 
 DEFAULT_PROGRAM_NAMES = ("gated-msi", "ungated-msi", "shl2-mesi",
                          "sweep-b4", "gated-msi-tel", "sweep-b4-tel")
+
+# cache/directory geometry chosen so the directory entry/sharers avals
+# are UNIQUE in the program (same trick as the phase-gating test) — a
+# cache meta array of coincidentally equal shape would make the
+# cond-payload signature check blind to the store
+AUDIT_GEOMETRY = """
+[l1_icache/T1]
+cache_size = 4
+associativity = 2
+[l1_dcache/T1]
+cache_size = 8
+associativity = 4
+[l2_cache/T1]
+cache_size = 32
+associativity = 8
+[dram_directory]
+total_entries = 64
+associativity = 4
+"""
+
+
+def _audit_trace(tiles: int):
+    from graphite_tpu.trace import synthetic
+
+    return synthetic.memory_stress_trace(
+        tiles, n_accesses=16, working_set_bytes=1 << 12,
+        write_fraction=0.4, shared_fraction=0.5, seed=7)
+
+
+def gated_msi_simulator(tiles: int = 8, extra_cfg: str = ""):
+    """The audited gated-MSI Simulator, optionally with `extra_cfg` INI
+    appended — the hook registry.lock_regression_fixture uses to lower
+    the SAME program shape with one intentionally perturbed literal."""
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.engine.simulator import Simulator
+    from graphite_tpu.tools._template import config_text
+
+    sc = SimConfig(ConfigFile.from_string(config_text(
+        tiles, shared_mem=True, clock_scheme="lax_barrier")
+        + AUDIT_GEOMETRY + extra_cfg))
+    return Simulator(sc, _audit_trace(tiles), phase_gate=True,
+                     mem_gate_bytes=0)
 
 
 def default_programs(tiles: int = 8, max_quanta: int = 4096,
@@ -244,27 +291,8 @@ def default_programs(tiles: int = 8, max_quanta: int = 4096,
             f"unknown program(s) {sorted(unknown)} "
             f"(available: {', '.join(DEFAULT_PROGRAM_NAMES)})")
 
-    batch = synthetic.memory_stress_trace(
-        tiles, n_accesses=16, working_set_bytes=1 << 12,
-        write_fraction=0.4, shared_fraction=0.5, seed=7)
-    # cache/directory geometry chosen so the directory entry/sharers
-    # avals are UNIQUE in the program (same trick as the phase-gating
-    # test) — a cache meta array of coincidentally equal shape would
-    # make the cond-payload signature check blind to the store
-    geometry = """
-[l1_icache/T1]
-cache_size = 4
-associativity = 2
-[l1_dcache/T1]
-cache_size = 8
-associativity = 4
-[l2_cache/T1]
-cache_size = 32
-associativity = 8
-[dram_directory]
-total_entries = 64
-associativity = 4
-"""
+    batch = _audit_trace(tiles)
+    geometry = AUDIT_GEOMETRY
     sc = SimConfig(ConfigFile.from_string(config_text(
         tiles, shared_mem=True, clock_scheme="lax_barrier") + geometry))
     sc_shl2 = SimConfig(ConfigFile.from_string(config_text(
@@ -274,8 +302,8 @@ associativity = 4
     # big-state regime the round-6 contract exists for
     specs = []
     if "gated-msi" in names:
-        specs.append(spec_from_simulator("gated-msi", Simulator(
-            sc, batch, phase_gate=True, mem_gate_bytes=0), max_quanta))
+        specs.append(spec_from_simulator(
+            "gated-msi", gated_msi_simulator(tiles), max_quanta))
     if "ungated-msi" in names:
         specs.append(spec_from_simulator("ungated-msi", Simulator(
             sc, batch, phase_gate=False, mem_gate_bytes=0), max_quanta))
@@ -337,7 +365,7 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
 # ---------------------------------------------------------------------------
 
 RULE_NAMES = ("cond-payload", "knob-fold", "time-dtype", "vmap-gate",
-              "host-sync", "telemetry-off")
+              "host-sync", "scatter-determinism", "telemetry-off")
 
 
 @dataclasses.dataclass
@@ -419,6 +447,8 @@ def audit_program(spec: ProgramSpec, *,
         spec.closed, spec.n_tiles, spec.expect_gated,
         n_phases=spec.n_phases))
     add("host-sync", rules.host_sync(spec.closed))
+    add("scatter-determinism", rules.scatter_determinism(
+        spec.closed, batched=spec.batched))
     if not spec.expect_telemetry:
         # telemetry-OFF programs must carry no trace of the timeline
         # machinery (ON programs instead police the ring via the

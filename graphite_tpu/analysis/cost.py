@@ -520,21 +520,34 @@ def ceilings(report: CostReport) -> "dict[str, int]":
 
 
 def save_budgets(reports: "list[CostReport]", path: "str | None" = None,
-                 ) -> str:
+                 fingerprints: "dict[str, str] | None" = None,
+                 registry: "dict | None" = None) -> str:
     """Write measured baselines + slack ceilings for `reports` (the
     --budget-update refresh; merges over an existing file so a subset
-    run never drops the other programs' entries)."""
+    run never drops the other programs' entries).  `fingerprints` maps
+    program name -> identity digest (analysis/identity.fingerprint):
+    each entry records WHICH program its ceilings were measured at, so
+    the gate can refuse stale ceilings after an identity change.
+    `registry` (name -> registry.ProgramRecord) keys each entry under
+    the program's registered `budget_key` — the SAME key check_budget
+    reads, so a refresh after a rename replaces the entry the gate
+    resolves instead of orphaning a new-name copy next to the stale
+    old-key one."""
     path = path or default_budgets_path()
     data = {}
     if os.path.exists(path):
         with open(path) as f:
             data = json.load(f)
     for rep in reports:
-        data[rep.program] = {
+        entry = {
             "tiles": int(rep.tiles),
             "measured": rep.metrics(),
             "ceiling": ceilings(rep),
         }
+        if fingerprints and rep.program in fingerprints:
+            entry["fingerprint"] = fingerprints[rep.program]
+        rec = registry.get(rep.program) if registry else None
+        data[rec.budget_key if rec is not None else rep.program] = entry
     with open(path, "w") as f:
         json.dump(data, f, indent=1, sort_keys=True)
         f.write("\n")
@@ -547,21 +560,57 @@ def load_budgets(path: "str | None" = None) -> dict:
         return json.load(f)
 
 
-def check_budget(report: CostReport, budgets: dict) -> list:
+def check_budget(report: CostReport, budgets: dict,
+                 record=None) -> list:
     """Gate one report against the checked-in budgets.  Returns
     rules.Finding rows (rule "budget", error severity) — empty means
     within budget.  A missing program entry is itself an error: silence
-    on a new program would let it grow unbudgeted."""
+    on a new program would let it grow unbudgeted.
+
+    `record` (a registry.ProgramRecord) resolves the program THROUGH
+    the registry: the budget entry is looked up under the record's
+    `budget_key` (renames keep their ceilings reachable), and an entry
+    whose recorded fingerprint no longer matches the REGISTERED
+    program's is a loud error — a retraced program can no longer
+    silently inherit ceilings measured on a different artifact."""
     from graphite_tpu.analysis.rules import Finding, SEV_ERROR
 
-    entry = budgets.get(report.program)
+    key = record.budget_key if record is not None else report.program
+    entry = budgets.get(key)
     if entry is None:
         return [Finding(
             "budget", SEV_ERROR, "BUDGETS.json",
-            f"no budget entry for program {report.program!r} — run "
-            f"`python -m graphite_tpu.tools.audit --budget-update` after "
-            f"reviewing its cost report", program=report.program,
+            f"no budget entry for program {report.program!r} "
+            + (f"(registry key {key!r}) " if key != report.program
+               else "")
+            + f"— run `python -m graphite_tpu.tools.audit "
+            f"--budget-update` after reviewing its cost report",
+            program=report.program,
             data={"metrics": report.metrics()})]
+    if record is not None and entry.get("fingerprint") is None:
+        # a fingerprint-less entry resolved through the registry cannot
+        # be staleness-checked — silence here would reopen the exact
+        # stale-ceilings gap the identity plumbing closes
+        return [Finding(
+            "budget", SEV_ERROR, "BUDGETS.json",
+            f"budget entry {key!r} records no fingerprint (it predates "
+            f"the program registry) so its ceilings cannot be checked "
+            f"against the registered artifact — refresh with "
+            f"--budget-update",
+            program=report.program,
+            data={"registered_fingerprint": record.fingerprint})]
+    if record is not None \
+            and entry["fingerprint"] != record.fingerprint:
+        return [Finding(
+            "budget", SEV_ERROR, "BUDGETS.json",
+            f"budget entry {key!r} was measured at fingerprint "
+            f"{entry['fingerprint'][:24]}... but the registered "
+            f"program is {record.fingerprint[:24]}... — the ceilings "
+            f"are STALE for this artifact; review the cost report and "
+            f"refresh with --budget-update (after --lock-update)",
+            program=report.program,
+            data={"budget_fingerprint": entry["fingerprint"],
+                  "registered_fingerprint": record.fingerprint})]
     base_tiles = entry.get("tiles")
     if base_tiles is not None and report.tiles \
             and int(base_tiles) != int(report.tiles):
@@ -614,10 +663,15 @@ def check_budget(report: CostReport, budgets: dict) -> list:
     return out
 
 
-def check_budgets(reports: "list[CostReport]", budgets: dict) -> list:
+def check_budgets(reports: "list[CostReport]", budgets: dict,
+                  registry: "dict | None" = None) -> list:
+    """Gate every report; `registry` (name -> registry.ProgramRecord,
+    from registry.load_lock) resolves budget keys and arms the
+    stale-fingerprint check per report."""
     out = []
     for rep in reports:
-        out.extend(check_budget(rep, budgets))
+        rec = registry.get(rep.program) if registry else None
+        out.extend(check_budget(rep, budgets, record=rec))
     return out
 
 

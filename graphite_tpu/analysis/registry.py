@@ -1,0 +1,219 @@
+"""The program registry: name -> identity for every audited program.
+
+ROADMAP's campaign-service direction asks for "an explicit program
+registry so the service, the auditor, and the budget gate all key off
+the same artifact identity".  This is it: every `ProgramSpec` in the
+audit default set registers
+
+    name -> {fingerprint, tile geometry, knob signature, budget key}
+
+and the checked-in `PROGRAMS.lock` (repo root, next to BUDGETS.json)
+pins those identities in CI:
+
+  - `tools/audit.py --lock` recomputes each default program's
+    fingerprint and fails loudly on any drift — naming the program,
+    and (for the self-test fixture) the first divergent equation with
+    its protocol phase via `identity.structural_diff`;
+  - `--lock-update` re-registers after an INTENTIONAL program change
+    (merging, like --budget-update);
+  - the budget gate resolves `BUDGETS.json` entries THROUGH the
+    registry: each budget entry records the fingerprint it was
+    measured at, and a ceiling whose fingerprint no longer matches the
+    registered program is an error — a renamed or retraced program can
+    no longer silently inherit stale ceilings.
+
+The lock is the artifact-identity substrate the campaign service's
+compiled-program cache will key off: same registry key == same lowered
+program == same executable, byte for byte.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from graphite_tpu.analysis.identity import fingerprint
+
+
+def default_lock_path() -> str:
+    """PROGRAMS.lock at the repo root (next to BUDGETS.json)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(os.path.dirname(here)),
+                        "PROGRAMS.lock")
+
+
+@dataclasses.dataclass
+class ProgramRecord:
+    """One registered program's identity."""
+
+    name: str
+    fingerprint: str
+    tiles: int
+    # sorted knob names with live traced invars (sweep campaigns), or
+    # None for un-swept programs — a knob appearing/disappearing is an
+    # interface change even when the digest moves anyway
+    knobs: "tuple[str, ...] | None" = None
+    # the BUDGETS.json key this program's ceilings live under (defaults
+    # to the program name; a rename keeps old ceilings reachable)
+    budget_key: str = ""
+
+    def __post_init__(self):
+        if not self.budget_key:
+            self.budget_key = self.name
+
+    def to_json(self) -> dict:
+        out = {"fingerprint": self.fingerprint, "tiles": int(self.tiles),
+               "budget_key": self.budget_key}
+        if self.knobs is not None:
+            out["knobs"] = sorted(self.knobs)
+        return out
+
+    @classmethod
+    def from_json(cls, name: str, d: dict) -> "ProgramRecord":
+        return cls(name=name, fingerprint=d["fingerprint"],
+                   tiles=int(d["tiles"]),
+                   knobs=(tuple(d["knobs"]) if "knobs" in d else None),
+                   budget_key=d.get("budget_key", name))
+
+
+def record_from_spec(spec) -> ProgramRecord:
+    """Register one audited program (an audit.ProgramSpec)."""
+    knobs = (tuple(sorted(spec.knob_invars))
+             if spec.knob_invars is not None else None)
+    return ProgramRecord(name=spec.name,
+                         fingerprint=fingerprint(spec.closed),
+                         tiles=int(spec.n_tiles), knobs=knobs)
+
+
+def save_lock(records: "list[ProgramRecord]",
+              path: "str | None" = None) -> str:
+    """Write/merge registered identities (the --lock-update refresh;
+    merges over an existing file so a --programs subset run never
+    drops the other programs' entries)."""
+    path = path or default_lock_path()
+    data = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    for rec in records:
+        row = rec.to_json()
+        prev = data.get(rec.name)
+        # a hand-set budget_key (rename workflow) survives refreshes:
+        # record_from_spec only knows the name, so a default-keyed
+        # record must not clobber the key the budget gate resolves by
+        if prev and rec.budget_key == rec.name \
+                and prev.get("budget_key", rec.name) != rec.name:
+            row["budget_key"] = prev["budget_key"]
+        data[rec.name] = row
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_lock(path: "str | None" = None) -> "dict[str, ProgramRecord]":
+    path = path or default_lock_path()
+    with open(path) as f:
+        data = json.load(f)
+    return {name: ProgramRecord.from_json(name, d)
+            for name, d in data.items()}
+
+
+LOCK_FIXTURE_PERTURBATION = """
+[l2_cache/T1]
+data_access_time = 19
+"""
+
+
+def lock_regression_fixture(tiles: int = 8, max_quanta: int = 4096):
+    """The REAL gated-MSI program lowered with ONE perturbed literal —
+    the L2 data-access latency (8 -> 19 cycles), a constant consumed
+    inside the `requester` phase cond — under the registered name
+    "gated-msi".  The lock gate MUST trip on it, and the structural
+    diff against the reference lowering must name the first divergent
+    equation WITH its protocol phase ("requester ... mul lit(8) ->
+    lit(19)"), not just a failed hash: the CI self-test that the
+    identity machinery attributes drift, exactly the way the inflated-
+    carry fixture proves the budget gate trips (cost.
+    budget_regression_fixture)."""
+    from graphite_tpu.analysis.audit import (
+        gated_msi_simulator, spec_from_simulator,
+    )
+
+    return spec_from_simulator(
+        "gated-msi", gated_msi_simulator(tiles, LOCK_FIXTURE_PERTURBATION),
+        max_quanta)
+
+
+def check_lock(specs, lock: "dict[str, ProgramRecord]", *,
+               expect_complete: bool = False) -> list:
+    """Gate lowered programs against the checked-in registry.
+
+    Returns rules.Finding rows (rule "lock", error severity) — empty
+    means every program's recomputed fingerprint, geometry and knob
+    signature match its registered identity.  A program missing from
+    the lock is itself an error (silence would let it drift
+    unregistered); with `expect_complete`, registered names absent
+    from `specs` error too (a stale lock entry nothing verifies).
+    """
+    from graphite_tpu.analysis.rules import Finding, SEV_ERROR
+
+    out = []
+    seen = set()
+    for spec in specs:
+        seen.add(spec.name)
+        rec = lock.get(spec.name)
+        cur = record_from_spec(spec)
+        if rec is None:
+            out.append(Finding(
+                "lock", SEV_ERROR, "PROGRAMS.lock",
+                f"program {spec.name!r} is not registered — run "
+                f"`python -m graphite_tpu.tools.audit --lock-update` "
+                f"after reviewing its cost report",
+                program=spec.name,
+                data={"fingerprint": cur.fingerprint}))
+            continue
+        if int(rec.tiles) != int(cur.tiles):
+            out.append(Finding(
+                "lock", SEV_ERROR, "PROGRAMS.lock",
+                f"program {spec.name!r} was lowered at tiles="
+                f"{cur.tiles} but is registered at tiles={rec.tiles} — "
+                f"rerun at the registered geometry, or re-register "
+                f"with --lock-update",
+                program=spec.name,
+                data={"tiles": cur.tiles, "lock_tiles": rec.tiles}))
+            continue
+        if rec.knobs is not None or cur.knobs is not None:
+            if tuple(rec.knobs or ()) != tuple(cur.knobs or ()):
+                out.append(Finding(
+                    "lock", SEV_ERROR, "PROGRAMS.lock",
+                    f"program {spec.name!r} knob signature changed: "
+                    f"registered {sorted(rec.knobs or ())} != lowered "
+                    f"{sorted(cur.knobs or ())} — the sweep interface "
+                    f"moved; re-register with --lock-update",
+                    program=spec.name,
+                    data={"knobs": sorted(cur.knobs or ()),
+                          "lock_knobs": sorted(rec.knobs or ())}))
+        if rec.fingerprint != cur.fingerprint:
+            out.append(Finding(
+                "lock", SEV_ERROR, "PROGRAMS.lock",
+                f"program {spec.name!r} drifted from its registered "
+                f"identity ({rec.fingerprint[:24]}... -> "
+                f"{cur.fingerprint[:24]}...) — if intentional, review "
+                f"the cost report and re-register with --lock-update "
+                f"(then --budget-update: the ceilings were measured at "
+                f"the old identity)",
+                program=spec.name,
+                data={"fingerprint": cur.fingerprint,
+                      "lock_fingerprint": rec.fingerprint}))
+    if expect_complete:
+        for name in sorted(set(lock) - seen):
+            out.append(Finding(
+                "lock", SEV_ERROR, "PROGRAMS.lock",
+                f"registered program {name!r} is not in the audited "
+                f"set — nothing verifies its identity; remove the "
+                f"stale entry or audit it",
+                program=name,
+                data={"lock_fingerprint": lock[name].fingerprint}))
+    return out

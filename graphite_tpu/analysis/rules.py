@@ -23,6 +23,17 @@ a Python-level abstraction:
                 step (a host round trip costs ~100 ms over a tunneled
                 chip — the whole reason the quantum loop is
                 device-driven)
+  scatter-determinism
+                inside a vmapped campaign (or any shard_mapped region)
+                a replace-combiner scatter whose index rows can alias
+                has an implementation-defined winner — the round-9
+                telemetry contract says device stores are masked
+                add-scatters; this enforces it program-wide.  A scatter
+                passes by being commutative (add/mul/min/max), by
+                declaring unique_indices, by an index-provenance proof
+                (an iota column survives into every row — walk.
+                distinct_axes), or by the masked scratch-redirect idiom
+                (disabled lanes select a constant spill slot)
   telemetry-off a program lowered with telemetry=None must contain NO
                 trace of the timeline machinery: no telemetry-state
                 invar and no equation producing the ring's
@@ -41,11 +52,13 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import numpy as np
 
 from graphite_tpu.analysis.walk import (
-    aval_bytes, aval_sig, iter_eqns_with_site, taint_narrowing,
-    used_invar_mask,
+    aval_bytes, aval_sig, call_arg_maps, distinct_axes,
+    iter_eqns_with_site, make_scope, masked_index_select,
+    scope_from_closed, subjaxprs, taint_narrowing, used_invar_mask,
 )
 
 SEV_ERROR = "error"
@@ -309,7 +322,104 @@ def host_sync(jaxpr) -> "list[Finding]":
 
 
 # ---------------------------------------------------------------------------
-# rule 6: telemetry-off
+# rule 6: scatter-determinism
+# ---------------------------------------------------------------------------
+
+# Commutative-combiner scatters produce the same result under any
+# update order (integer add/mul/min/max are exactly associative), so
+# aliasing index rows cannot make them nondeterministic.
+_COMMUTATIVE_SCATTERS = frozenset({
+    "scatter-add", "scatter-mul", "scatter-min", "scatter-max",
+})
+
+
+def _scatter_row_axes(eqn) -> "tuple[int, ...]":
+    """The index-row axes of a scatter's indices operand: everything
+    except the trailing index-vector dim and any vmap batching dims
+    (a batching dim addresses a DIFFERENT operand slice per position,
+    so it cannot alias across itself)."""
+    idx = eqn.invars[1]
+    rank = len(getattr(idx.aval, "shape", ()) or ())
+    dn = eqn.params.get("dimension_numbers")
+    batch = tuple(getattr(dn, "scatter_indices_batching_dims", ()) or ())
+    return tuple(a for a in range(rank - 1) if a not in batch)
+
+
+def scatter_determinism(jaxpr, *, batched: bool = False,
+                        ) -> "list[Finding]":
+    """No potentially-aliasing replace-scatter inside a batched region.
+
+    XLA leaves the winner of colliding replace-scatter rows
+    implementation-defined; today's serial CPU/TPU lowerings happen to
+    pick last-in-index-order, but a parallelized batched lowering is
+    free not to — and the repo's bit-identity claims (sweep-vs-
+    sequential, telemetry on/off) assume determinism.  `batched=True`
+    puts the WHOLE program in scope (it lowers under vmap —
+    SweepRunner campaigns); otherwise only `shard_map`ped interiors
+    are.  Warning severity, like vmap-gate: the program is correct on
+    the backends we run today, but it leans on behavior the contract
+    does not own.
+    """
+    scope0 = scope_from_closed(jaxpr)
+    out = []
+
+    def visit(scope, site, in_scope):
+        for eqn in scope.jaxpr.eqns:
+            name = eqn.primitive.name
+            here = f"{site}.{name}" if site else name
+            if name.startswith("scatter") and in_scope \
+                    and name not in _COMMUTATIVE_SCATTERS \
+                    and not eqn.params.get("unique_indices"):
+                idx = eqn.invars[1]
+                if not isinstance(idx, jax.core.Literal):
+                    idx_shape = tuple(
+                        getattr(idx.aval, "shape", ()) or ())
+                    # a size-1 row axis holds a single row, and an
+                    # empty row set (rank-1 indices, or every row axis
+                    # a vmap batching dim) means one row per addressed
+                    # operand slice — a lone row cannot collide with
+                    # itself, so only multi-row axes need provenance.
+                    # The per-axis proof is sound for AT MOST one such
+                    # axis: per-axis distinctness covers pairs that
+                    # differ in one axis, not rows differing in several
+                    # (a const table [[0,1],[1,0]] is distinct along
+                    # both axes yet rows (0,0) and (1,1) collide)
+                    rows = tuple(a for a in _scatter_row_axes(eqn)
+                                 if idx_shape[a] > 1)
+                    # the provenance walk only decides the one-axis
+                    # case: no rows is trivially safe, >= 2 unprovable
+                    proven = (not rows) if len(rows) != 1 \
+                        else rows[0] in distinct_axes(idx, scope)
+                    if not proven \
+                            and not masked_index_select(idx, scope):
+                        sig = aval_sig(eqn.outvars[0].aval) or ((), "?")
+                        out.append(Finding(
+                            "scatter-determinism", SEV_WARNING, here,
+                            f"replace-combiner scatter into {sig[0]} "
+                            f"{sig[1]} with potentially aliasing index "
+                            f"rows inside a batched region — colliding "
+                            f"rows have an implementation-defined "
+                            f"winner; use a masked add-scatter (the "
+                            f"round-9 ring-store contract), a scratch-"
+                            f"slot redirect, or unique_indices=True",
+                            data={"shape": list(sig[0]),
+                                  "dtype": sig[1],
+                                  "indices_shape": list(
+                                      getattr(idx.aval, "shape", ()))}))
+            subs = call_arg_maps(eqn)
+            if subs:
+                tags = [t for t, _ in subjaxprs(eqn)]
+                for k, sc in enumerate(subs):
+                    tag = tags[k] if k < len(tags) else str(k)
+                    visit(make_scope(sc.jaxpr, scope, eqn, sc),
+                          f"{here}/{tag}",
+                          in_scope or "shard_map" in name)
+    visit(scope0, "", batched)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 7: telemetry-off
 # ---------------------------------------------------------------------------
 
 

@@ -9,7 +9,7 @@ auditor rule (analysis/rules.py) and every structural test assertion
 walks the SAME recursion below — the traversal the round-6
 phase-gating test used to keep as a private `_walk_eqns` helper.
 
-Three layers:
+Four layers:
  - `iter_eqns` / `iter_eqns_with_site`: flat iteration over every eqn
    at every nesting depth (site strings name the path for findings);
  - `call_arg_maps`: the structural operand<->sub-jaxpr wiring of the
@@ -18,7 +18,15 @@ Three layers:
  - `used_invar_mask` / `taint_narrowing`: the two dataflow passes the
    rules are built on — "is this input ever consumed?" (knob-fold)
    and "does a value derived from this input get integer-narrowed?"
-   (time-dtype).
+   (time-dtype);
+ - `Scope` / `distinct_axes` / `masked_index_select`: backward value
+   provenance for scatter INDEX operands — "is this index array
+   provably collision-free (an iota column survives into every row)"
+   and "is this the engines' masked scratch-redirect idiom" — the
+   round-11 scatter-determinism rule's analysis.  Resolution follows
+   def chains upward through cond/scan/pjit boundaries via
+   `call_arg_maps` (loop-carried positions stay unresolved: their
+   value changes across iterations).
 """
 
 from __future__ import annotations
@@ -390,3 +398,519 @@ def taint_narrowing(jaxpr, in_taint, on_finding=None, _site="",
         for v in eqn.outvars:
             env[v] = tainted
     return [get(v) for v in j.outvars]
+
+
+# ---------------------------------------------------------------------------
+# dataflow pass 3: backward index provenance (scatter-determinism)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Scope:
+    """One jaxpr nesting level of a provenance walk: def sites at this
+    level plus the wiring back to the enclosing level, so a value that
+    enters a cond branch (or a scan body's const slot) as an invar can
+    be chased to its real definition outside."""
+
+    jaxpr: object                  # the (raw) Jaxpr of this level
+    defs: dict                     # var -> defining eqn at this level
+    parent: "Scope | None" = None
+    parent_eqn: object | None = None   # the call-like eqn that owns us
+    sub: "SubCall | None" = None       # our wiring inside parent_eqn
+    consts: dict = dataclasses.field(default_factory=dict)
+    # var -> concrete array for the top ClosedJaxpr's constvars — lets
+    # the analysis check hoisted np.arange tables for real uniqueness
+
+
+def make_scope(jaxpr, parent=None, parent_eqn=None, sub=None,
+               consts: "dict | None" = None) -> Scope:
+    j = as_jaxpr(jaxpr)
+    defs = {}
+    for eqn in j.eqns:
+        for v in eqn.outvars:
+            defs[v] = eqn
+    return Scope(j, defs, parent, parent_eqn, sub, consts or {})
+
+
+def scope_from_closed(closed) -> Scope:
+    """Top-level Scope of a ClosedJaxpr, with its consts resolvable."""
+    j = as_jaxpr(closed)
+    consts = {}
+    for v, c in zip(j.constvars, getattr(closed, "consts", ()) or ()):
+        consts[v] = np.asarray(c) if hasattr(c, "shape") else c
+    return make_scope(j, consts=consts)
+
+
+def resolve_var(var, scope: Scope):
+    """Chase `var` one level up when it is an invar of `scope.jaxpr`:
+    returns (outer_var, outer_scope, axis_shift) or (None, None, 0)
+    when the definition cannot be followed (top-level input, loop
+    carry, opaque wiring).  axis_shift is 1 when the outer value is a
+    scan xs operand the body sees one leading axis short."""
+    if scope.parent is None or scope.sub is None:
+        return None, None, 0
+    try:
+        i = list(scope.jaxpr.invars).index(var)
+    except ValueError:
+        return None, None, 0
+    sub = scope.sub
+    # loop-carried slots change value across iterations: unresolvable
+    if any(fb == i for fb in sub.feedback if fb is not None):
+        return None, None, 0
+    if i >= len(sub.in_map) or sub.in_map[i] is None:
+        return None, None, 0
+    if scope.parent_eqn.primitive.name == "while":
+        # the while COND's SubCall carries no feedback edges of its
+        # own, but its carry slots are just as iteration-variant as
+        # the body's: everything past the two const blocks is carry
+        cn = scope.parent_eqn.params["cond_nconsts"]
+        bn = scope.parent_eqn.params["body_nconsts"]
+        if sub.in_map[i] >= cn + bn:
+            return None, None, 0
+    outer = scope.parent_eqn.invars[sub.in_map[i]]
+    if isinstance(outer, jax.core.Literal):
+        return None, None, 0
+    shift = 0
+    if scope.parent_eqn.primitive.name == "scan":
+        r_out = len(getattr(outer.aval, "shape", ()) or ())
+        r_in = len(getattr(var.aval, "shape", ()) or ())
+        if r_out == r_in + 1:
+            shift = 1   # an xs operand: the body sees slice [l, ...]
+    return outer, scope.parent, shift
+
+
+# Per-axis provenance forms (the value of _axis_forms):
+#   ("D",)       distinct: any two positions differing in this axis
+#                hold different values (no congruence info — e.g. a
+#                concrete const table checked exhaustively)
+#   (m, c)       affine-congruent: value = pos + c exactly when m == 0,
+#                else value ≡ pos + c (mod m).  c may be None for an
+#                unknown-but-uniform shift (e.g. pos + traced_scalar).
+#                Distinct along an axis of size n iff m == 0 or m >= n.
+# The congruence form is what survives the engines' wraparound idiom
+# (`jnp.where(h < T, h, h - T)` -> select_n of pos+c1 / pos+c2 arms:
+# both ≡ pos mod |c1-c2|, still collision-free at the axis size).
+
+
+def _const_axis_forms(arr) -> dict:
+    """("D",) for every axis of a concrete array along which all pairs
+    of positions differ (checked exhaustively — consts are host-side
+    and small)."""
+    arr = np.asarray(arr)
+    out = {}
+    for a in range(arr.ndim):
+        m = np.moveaxis(arr, a, 0).reshape(arr.shape[a], -1)
+        # need every pair of rows to differ in EVERY column
+        if all(len(np.unique(m[:, c])) == m.shape[0]
+               for c in range(m.shape[1])):
+            out[a] = ("D",)
+    return out
+
+
+_DISTINCT_PASS_THROUGH = frozenset({
+    "convert_element_type", "copy", "stop_gradient",
+    # jnp.asarray(host_const) inserts a device_put between a hoisted
+    # index table and its use — value-preserving movement, without
+    # which Scope.consts/_const_axis_forms is unreachable
+    "device_put",
+})
+
+_DIRECT_CALLS = frozenset({
+    "pjit", "closed_call", "core_call", "xla_call", "custom_jvp_call",
+    "custom_vjp_call", "remat", "checkpoint", "remat2",
+})
+
+_PROVENANCE_DEPTH = 24
+
+
+def _descend_outvar(eqn, var, scope: Scope):
+    """When `var` is an output of a direct-call eqn (pjit et al — the
+    wrappers jnp.where/jnp.mod lowerings hide behind), step INTO the
+    sub-jaxpr: returns (inner outvar, inner Scope) or None."""
+    if eqn.primitive.name not in _DIRECT_CALLS:
+        return None
+    subs = call_arg_maps(eqn)
+    if not subs:
+        return None
+    sub = subs[0]
+    try:
+        o = list(eqn.outvars).index(var)
+    except ValueError:
+        return None
+    for io, oo in enumerate(sub.out_map):
+        if oo == o:
+            inner = as_jaxpr(sub.jaxpr).outvars[io]
+            if isinstance(inner, jax.core.Literal):
+                return None
+            return inner, make_scope(sub.jaxpr, scope, eqn, sub)
+    return None
+
+
+def _scalar_literal(v, scope: Scope):
+    """The Python value of a scalar literal (chasing trivial
+    broadcasts/converts), or None."""
+    for _ in range(6):
+        if isinstance(v, jax.core.Literal):
+            val = np.asarray(v.val)
+            return val.item() if val.ndim == 0 else None
+        if getattr(v.aval, "shape", None) == () and v in scope.consts:
+            return np.asarray(scope.consts[v]).item()
+        e = scope.defs.get(v)
+        if e is None or e.primitive.name not in (
+                "broadcast_in_dim", "convert_element_type", "reshape",
+                "squeeze", "copy"):
+            return None
+        v = e.invars[0]
+    return None
+
+
+def _peel_uniform_shift(v, scope: Scope):
+    """Resolve `v` to (base var, base scope, accumulated literal shift)
+    by peeling add/sub of scalar literals and trivial wrappers — the
+    shape of a wrap-fixup select arm (`t` and `t - T` share base `t`
+    with shifts 0 and -T).  Returns None when `v` is a literal or the
+    chain leaves the provable shape."""
+    if isinstance(v, jax.core.Literal):
+        return None
+    shift = 0
+    for _ in range(12):
+        eqn = scope.defs.get(v)
+        if eqn is None:
+            v2, s2, sh = resolve_var(v, scope)
+            if v2 is None or sh:
+                break
+            v, scope = v2, s2
+            continue
+        down = _descend_outvar(eqn, v, scope)
+        if down is not None:
+            v, scope = down
+            continue
+        name = eqn.primitive.name
+        if name in ("add", "sub"):
+            x, y = eqn.invars[0], eqn.invars[1]
+            k = _scalar_literal(y, scope)
+            if k is not None and not isinstance(x, jax.core.Literal):
+                shift += -int(k) if name == "sub" else int(k)
+                v = x
+                continue
+            if name == "add":
+                k = _scalar_literal(x, scope)
+                if k is not None \
+                        and not isinstance(y, jax.core.Literal):
+                    shift += int(k)
+                    v = y
+                    continue
+            break
+        if name in _DISTINCT_PASS_THROUGH:
+            v = eqn.invars[0]
+            continue
+        break
+    return v, scope, shift
+
+
+def _const_cross_shift_distinct(arr, axis: int, shifts) -> bool:
+    """For a concrete index table: can two positions along `axis` (same
+    other coordinates) collide under ANY per-position choice of the
+    literal `shifts`?  Exhaustive, like _const_axis_forms — this is
+    what lets a no-repeat const table stay proven through the .at[]
+    wrap-fixup select (`select(d < 0, d, d + N)`), whose arms shift
+    the same base by different amounts."""
+    arr = np.asarray(arr)
+    m = np.moveaxis(arr, axis, 0).reshape(arr.shape[axis], -1)
+    shifts = sorted({int(s) for s in shifts})
+    for c in range(m.shape[1]):
+        seen = {}
+        for i, x in enumerate(m[:, c]):
+            for s in shifts:
+                key = int(x) + s
+                if seen.setdefault(key, i) != i:
+                    return False
+    return True
+
+
+def _is_uniform_scalar(v, scope: Scope, _depth: int = 0) -> bool:
+    """Does `v` hold one value replicated everywhere (a broadcast of a
+    scalar)?  Adding such an operand shifts every position equally, so
+    per-axis distinctness survives even when the value is traced; a
+    select arm like this is a single redirect slot."""
+    if _depth > 12:
+        return False
+    while True:
+        if isinstance(v, jax.core.Literal):
+            val = np.asarray(v.val)
+            return val.ndim == 0 or len(np.unique(val)) == 1
+        if getattr(v.aval, "shape", None) == ():
+            return True
+        eqn = scope.defs.get(v)
+        if eqn is not None:
+            down = _descend_outvar(eqn, v, scope)
+            if down is None:
+                break
+            v, scope = down
+            continue
+        if v in scope.consts:
+            c = np.asarray(scope.consts[v])
+            return c.size == 1 or len(np.unique(c)) == 1
+        v2, s2, shift = resolve_var(v, scope)
+        if v2 is None:
+            return False
+        v, scope = v2, s2
+    # broadcasting/reshaping a uniform value stays uniform
+    if eqn.primitive.name in (
+            "broadcast_in_dim", "reshape", "squeeze", "copy",
+            "convert_element_type", "stop_gradient", "expand_dims"):
+        return _is_uniform_scalar(eqn.invars[0], scope, _depth + 1)
+    return False
+
+
+def _merge_arm_forms(forms: "list") -> "tuple | None":
+    """Combine per-arm forms of an elementwise select: every position
+    takes SOME arm's value, so the result is congruent mod the gcd of
+    the arms' moduli and pairwise offset differences."""
+    if any(f is None for f in forms):
+        return None
+    if all(f == ("D",) for f in forms) and len(forms) == 1:
+        return ("D",)
+    if any(f == ("D",) for f in forms):
+        return None   # no congruence info to reconcile the arms with
+    if any(f[1] is None for f in forms):
+        # unknown shifts: offset differences unprovable across arms
+        return forms[0] if len(forms) == 1 else None
+    g = 0
+    for f in forms:
+        g = int(np.gcd(g, int(f[0])))
+    c0 = forms[0][1]
+    for f in forms[1:]:
+        g = int(np.gcd(g, abs(int(f[1]) - int(c0))))
+    return (g, c0 % g if g else c0)
+
+
+def _axis_forms(var, scope: Scope, _depth: int = 0) -> dict:
+    """axis -> provenance form (see above) for `var`.  Conservative:
+    a missing axis means "not provable", never "aliasing"."""
+    if _depth > _PROVENANCE_DEPTH or isinstance(var, jax.core.Literal):
+        return {}
+    while True:
+        eqn = scope.defs.get(var)
+        if eqn is not None:
+            down = _descend_outvar(eqn, var, scope)
+            if down is None:
+                break
+            var, scope = down
+            continue
+        if var in scope.consts:
+            return _const_axis_forms(scope.consts[var])
+        var2, scope2, shift = resolve_var(var, scope)
+        if var2 is None:
+            return {}
+        if shift:
+            outer = _axis_forms(var2, scope2, _depth + 1)
+            return {a - 1: f for a, f in outer.items() if a >= 1}
+        var, scope = var2, scope2
+    name = eqn.primitive.name
+    if name == "iota":
+        return {int(eqn.params["dimension"]): (0, 0)}
+    if name in _DISTINCT_PASS_THROUGH:
+        return _axis_forms(eqn.invars[0], scope, _depth + 1)
+    if name in ("add", "sub"):
+        x, y = eqn.invars[0], eqn.invars[1]
+        # value = structured + uniform shift: distinctness survives,
+        # and a literal shift keeps the congruence offset exact
+        candidates = [(x, y, -1 if name == "sub" else 1)]
+        if name == "add":
+            candidates.append((y, x, 1))
+        for a, b, sign in candidates:
+            if isinstance(a, jax.core.Literal) \
+                    or not _is_uniform_scalar(b, scope):
+                continue
+            forms = _axis_forms(a, scope, _depth + 1)
+            k = _scalar_literal(b, scope)
+            out = {}
+            for ax, f in forms.items():
+                if f == ("D",):
+                    out[ax] = f
+                elif k is None or f[1] is None:
+                    out[ax] = (f[0], None)
+                else:
+                    c = int(f[1]) + sign * int(k)
+                    out[ax] = (f[0], c % f[0] if f[0] else c)
+            return out
+        return {}
+    if name == "rem":
+        r = _scalar_literal(eqn.invars[1], scope)
+        if r is None or int(r) <= 0:
+            return {}
+        r = int(r)
+        forms = _axis_forms(eqn.invars[0], scope, _depth + 1)
+        out = {}
+        for ax, f in forms.items():
+            if f == ("D",):
+                continue   # remainder of an arbitrary table can collide
+            m, c = f
+            if m == 0 or m % r == 0:
+                out[ax] = (r, None if c is None else int(c) % r)
+        return out
+    if name == "select_n":
+        # shared-base arms first (the .at[] wrap fixup: select(p, t,
+        # t - T)): the arms' absolute offsets may be unknown, but
+        # their RELATIVE literal shifts still pin congruence mod the
+        # shift gcd — per position the value is base + shift_j, so
+        # distinctness mod gcd(base modulus, shift differences) holds
+        peeled = [_peel_uniform_shift(v, scope)
+                  for v in eqn.invars[1:]]
+        if len(peeled) > 1 and all(p is not None for p in peeled):
+            b0, s0, k0 = peeled[0]
+            if all(p[0] is b0 and p[1].jaxpr is s0.jaxpr
+                   for p in peeled[1:]):
+                g = 0
+                for _, _, k in peeled[1:]:
+                    g = int(np.gcd(g, abs(int(k) - int(k0))))
+                cval = s0.consts.get(b0)
+                shifts = [k0] + [p[2] for p in peeled[1:]]
+                out = {}
+                for ax, f in _axis_forms(b0, s0, _depth + 1).items():
+                    if f == ("D",):
+                        # identical shifts are a pure copy; differing
+                        # shifts keep a CONST table distinct exactly
+                        # when no cross-shift pair collides (checked
+                        # exhaustively, consts are small)
+                        if g == 0 or (cval is not None
+                                      and _const_cross_shift_distinct(
+                                          cval, ax, shifts)):
+                            out[ax] = f
+                        continue
+                    if g == 0:
+                        m, c = int(f[0]), f[1]
+                        out[ax] = (m, None if c is None
+                                   else (int(c) + k0) % m if m
+                                   else int(c) + k0)
+                        continue
+                    m = int(np.gcd(int(f[0]), g))
+                    if m:
+                        out[ax] = (m, None if f[1] is None
+                                   else (int(f[1]) + k0) % m)
+                return out
+        arms = [
+            _axis_forms(v, scope, _depth + 1)
+            if not isinstance(v, jax.core.Literal) else {}
+            for v in eqn.invars[1:]
+        ]
+        out = {}
+        for ax in set().union(*[set(a) for a in arms]) if arms else ():
+            merged = _merge_arm_forms([a.get(ax) for a in arms])
+            if merged is not None:
+                out[ax] = merged
+        return out
+    if name == "broadcast_in_dim":
+        inner = _axis_forms(eqn.invars[0], scope, _depth + 1)
+        bd = eqn.params["broadcast_dimensions"]
+        in_shape = getattr(eqn.invars[0].aval, "shape", ())
+        return {
+            int(bd[a]): f for a, f in inner.items()
+            if a < len(bd) and int(in_shape[a]) ==
+            int(eqn.outvars[0].aval.shape[bd[a]])
+        }
+    if name in ("reshape", "squeeze"):
+        # only size-1 insertions/removals are tracked: the non-unit
+        # dims must survive in order for the axis map to be sound
+        in_shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+        out_shape = tuple(eqn.outvars[0].aval.shape)
+        in_nz = [a for a, d in enumerate(in_shape) if d != 1]
+        out_nz = [a for a, d in enumerate(out_shape) if d != 1]
+        if [in_shape[a] for a in in_nz] != [out_shape[a] for a in out_nz]:
+            return {}
+        inner = _axis_forms(eqn.invars[0], scope, _depth + 1)
+        remap = dict(zip(in_nz, out_nz))
+        return {remap[a]: f for a, f in inner.items() if a in remap}
+    if name == "concatenate":
+        d = int(eqn.params["dimension"])
+        out = {}
+        for v in eqn.invars:
+            for a, f in _axis_forms(v, scope, _depth + 1).items():
+                if a != d and a not in out:
+                    out[a] = f
+        return out
+    return {}
+
+
+def distinct_axes(var, scope: Scope) -> frozenset:
+    """Axes `a` of `var` with the pairwise-distinct property: any two
+    positions differing in axis `a` hold different values, regardless
+    of the other coordinates.  Proven by provenance (`_axis_forms`):
+    an iota column, a concrete const table with no repeats, or an
+    affine-congruent form whose modulus covers the axis size (the
+    wraparound-select idiom).  Conservative: an empty set means "not
+    provable", not "aliasing"."""
+    shape = tuple(getattr(var.aval, "shape", ()) or ())
+    out = set()
+    for a, f in _axis_forms(var, scope).items():
+        if a >= len(shape):
+            continue
+        if f == ("D",) or f[0] == 0 or f[0] >= int(shape[a]):
+            out.add(a)
+    return frozenset(out)
+
+
+def masked_index_select(var, scope: Scope, _depth: int = 0) -> bool:
+    """Is `var` an index array built by the engines' masked
+    scratch-redirect idiom — a select between real indices and a
+    uniform scratch slot (`jnp.where(mask, word, SCRATCH)`), the
+    round-9 "masked store" shape?  Such a scatter is masked BY
+    CONSTRUCTION: disabled lanes all land on the dedicated slot.  The
+    detection sees through jnp's pjit-wrapped where/mod composites and
+    the index-wrap fixup select the `.at[]` lowering adds on top."""
+    if _depth > _PROVENANCE_DEPTH or isinstance(var, jax.core.Literal):
+        return False
+    while True:
+        eqn = scope.defs.get(var)
+        if eqn is not None:
+            down = _descend_outvar(eqn, var, scope)
+            if down is None:
+                break
+            var, scope = down
+            continue
+        var2, scope2, shift = resolve_var(var, scope)
+        if var2 is None or shift:
+            return False
+        var, scope = var2, scope2
+    name = eqn.primitive.name
+    if name in _DISTINCT_PASS_THROUGH or name in (
+            "broadcast_in_dim", "reshape", "squeeze", "concatenate",
+            "add", "sub", "rem"):
+        # index arithmetic (the .at[] wrap fixup adds/rems the axis
+        # size) and movement preserve "one arm is a fixed slot" ONLY
+        # when every operand is the masked select or uniform: a masked
+        # redirect added to an OPAQUE base (base + where(mask, 0, S))
+        # re-opens collisions between the base rows, and an opaque
+        # part concatenated next to a masked one can alias it
+        got_masked = False
+        for v in eqn.invars:
+            if isinstance(v, jax.core.Literal) \
+                    or _is_uniform_scalar(v, scope):
+                continue
+            if not masked_index_select(v, scope, _depth + 1):
+                return False
+            got_masked = True
+        return got_masked
+    if name != "select_n":
+        return False
+
+    def is_uniform_arm(v):
+        # a literal, a broadcast scalar, or anything else uniform:
+        # every masked-off lane lands on ONE slot
+        return isinstance(v, jax.core.Literal) \
+            or _is_uniform_scalar(v, scope)
+
+    # select_n(pred, arm0, arm1, ...): one arm a uniform scratch slot
+    # (the masked-store idiom proper), else EVERY arm itself a masked
+    # select (the wrap fixup selects between two shifted copies of the
+    # redirect) — an opaque sibling arm re-opens collisions between
+    # the lanes that select it
+    if any(is_uniform_arm(v) for v in eqn.invars[1:]):
+        return True
+    got_masked = False
+    for v in eqn.invars[1:]:
+        if not masked_index_select(v, scope, _depth + 1):
+            return False
+        got_masked = True
+    return got_masked

@@ -203,6 +203,14 @@ class SweepRunner:
         self._runner_max_quanta = None
         self._dtr = None      # device-resident [B, T, L] traces (cached)
         self._states0 = None  # broadcast [B, ...] initial states (cached)
+        # lower-once plumbing (round 11): one tracing per max_quanta
+        # serves audit + cost + fingerprint; lower_count is the probe.
+        # _sim_lower_gen mirrors sim.lower_gen — attach_telemetry on
+        # the wrapped sim changes the program AND initial state, so
+        # every sim-derived cache here must drop (_sync_with_sim)
+        self._lowered = {}
+        self.lower_count = 0
+        self._sim_lower_gen = self.sim.lower_gen
         # Pre-compile residency fail-fast (round 10): the campaign's HBM
         # bill is B x per-sim state + the resident [B, T, L] traces +
         # B telemetry rings — all known BEFORE tracing, so a sweep of
@@ -292,7 +300,23 @@ class SweepRunner:
                           in_specs=(P("b"), P("b"), P("b")),
                           out_specs=P("b"))
 
+    def _sync_with_sim(self):
+        """Drop caches derived from the wrapped sim's program when its
+        identity changed (attach_telemetry after this runner was built):
+        the lowering, the jitted runner, and the broadcast initial
+        states all bake the telemetry spec/ring in, and serving stale
+        ones would certify or execute a different artifact than the
+        sim describes."""
+        if self._sim_lower_gen != self.sim.lower_gen:
+            self._sim_lower_gen = self.sim.lower_gen
+            self._lowered = {}
+            self._runner = None
+            self._runner_max_quanta = None
+            self._states0 = None
+            self._dtr = None
+
     def _get_runner(self, max_quanta: int):
+        self._sync_with_sim()
         if self._runner is None or self._runner_max_quanta != max_quanta:
             self._runner = jax.jit(self._runner_fn(max_quanta))
             self._runner_max_quanta = max_quanta
@@ -302,6 +326,7 @@ class SweepRunner:
         """The [B, ...] initial states and [B, T, L] device traces,
         built once and cached so repeat run() calls (timed benchmark
         loops) measure the program, not a host->device re-upload."""
+        self._sync_with_sim()
         if self._states0 is None:
             B = self.pack.n_sims
             self._states0 = jax.tree_util.tree_map(
@@ -318,11 +343,17 @@ class SweepRunner:
 
         Pure tracing over abstract inputs: make_jaxpr only needs avals,
         so audit-only callers never pay the [B, ...] state broadcast or
-        the [B, T, L] trace upload run() caches for execution."""
+        the [B, T, L] trace upload run() caches for execution.
+        Lower-once: cached per max_quanta, so audit + cost +
+        fingerprint share one tracing (`lower_count` is the probe)."""
         from graphite_tpu.analysis.walk import invar_path_strings
         from graphite_tpu.engine.state import DeviceTrace
         from graphite_tpu.sweep.pack import PackedTraces
 
+        self._sync_with_sim()
+        hit = self._lowered.get(max_quanta)
+        if hit is not None:
+            return hit
         B = self.pack.n_sims
         states_abs = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct((B,) + jnp.shape(x),
@@ -334,8 +365,11 @@ class SweepRunner:
             for f in PackedTraces._TRACE_FIELDS})
         closed = jax.make_jaxpr(self._runner_fn(max_quanta))(
             states_abs, dtr_abs, self.knobs)
-        return closed, invar_path_strings((states_abs, dtr_abs,
-                                           self.knobs))
+        self.lower_count += 1
+        hit = (closed, invar_path_strings((states_abs, dtr_abs,
+                                           self.knobs)))
+        self._lowered[max_quanta] = hit
+        return hit
 
     def run(self, max_quanta: int = 1_000_000) -> SweepOutcome:
         from graphite_tpu.engine.simulator import (

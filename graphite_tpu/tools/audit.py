@@ -18,6 +18,19 @@ equation named); `--budget-update` refreshes the baselines after an
 intentional change.  `--regression-fixture` swaps in the known-bad
 inflated-carry program — the gate must trip on it (the CI self-test).
 
+`--lock` gates program IDENTITY the same way (round 11): every default
+program's canonical fingerprint (analysis/identity.py) must match its
+registered entry in the checked-in PROGRAMS.lock (analysis/registry.py)
+— tile geometry and sweep-knob signature included — so no program
+drifts unnoticed and no renamed/retraced program silently inherits
+stale budget ceilings (budget entries record the fingerprint they were
+measured at and are resolved THROUGH the registry).  `--lock-update`
+re-registers after an INTENTIONAL program change; `--lock-fixture`
+swaps in the intentionally perturbed gated-MSI lowering — the lock
+gate must trip on it AND the emitted structural diff must name the
+first divergent equation with its protocol phase (the CI self-test
+that drift reports are attributed, not just "hash changed").
+
 Output is JSON lines: one line per lint finding, one cost line and one
 summary line per program, then one trailing overall line.  Exit code 0
 iff no error-severity finding fired (`--strict` also fails on warnings).
@@ -28,6 +41,9 @@ Usage:
                                      [--budget | --budget-update]
                                      [--budgets-file PATH]
                                      [--regression-fixture]
+                                     [--lock | --lock-update]
+                                     [--lock-file PATH]
+                                     [--lock-fixture]
 """
 
 from __future__ import annotations
@@ -70,28 +86,67 @@ def main(argv=None) -> int:
                     help="audit the known-bad inflated-carry fixture "
                     "instead of the real gated-msi program — the budget "
                     "gate MUST exit nonzero (CI self-test)")
+    ap.add_argument("--lock", action="store_true",
+                    help="gate each program's canonical fingerprint "
+                    "against the checked-in PROGRAMS.lock registry "
+                    "(exit nonzero on any identity drift)")
+    ap.add_argument("--lock-update", action="store_true",
+                    help="re-register this run's program identities "
+                    "into PROGRAMS.lock (after an INTENTIONAL change; "
+                    "merges, so --programs subsets are safe)")
+    ap.add_argument("--lock-file", default=None,
+                    help="override the PROGRAMS.lock path (default: "
+                    "repo root)")
+    ap.add_argument("--lock-fixture", action="store_true",
+                    help="audit the intentionally perturbed gated-msi "
+                    "lowering instead of the real one — the lock gate "
+                    "MUST exit nonzero with a structural diff naming "
+                    "the divergent equation and its protocol phase "
+                    "(CI self-test)")
     args = ap.parse_args(argv)
     if args.budget and args.budget_update:
         ap.error("--budget and --budget-update are mutually exclusive "
                  "(gate against the ceilings OR refresh them, not both)")
-    if args.regression_fixture and args.budget_update:
-        # the fixture deliberately reuses the real program's name so the
-        # gate runs against its checked-in ceilings — writing its
-        # inflated measurements back would corrupt the real baseline and
-        # turn the CI self-test green on a broken gate
-        ap.error("--regression-fixture is a read-only self-test; it "
-                 "cannot be combined with --budget-update")
-    # the fixture exists only to prove the gate trips — without the gate
+    if args.lock and args.lock_update:
+        ap.error("--lock and --lock-update are mutually exclusive "
+                 "(gate against the registry OR refresh it, not both)")
+    if args.regression_fixture and args.lock_fixture:
+        ap.error("--regression-fixture and --lock-fixture each swap in "
+                 "their own known-bad program; run the self-tests "
+                 "separately")
+    # each fixture self-tests ONE gate; arming the OTHER gate alongside
+    # lets its finding (the budget fixture's perturbed identity always
+    # trips the lock) carry the nonzero exit even when the gate under
+    # test is broken — a vacuously green CI self-test
+    if args.regression_fixture and args.lock:
+        ap.error("--regression-fixture self-tests the budget gate; "
+                 "--lock would trip on the fixture's identity and mask "
+                 "a broken budget gate (run the lock gate separately)")
+    if args.lock_fixture and args.budget:
+        ap.error("--lock-fixture self-tests the lock gate; combine it "
+                 "with --budget and the exit code no longer isolates "
+                 "the gate under test (run the budget gate separately)")
+    if (args.regression_fixture or args.lock_fixture) \
+            and (args.budget_update or args.lock_update):
+        # both fixtures deliberately reuse the real program's name so
+        # their gates run against the checked-in baselines — writing a
+        # fixture's measurements or identity back would corrupt the
+        # real entries and turn the CI self-tests green on broken gates
+        ap.error("the fixtures are read-only self-tests; they cannot "
+                 "be combined with --budget-update or --lock-update")
+    # a fixture exists only to prove its gate trips — without the gate
     # its lints all pass and the self-test would be vacuously green
     if args.regression_fixture:
         args.budget = True
+    if args.lock_fixture:
+        args.lock = True
 
     # auditing is host-side static analysis — never touch a real chip
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     import graphite_tpu  # noqa: F401  (x64)
 
-    from graphite_tpu.analysis import cost
+    from graphite_tpu.analysis import cost, identity, registry
     from graphite_tpu.analysis.audit import (
         DEFAULT_MAX_COND_BYTES, audit, default_programs,
     )
@@ -103,6 +158,8 @@ def main(argv=None) -> int:
     try:
         if args.regression_fixture:
             specs = [cost.budget_regression_fixture(args.tiles)]
+        elif args.lock_fixture:
+            specs = [registry.lock_regression_fixture(args.tiles)]
         else:
             specs = default_programs(args.tiles, names=names)
     except ValueError as e:
@@ -111,13 +168,69 @@ def main(argv=None) -> int:
         args.max_cond_bytes if args.max_cond_bytes is not None
         else DEFAULT_MAX_COND_BYTES))
 
+    # the lock registry doubles as the budget gate's resolver: budget
+    # entries are looked up under the registered budget_key and refuse
+    # ceilings measured at a different fingerprint
+    lock = None
+    if args.lock or args.budget or args.budget_update:
+        try:
+            lock = registry.load_lock(args.lock_file)
+        except FileNotFoundError as e:
+            if args.lock:
+                raise SystemExit(
+                    f"no PROGRAMS.lock ({e}); create one with "
+                    f"--lock-update")
+            # --budget without a lock file: ceilings resolve by name
+            # only, as before round 11
+
+    lock_findings = []
+    if args.lock_update:
+        path = registry.save_lock(
+            [registry.record_from_spec(s) for s in specs],
+            args.lock_file)
+        print(json.dumps({"lock_updated": True, "path": path,
+                          "programs": [s.name for s in specs]}))
+        # a combined --budget/--budget-update run must resolve through
+        # the registry JUST written (merged entries, preserved budget
+        # keys) — the pre-update records' fingerprints would certify
+        # ceilings against the artifact the refresh just replaced
+        lock = registry.load_lock(args.lock_file)
+    elif args.lock:
+        # a full-set run also flags stale registered names nothing
+        # audits anymore; subset/fixture runs only check what they
+        # lowered
+        lock_findings = registry.check_lock(
+            specs, lock,
+            expect_complete=(names is None and not args.lock_fixture
+                             and not args.regression_fixture))
+        if args.lock_fixture and lock_findings:
+            # the self-test must prove drift is ATTRIBUTED: diff the
+            # perturbed lowering against the reference program and
+            # name the first divergent equation + its protocol phase
+            ref = default_programs(args.tiles,
+                                   names=("gated-msi",))[0]
+            d = identity.diff_or_none(
+                ref.closed, specs[0].closed, n_tiles=ref.n_tiles,
+                phase_names=ref.phase_names)
+            if d is not None:
+                for f in lock_findings:
+                    f.message += f"; {d}"
+                    f.data["diff"] = d.to_json()
+                print(json.dumps({"lock_diff": True,
+                                  "program": specs[0].name,
+                                  **d.to_json()}))
+
     # static cost reports ride alongside the lint rows unconditionally
     # (walking a lowered jaxpr is cheap; the budget GATE is opt-in)
     cost_reports = [cost.cost_report(s) for s in specs]
     budget_findings = []
     if args.budget or args.budget_update:
         if args.budget_update:
-            path = cost.save_budgets(cost_reports, args.budgets_file)
+            path = cost.save_budgets(
+                cost_reports, args.budgets_file,
+                fingerprints={s.name: identity.fingerprint(s.closed)
+                              for s in specs},
+                registry=lock)
             print(json.dumps({"budgets_updated": True, "path": path,
                               "programs": [r.program
                                            for r in cost_reports]}))
@@ -128,26 +241,29 @@ def main(argv=None) -> int:
                 raise SystemExit(
                     f"no budgets file ({e}); create one with "
                     f"--budget-update")
-            budget_findings = cost.check_budgets(cost_reports, budgets)
+            budget_findings = cost.check_budgets(cost_reports, budgets,
+                                                 registry=lock)
 
     for f in report.findings:
         print(json.dumps(f.to_json()))
     for rep in cost_reports:
         print(json.dumps(rep.to_json()))
-    for f in budget_findings:
+    for f in budget_findings + lock_findings:
         print(json.dumps(f.to_json()))
     for row in report.summary_rows():
         print(json.dumps(row))
     n_budget_err = len(budget_findings)
-    ok = (report.ok and not n_budget_err
+    n_lock_err = len(lock_findings)
+    ok = (report.ok and not n_budget_err and not n_lock_err
           and not (args.strict and report.findings))
     print(json.dumps({
         "overall": True,
         "ok": ok,
         "programs": len(specs),
-        "errors": len(report.errors) + n_budget_err,
+        "errors": len(report.errors) + n_budget_err + n_lock_err,
         "warnings": len(report.findings) - len(report.errors),
         "budget_errors": n_budget_err,
+        "lock_errors": n_lock_err,
         "wall_s": round(time.perf_counter() - t0, 1),
     }))
     return 0 if ok else 1

@@ -19,11 +19,13 @@ exactly, the B=4 sweep must match sequential runs, telemetry recording
 must leave SimResults bit-identical (solo, gated + ungated) and the
 B=4 campaign's demuxed timelines must equal sequential telemetry runs,
 the program auditor's jaxpr invariant lints (graphite_tpu/analysis)
-must pass on the lowered default programs, and every default program's
+must pass on the lowered default programs, every default program's
 static cost report must sit within the checked-in BUDGETS.json
 ceilings (the round-10 budget gate — kernel proxy, bytes/iter, peak
 residency; tools/audit.py --budget-update refreshes after an
-intentional change).
+intentional change), and every default program's canonical fingerprint
+must match its registered identity in PROGRAMS.lock (the round-11
+identity gate — tools/audit.py --lock-update re-registers).
 """
 
 from __future__ import annotations
@@ -188,6 +190,7 @@ def smoke(tiles: int = 16) -> int:
     #    BUDGETS.json ceilings — kernel proxy, bytes/iter, peak
     #    residency.  The same lowered specs as rung 5; no compile.
     from graphite_tpu.analysis import cost as _cost
+    from graphite_tpu.analysis import registry as _registry
 
     try:
         budgets = _cost.load_budgets()
@@ -196,14 +199,51 @@ def smoke(tiles: int = 16) -> int:
               f"tools/audit.py --budget-update)")
         failures += 1
     else:
+        # round 11: budgets resolve THROUGH the program registry, so a
+        # ceiling measured at a different fingerprint errors loudly
+        try:
+            reg = _registry.load_lock()
+        except FileNotFoundError:
+            reg = None
         for spec in specs:
             rep = _cost.cost_report(spec)
-            trips = _cost.check_budget(rep, budgets)
+            trips = _cost.check_budget(
+                rep, budgets,
+                record=(reg or {}).get(rep.program))
             name = f"budget {rep.program}"
             print(f"{name:44} {'PASS' if not trips else 'FAIL'}")
             for f in trips:
                 print(f"    {f}")
             failures += 1 if trips else 0
+
+    # 7) identity lock (round 11): every default program's canonical
+    #    fingerprint (analysis/identity.py) must match its registered
+    #    entry in PROGRAMS.lock — geometry and knob signature included.
+    #    Same lowered specs as rungs 5-6; tools/audit.py --lock-update
+    #    re-registers after an intentional change.
+    try:
+        lock = _registry.load_lock()
+    except FileNotFoundError:
+        print(f"{'lock PROGRAMS.lock':44} FAIL  (missing — run "
+              f"tools/audit.py --lock-update)")
+        failures += 1
+    else:
+        trips = _registry.check_lock(specs, lock, expect_complete=True)
+        by_prog = {}
+        for f in trips:
+            by_prog.setdefault(f.program, []).append(f)
+        for spec in specs:
+            name = f"lock {spec.name}"
+            fs = by_prog.pop(spec.name, [])
+            print(f"{name:44} {'PASS' if not fs else 'FAIL'}")
+            for f in fs:
+                print(f"    {f}")
+            failures += 1 if fs else 0
+        for prog, fs in sorted(by_prog.items()):
+            print(f"{f'lock {prog}':44} FAIL")
+            for f in fs:
+                print(f"    {f}")
+            failures += 1
 
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
     return 1 if failures else 0

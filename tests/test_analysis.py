@@ -350,6 +350,162 @@ def test_host_sync_clean_on_plain_program():
     assert not rules.host_sync(jax.make_jaxpr(lambda x: x * 2)(1.0))
 
 
+# ---- rule 6: scatter-determinism ------------------------------------------
+
+
+def test_scatter_determinism_fires_on_aliasing_replace_scatter():
+    """Known-bad fixture: a vmapped replace-combiner scatter whose
+    traced index rows can collide — XLA leaves the winner
+    implementation-defined, so the round-9 masked-add-scatter contract
+    must flag it inside batched programs."""
+    def bad(x, idx):
+        return x.at[idx].set(1.0)
+
+    cb = jax.make_jaxpr(jax.vmap(bad))(
+        jnp.zeros((3, 16)), jnp.zeros((3, 4), jnp.int32))
+    fs = rules.scatter_determinism(cb, batched=True)
+    assert len(fs) == 1 and fs[0].rule == "scatter-determinism"
+    assert fs[0].severity == rules.SEV_WARNING
+    assert "implementation-defined" in fs[0].message
+    # solo (non-batched) programs only police shard_map interiors:
+    # the same scatter at top level is out of scope
+    assert not rules.scatter_determinism(cb, batched=False)
+
+
+def test_scatter_determinism_clean_on_commutative_and_unique():
+    """Add-combiner scatters commute; unique_indices is an explicit
+    no-alias declaration — neither can be nondeterministic."""
+    def add(x, idx):
+        return x.at[idx].add(1.0)
+
+    ca = jax.make_jaxpr(jax.vmap(add))(
+        jnp.zeros((3, 16)), jnp.zeros((3, 4), jnp.int32))
+    assert not rules.scatter_determinism(ca, batched=True)
+
+    def uni(x, idx, v):
+        return x.at[idx].set(v, unique_indices=True)
+
+    cu = jax.make_jaxpr(jax.vmap(uni))(
+        jnp.zeros((3, 16)), jnp.zeros((3, 4), jnp.int32),
+        jnp.zeros((3, 4)))
+    assert not rules.scatter_determinism(cu, batched=True)
+
+
+def test_scatter_determinism_proves_iota_and_wraparound_indices():
+    """Index provenance: an iota row and the engines' wraparound idiom
+    (`where(h < T, h, h - T)` — both arms congruent mod T) are
+    collision-free by construction, even though the scatter replaces."""
+    def iota(x, v):
+        return x.at[jnp.arange(4, dtype=jnp.int32)].set(v)
+
+    ci = jax.make_jaxpr(jax.vmap(iota))(
+        jnp.zeros((3, 16)), jnp.zeros((3, 4)))
+    assert not rules.scatter_determinism(ci, batched=True)
+
+    def wrap(x, h):
+        idx = jnp.where(h < 8, h, h - 8) \
+            + jnp.arange(8, dtype=jnp.int32)
+        idx = jnp.where(idx < 8, idx, idx - 8)
+        return x.at[idx].set(1.0, mode="drop")
+
+    cw = jax.make_jaxpr(jax.vmap(wrap, in_axes=(0, None)))(
+        jnp.zeros((3, 8)), jnp.asarray(3, jnp.int32))
+    assert not rules.scatter_determinism(cw, batched=True)
+
+
+def test_scatter_determinism_allows_masked_scratch_redirect():
+    """The round-9 masked-store idiom: disabled lanes select ONE
+    dedicated scratch slot, so colliding "writes" all carry the same
+    redirect — masked by construction."""
+    def masked(x, word, mask):
+        idx = jnp.where(mask, word, 16)
+        return x.at[idx].set(1.0, mode="drop")
+
+    cm = jax.make_jaxpr(jax.vmap(masked))(
+        jnp.zeros((3, 17)), jnp.zeros((3, 4), jnp.int32),
+        jnp.zeros((3, 4), bool))
+    assert not rules.scatter_determinism(cm, batched=True)
+
+
+def test_scatter_determinism_single_row_is_trivially_safe():
+    """A lone index row cannot collide with itself: size-1 row axes
+    (and rank-1 indices whose only row axis is a vmap batching dim)
+    are out of scope even when the index value is fully opaque."""
+    def one_row(x, i, v):
+        return x.at[i.reshape(1)].set(v)
+
+    cv = jax.make_jaxpr(jax.vmap(one_row))(
+        jnp.zeros((3, 16)), jnp.zeros((3,), jnp.int32),
+        jnp.zeros((3,)))
+    assert not rules.scatter_determinism(cv, batched=True)
+
+    c1 = jax.make_jaxpr(
+        lambda x, i: x.at[i.reshape(1)].set(1.0))(
+        jnp.zeros(16), jnp.asarray(5, jnp.int32))
+    assert not rules.scatter_determinism(c1, batched=True)
+
+
+def test_scatter_determinism_masked_redirect_needs_all_operands():
+    """A masked redirect combined with an OPAQUE operand is not the
+    round-9 idiom: `base + where(mask, 0, S)` still collides at the
+    base rows, and an opaque array concatenated next to a masked one
+    can alias it — the pass-through must require EVERY non-uniform
+    operand to be the masked select, not any one of them."""
+    def bad_add(x, base, mask):
+        idx = base + jnp.where(mask, 0, 16)
+        return x.at[idx].set(1.0, mode="drop")
+
+    ca = jax.make_jaxpr(jax.vmap(bad_add))(
+        jnp.zeros((3, 32)), jnp.zeros((3, 4), jnp.int32),
+        jnp.zeros((3, 4), bool))
+    assert rules.scatter_determinism(ca, batched=True)
+
+    def bad_cat(x, word, opaque, mask):
+        idx = jnp.concatenate([jnp.where(mask, word, 16), opaque])
+        return x.at[idx].set(1.0, mode="drop")
+
+    cc = jax.make_jaxpr(jax.vmap(bad_cat))(
+        jnp.zeros((3, 17)), jnp.zeros((3, 4), jnp.int32),
+        jnp.zeros((3, 4), jnp.int32), jnp.zeros((3, 4), bool))
+    assert rules.scatter_determinism(cc, batched=True)
+
+    # a select whose SIBLING arm is fully opaque is not the idiom
+    # either: lanes picking the opaque arm can still collide
+    def bad_sel(x, word, opaque, p, mask):
+        idx = jnp.where(p, opaque, jnp.where(mask, word, 16))
+        return x.at[idx].set(1.0, mode="drop")
+
+    cs = jax.make_jaxpr(jax.vmap(bad_sel))(
+        jnp.zeros((3, 17)), jnp.zeros((3, 4), jnp.int32),
+        jnp.zeros((3, 4), jnp.int32), jnp.zeros((3, 4), bool),
+        jnp.zeros((3, 4), bool))
+    assert rules.scatter_determinism(cs, batched=True)
+
+
+def test_scatter_determinism_const_tables_and_row_axis_limits():
+    """A hoisted no-repeat host const index table is collision-free
+    (the device_put between the constvar and its use must not hide
+    it), but per-axis distinctness proofs stop at ONE multi-size row
+    axis: [[0, 1], [1, 0]] is distinct along both axes yet rows (0,0)
+    and (1,1) both hold index 0."""
+    import numpy as np
+
+    def ok_tbl(x, v):
+        return x.at[jnp.asarray(np.arange(4, dtype=np.int32))].set(v)
+
+    ct = jax.make_jaxpr(jax.vmap(ok_tbl))(
+        jnp.zeros((3, 16)), jnp.zeros((3, 4)))
+    assert not rules.scatter_determinism(ct, batched=True)
+
+    def bad_tbl(x, v):
+        tbl = jnp.asarray(np.array([[0, 1], [1, 0]], np.int32))
+        return x.at[tbl].set(v)
+
+    c2 = jax.make_jaxpr(jax.vmap(bad_tbl))(
+        jnp.zeros((3, 16)), jnp.zeros((3, 2, 2)))
+    assert rules.scatter_determinism(c2, batched=True)
+
+
 # ---- the real configs must pass -------------------------------------------
 
 

@@ -119,7 +119,12 @@ class TestProgramIdentity:
             return run_simulation(params, tr, st, qps, 512)
 
         closed_legacy = jax.make_jaxpr(legacy)(sim.state, sim.device_trace)
-        assert str(closed_none.jaxpr) == str(closed_legacy.jaxpr)
+        # canonical structural equality (analysis/identity.py) — the
+        # ONE definition of "same program" the CI lock gate also uses,
+        # replacing the old ad-hoc str(jaxpr) comparison
+        from graphite_tpu.analysis.identity import same_program
+
+        assert same_program(closed_none, closed_legacy)
         assert not any("telemetry" in p for p in paths)
         assert not rules.telemetry_off(closed_none, paths)
 
