@@ -342,6 +342,26 @@ scheme = lax
                 "mean_clock_spread_ps"),
         })
 
+    # Static cost-model trajectory (round 12): the audited gated-MSI
+    # program's per-iteration kernel/byte proxy and its per-phase/base
+    # split (analysis/cost.py — the SAME numbers BUDGETS.json gates), so
+    # BENCH_r*.json tracks the proxy on CPU where wall-clock is noisy.
+    # Skippable via BENCH_COST=0.
+    if os.environ.get("BENCH_COST", "1") != "0":
+        from graphite_tpu.analysis.audit import default_programs
+        from graphite_tpu.analysis.cost import cost_report
+
+        spec = default_programs(8, names=("gated-msi",))[0]
+        rep = cost_report(spec)
+        companions.update({
+            "cost_program": rep.program,
+            "kernels_per_iter": int(rep.kernels_per_iter),
+            "bytes_per_iter": int(rep.bytes_per_iter),
+            "phase_kernels_per_iter": {
+                p.name: int(p.eqns) for p in rep.phase_costs},
+            "base_kernels_per_iter": int(rep.base_kernels_per_iter),
+        })
+
     print(
         json.dumps(
             {

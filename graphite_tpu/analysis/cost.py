@@ -519,9 +519,23 @@ def ceilings(report: CostReport) -> "dict[str, int]":
             for m, v in report.metrics().items()}
 
 
+class BudgetRatchetError(ValueError):
+    """A ratcheted budget refresh tried to RAISE a ceiling.
+
+    `save_budgets(..., ratchet=True)` only lowers ceilings: a perf PR's
+    win is locked in, and a later refresh cannot silently absorb a
+    regression by re-baselining above the old ceiling.  Raising a
+    metric requires naming it explicitly (`allow_increase` /
+    `--allow-increase <metric>`), which makes the increase a reviewed
+    decision instead of a side effect.  The message lists every
+    offending (program, metric, old ceiling, new ceiling) tuple."""
+
+
 def save_budgets(reports: "list[CostReport]", path: "str | None" = None,
                  fingerprints: "dict[str, str] | None" = None,
-                 registry: "dict | None" = None) -> str:
+                 registry: "dict | None" = None, *,
+                 ratchet: bool = False,
+                 allow_increase: "tuple[str, ...]" = ()) -> str:
     """Write measured baselines + slack ceilings for `reports` (the
     --budget-update refresh; merges over an existing file so a subset
     run never drops the other programs' entries).  `fingerprints` maps
@@ -532,12 +546,18 @@ def save_budgets(reports: "list[CostReport]", path: "str | None" = None,
     the program's registered `budget_key` — the SAME key check_budget
     reads, so a refresh after a rename replaces the entry the gate
     resolves instead of orphaning a new-name copy next to the stale
-    old-key one."""
+    old-key one.
+
+    `ratchet=True` (round 12): the refresh may only LOWER ceilings.  A
+    metric whose new ceiling would exceed the existing entry's raises
+    `BudgetRatchetError` unless it is named in `allow_increase` — the
+    post-perf-PR refresh mode that locks wins in."""
     path = path or default_budgets_path()
     data = {}
     if os.path.exists(path):
         with open(path) as f:
             data = json.load(f)
+    offenders = []
     for rep in reports:
         entry = {
             "tiles": int(rep.tiles),
@@ -547,7 +567,25 @@ def save_budgets(reports: "list[CostReport]", path: "str | None" = None,
         if fingerprints and rep.program in fingerprints:
             entry["fingerprint"] = fingerprints[rep.program]
         rec = registry.get(rep.program) if registry else None
-        data[rec.budget_key if rec is not None else rep.program] = entry
+        key = rec.budget_key if rec is not None else rep.program
+        if ratchet and key in data:
+            old_ceil = data[key].get("ceiling", {})
+            for m, c in entry["ceiling"].items():
+                old = old_ceil.get(m)
+                if old is None or c <= int(old):
+                    continue
+                if m in allow_increase:
+                    continue
+                offenders.append((rep.program, m, int(old), int(c)))
+        data[key] = entry
+    if offenders:
+        rows = "; ".join(
+            f"{prog}.{m}: ceiling {old} -> {new}"
+            for prog, m, old, new in offenders)
+        raise BudgetRatchetError(
+            f"ratcheted refresh would RAISE {len(offenders)} ceiling(s): "
+            f"{rows} — pass --allow-increase <metric> for each metric "
+            f"whose increase is an intentional, reviewed decision")
     with open(path, "w") as f:
         json.dump(data, f, indent=1, sort_keys=True)
         f.write("\n")

@@ -230,10 +230,14 @@ def time_dtype(jaxpr, clock_invars, invar_paths=None) -> "list[Finding]":
 
 
 def phase_conds(jaxpr, n_tiles: int) -> list:
-    """(site, eqn) of every cond that writes a mailbox type matrix —
-    the memory engines' per-phase gating conds (each protocol phase
-    writes at least one uint8[.., T, T] matrix, and nothing else in the
-    program emits one as a cond output; see tests/test_phase_gating)."""
+    """(site, eqn) of every cond that writes a mailbox — the memory
+    engines' per-phase gating conds.  Each protocol phase writes either
+    a uint8[.., T, T] type matrix (fwd/ack/evict) or, since the round-12
+    request compaction, the per-REQUESTER lane signature: a uint8[.., T]
+    type vector TOGETHER with an int64[.., T] time vector (the shared-L2
+    requester phase's only mailbox write is the compacted request lane).
+    Nothing else in the mem_gate-off programs emits either shape set as
+    a cond output; see tests/test_phase_gating."""
     out = []
     for site, eqn in iter_eqns_with_site(jaxpr):
         if eqn.primitive.name == "cond" \
@@ -244,11 +248,30 @@ def phase_conds(jaxpr, n_tiles: int) -> list:
 
 def _mailbox_outputs(eqn, n_tiles: int) -> list:
     outs = []
+    lane_u8 = []
+    lane_i64 = False
+    progress = False
     for v in eqn.outvars:
         sig = aval_sig(v.aval)
-        if sig and len(sig[0]) >= 2 and sig[0][-2:] == (n_tiles, n_tiles) \
+        if not sig:
+            continue
+        if len(sig[0]) >= 2 and sig[0][-2:] == (n_tiles, n_tiles) \
                 and sig[1] == "uint8":
             outs.append(sig)
+        if sig == ((), "int32"):
+            # every phase cond returns its progress counter — the
+            # discriminator that keeps lane-signature matching from
+            # catching e.g. the record-fetch cond (uint8 ops + int64
+            # dyn costs, but no scalar progress output)
+            progress = True
+        if sig[0][-1:] == (n_tiles,) and (len(sig[0]) < 2
+                                          or sig[0][-2] != n_tiles):
+            if sig[1] == "uint8":
+                lane_u8.append(sig)
+            elif sig[1] == "int64":
+                lane_i64 = True
+    if lane_u8 and lane_i64 and progress:
+        outs.extend(lane_u8)
     return outs
 
 

@@ -352,6 +352,7 @@ class Simulator:
         mem_gate_bytes: int | None = None,
         barrier_batch: int | None = None,
         telemetry=None,
+        base_consolidate: bool | None = None,
     ):
         """`dir_stage`: force the directory write-staging path on/off
         (None = auto: on for single-device private-L2 runs whose sharers
@@ -460,8 +461,13 @@ class Simulator:
             # the coherence-storm floor — XLA lowers per-lane scatters on
             # the big sharers store as full-array dense passes, so big
             # directories stage writes and flush once per inner block
-            # (PERF.md round-5).  Auto-on when the sharers store alone
-            # is >= 64 MB; single-device private-L2 programs only.
+            # (PERF.md round-5).  Private-L2 protocols only.  Auto-on
+            # stays conservative: single-device programs whose sharers
+            # store alone is >= 64 MB.  Meshed runs stage on EXPLICIT
+            # dir_stage=True (round 12: the per-lane rows shard with the
+            # directory, but only under the consolidated base — the
+            # check below enforces that; auto-enabling under a mesh
+            # would surprise base_consolidate=False configurations).
             private_l2 = mem_params.protocol.startswith("pr_l1_pr_l2")
             sharers_bytes = (4 * n_tiles * mem_params.dir_sets
                              * mem_params.dir_ways
@@ -469,6 +475,14 @@ class Simulator:
             if dir_stage is None:
                 dir_stage = (private_l2 and mesh is None
                              and sharers_bytes >= 64 << 20)
+            # Round-12 base consolidation (one packed directory gather +
+            # one merged scatter per iteration; MemParams.base_consolidate).
+            # None = config `[general] base_consolidate` (default on);
+            # False restores the round-11 per-phase layout — the regress
+            # equivalence oracle.
+            if base_consolidate is not None:
+                mem_params = dataclasses.replace(
+                    mem_params, base_consolidate=bool(base_consolidate))
             if dir_stage:
                 if not private_l2:
                     # Not "pending work": the shared-L2 engines don't
@@ -485,16 +499,24 @@ class Simulator:
                         "per phase (no per-entry dense-pass storm to "
                         "stage away), so staging would add table scans "
                         "for nothing")
-                if mesh is not None:
+                if mesh is not None and not mem_params.base_consolidate:
+                    # the per-lane staging rows shard with the directory
+                    # (round 12), but only the consolidated working-set
+                    # gather overlays them block-locally before the
+                    # exchange — the legacy per-phase view never did
                     raise ValueError(
-                        "dir_stage supports single-device programs only "
-                        "(the staging table is not threaded through the "
-                        "shard_map exchange)")
+                        "dir_stage under a mesh needs the round-12 "
+                        "consolidated base (base_consolidate=True): the "
+                        "legacy per-phase directory view does not "
+                        "overlay the staging rows before the shard_map "
+                        "exchange")
                 wpi = (5 if mem_params.dir_type == "limited_no_broadcast"
                        else 3)
+                # per-LANE capacity (round-12 layout): each home stages
+                # at most writes_per_iter entries per iteration
                 mem_params = dataclasses.replace(
                     mem_params,
-                    dir_stage_cap=wpi * n_tiles * inner_block)
+                    dir_stage_cap=wpi * inner_block)
             # Per-phase activity gating (round 6): on by default for
             # every memory-engine program — the per-phase conds carry
             # only small state (see MemParams.phase_gate), so unlike the

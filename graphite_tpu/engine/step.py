@@ -42,7 +42,10 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
+
+from graphite_tpu.intmath import nn_mod
 
 from graphite_tpu.engine.state import SimState, DeviceTrace
 from graphite_tpu.models.network_user import UserNetworkParams, route_latency_ps
@@ -181,7 +184,7 @@ def subquantum_iteration(
     T = params.n_tiles
     D = params.mailbox_depth
     core, net, sync = state.core, state.net, state.sync
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     if trace_base is None:
         idx = jnp.minimum(core.idx, trace.length - 1)
         in_window = None
@@ -215,7 +218,7 @@ def subquantum_iteration(
 
     fetched_l = lax.cond(uniform, _read_uniform, _read_gather, None)
     # branch prediction reads ride the same exchange (bp_bits block-local)
-    bp_index_l = (fetched_l[2] % params.bp_size).astype(jnp.int32)
+    bp_index_l = nn_mod(fetched_l[2], params.bp_size).astype(jnp.int32)
     bp_pred_l = jnp.take_along_axis(
         core.bp_bits, bp_index_l[:, None], axis=1)[:, 0]
     agd = px.ag(fetched_l + (bp_pred_l,))
@@ -351,7 +354,7 @@ def subquantum_iteration(
     cost_table = jnp.asarray(params.static_cost_cycles, dtype=I64)
     static_cycles = cost_table[jnp.clip(op, 0, 19)]
 
-    bp_index = (pc % params.bp_size).astype(jnp.int32)  # bp_pred: fetch ag
+    bp_index = nn_mod(pc, params.bp_size).astype(jnp.int32)  # bp_pred: fetch ag
     taken = ((flags & FLAG_BRANCH_TAKEN) != 0).astype(jnp.uint8)
     bp_correct_now = bp_pred == taken
     if params.bp_enabled:
@@ -407,7 +410,7 @@ def subquantum_iteration(
             noc_user = state.noc_user
             lat_ps = route_latency_ps(params.net, tiles, dst, aux1, enabled)
             arrival_ps = core.clock_ps + lat_ps
-        slot = (net.head[dst, tiles] % D).astype(jnp.int32)
+        slot = nn_mod(net.head[dst, tiles], D).astype(jnp.int32)
         # Write under mask: redirect masked-off lanes to their own (t, t)
         # cell at a dummy slot; since each lane writes a distinct src
         # column, no collisions occur either way.  Updates are add-a-delta
@@ -437,7 +440,7 @@ def subquantum_iteration(
         is_any_recv = is_recv & (aux0 == ANY_SENDER)
 
         def _any_src(_):
-            tail = ((head_new - count_sent) % D).astype(jnp.int32)  # [T, T]
+            tail = nn_mod(head_new - count_sent, D).astype(jnp.int32)  # [T, T]
             tail_times = jnp.take_along_axis(
                 time_ps_new, tail[:, None, :], axis=1)[:, 0, :]
             masked_times = jnp.where(
@@ -449,8 +452,8 @@ def subquantum_iteration(
             _any_src, lambda _: jnp.zeros((T,), jnp.int32), None)
         want_src = jnp.where(is_any_recv, any_src, jnp.clip(aux0, 0, T - 1))
         sel_count = count_sent[tiles, want_src]
-        sel_tail = ((head_new[tiles, want_src] - sel_count) % D).astype(
-            jnp.int32)
+        sel_tail = nn_mod(head_new[tiles, want_src] - sel_count,
+                          D).astype(jnp.int32)
         matched = sel_count > 0
         recv_time = jnp.where(
             matched, time_ps_new[tiles, sel_tail, want_src], FAR_FUTURE_PS)
@@ -517,7 +520,7 @@ def subquantum_iteration(
         from graphite_tpu.engine.state import GEN_RING
 
         barrier_gen = sync.barrier_gen + release_bar.astype(jnp.int32)
-        slot = (barrier_gen % GEN_RING).astype(jnp.int32)
+        slot = nn_mod(barrier_gen, GEN_RING).astype(jnp.int32)
         n_bars_r = jnp.arange(n_bars, dtype=jnp.int32)
         cur_slot = sync.barrier_release_ps[n_bars_r, slot]
         barrier_release = sync.barrier_release_ps.at[n_bars_r, slot].set(
@@ -777,7 +780,7 @@ def subquantum_iteration(
         # before a later-positioned init on the creator's lane)
         seq = sync.cond_sig_seq.at[jnp.where(pub_now, cid, 0)].add(
             jnp.where(pub_now, 1, 0))
-        slot = (seq[cid] % GEN_RING).astype(jnp.int32)
+        slot = nn_mod(seq[cid], GEN_RING).astype(jnp.int32)
         seq_ps = sync.cond_sig_seq_ps.at[
             jnp.where(pub_now, cid, 0),
             jnp.where(pub_now, slot, 0)].max(
@@ -967,7 +970,7 @@ def subquantum_iteration(
         # (the follow-on gather regresses superlinearly above it)
         KX = min(params.plain_unroll - 1, PLAIN_UNROLL_MAX - 1,
                  trace.length - 1)
-        offs = jnp.arange(1, KX + 1, dtype=jnp.int32)
+        offs = np.arange(1, KX + 1, dtype=np.int32)
         pos_l = jnp.minimum(idx_l[:, None] + offs[None, :],
                             trace.length - 1)
         # lockstep fast path (same trick as the record fetch): one
@@ -1126,14 +1129,24 @@ def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT,
     Returns (state, total_progress, n_iterations)."""
 
     def block(state, progress):
-        def body(carry, _):
-            st, prog = carry
+        # Bounded while_loop, NOT a lax.scan: both lower to the same HLO
+        # While with a static trip count, but a scan's body is multiplied
+        # by `length` in the static cost model's dense-iteration view
+        # (analysis/cost.py) — the budgeted kernels_per_iter then priced
+        # a 32-iteration BLOCK, not the protocol iteration it is named
+        # for.  The while form makes the per-iteration base the unit the
+        # budget ratchet tracks.  Trip count, flush cadence, and every
+        # carried value are identical to the scan, so the swap is
+        # bit-exact (regress rung + golden interpreters pin it).
+        def body(carry):
+            st, prog, i = carry
             st, adv = subquantum_iteration(params, trace, st, qend,
                                            trace_base, px=px, knobs=knobs)
-            return (st, prog + adv), None
+            return st, prog + adv, i + 1
 
-        (state, progress), _ = lax.scan(
-            body, (state, progress), None, length=params.inner_block,
+        state, progress, _ = lax.while_loop(
+            lambda c: c[2] < params.inner_block, body,
+            (state, progress, jnp.asarray(0, jnp.int32)),
         )
         if (params.mem is not None
                 and getattr(params.mem, "dir_stage_cap", 0)):

@@ -31,7 +31,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
+
+from graphite_tpu.intmath import nn_mod
 
 # CacheState (`common/tile/memory_subsystem/cache_state.h`).
 INVALID = 0
@@ -120,13 +123,19 @@ class CacheRow:
 
 
 def gather_row(cache: CacheArrays, line: jax.Array,
-               sets_mod=None) -> CacheRow:
+               sets_mod=None, *, nonneg: bool = False) -> CacheRow:
     """`sets_mod`: per-tile set count (int or int32[T]) for heterogeneous
-    geometries; defaults to the array's (max) set dimension."""
+    geometries; defaults to the array's (max) set dimension.
+
+    `nonneg=True`: the caller guarantees `line >= 0` (record-derived and
+    mailbox-carried lines), so the set index uses the one-equation
+    `intmath.nn_mod` instead of the floor-mod fixup chain — bit-identical
+    there.  Victim lines read off an invalid way can be -1 and must keep
+    the default."""
     T = cache.meta.shape[0]
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     mod = cache.num_sets if sets_mod is None else jnp.asarray(sets_mod)
-    sets = (line % mod).astype(jnp.int32)
+    sets = (nn_mod(line, mod) if nonneg else line % mod).astype(jnp.int32)
     meta = cache.meta[tiles, sets]                 # [T, W] — ONE gather
     tag, st, lru = _unpack(meta)
     return CacheRow(tag=tag, st=st.astype(jnp.int32), lru=lru, sets=sets,
@@ -151,7 +160,7 @@ def scatter_row(cache: CacheArrays, row: CacheRow) -> CacheArrays:
     the scatter is then the meta array's only remaining use and XLA
     updates the loop-carried buffer in place instead of copying it."""
     T = cache.meta.shape[0]
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     new_meta = _pack(row.tag, row.st, row.lru)
     return cache.replace(meta=cache.meta.at[tiles, row.sets].add(
         new_meta - row.meta0, unique_indices=True, indices_are_sorted=True))
@@ -172,14 +181,14 @@ def row_touch(row: CacheRow, way: jax.Array, mask: jax.Array) -> CacheRow:
     """Make `way` the MRU of its row where mask (ranks below it shift up)."""
     rank = jnp.take_along_axis(row.lru, way[:, None], axis=1)
     bumped = row.lru + (row.lru < rank).astype(jnp.int32)
-    onehot = jnp.arange(row.lru.shape[1])[None, :] == way[:, None]
+    onehot = np.arange(row.lru.shape[1])[None, :] == way[:, None]
     new_lru = jnp.where(onehot, 0, bumped)
     return row.replace(lru=jnp.where(mask[:, None], new_lru, row.lru))
 
 
 def row_set_state(row: CacheRow, way: jax.Array, new_state,
                   mask: jax.Array) -> CacheRow:
-    onehot = jnp.arange(row.st.shape[1])[None, :] == way[:, None]
+    onehot = np.arange(row.st.shape[1])[None, :] == way[:, None]
     sel = onehot & mask[:, None]
     return row.replace(st=jnp.where(
         sel, jnp.broadcast_to(jnp.asarray(new_state, jnp.int32)[..., None],
@@ -208,7 +217,7 @@ def row_pick_victim(row: CacheRow, policy: str = "lru", ways=None):
     move them)."""
     usable = None
     if ways is not None:
-        usable = (jnp.arange(row.lru.shape[1], dtype=jnp.int32)[None, :]
+        usable = (np.arange(row.lru.shape[1], dtype=np.int32)[None, :]
                   < jnp.asarray(ways)[:, None])
     lru_eff = row.lru if usable is None else jnp.where(usable, row.lru, -1)
     lru_way = jnp.argmax(lru_eff, axis=1)
@@ -234,7 +243,7 @@ def row_pick_victim(row: CacheRow, policy: str = "lru", ways=None):
 def row_insert(row: CacheRow, line: jax.Array, way: jax.Array, new_state,
                mask: jax.Array) -> CacheRow:
     """Install `line` at `way` with `new_state` where mask, making it MRU."""
-    onehot = jnp.arange(row.tag.shape[1])[None, :] == way[:, None]
+    onehot = np.arange(row.tag.shape[1])[None, :] == way[:, None]
     sel = onehot & mask[:, None]
     out = row.replace(
         tag=jnp.where(sel, line[:, None], row.tag),

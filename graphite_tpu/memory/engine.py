@@ -52,7 +52,10 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
+
+from graphite_tpu.intmath import nn_div, nn_mod
 
 from graphite_tpu.memory import cache_array as ca
 from graphite_tpu.memory.cache_array import (
@@ -87,13 +90,15 @@ FAR = 2**62  # python int: folds to an inline literal, never a device-constant b
 
 
 def _bit_word(idx):
-    return (idx // 32).astype(jnp.int32), (idx % 32).astype(jnp.uint32)
+    # idx is a tile id (>= 0 at every call site): truncating div/rem
+    return (nn_div(idx, 32).astype(jnp.int32),
+            nn_mod(idx, 32).astype(jnp.uint32))
 
 
 def set_bit(words: jax.Array, idx: jax.Array, mask: jax.Array) -> jax.Array:
     """words[t, idx[t]//64] |= 1 << idx%64 where mask; words is [T, SW]."""
     T = words.shape[0]
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     w, b = _bit_word(idx)
     cur = words[tiles, w]
     new = cur | (jnp.uint32(1) << b)
@@ -102,7 +107,7 @@ def set_bit(words: jax.Array, idx: jax.Array, mask: jax.Array) -> jax.Array:
 
 def clear_bit(words: jax.Array, idx: jax.Array, mask: jax.Array) -> jax.Array:
     T = words.shape[0]
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     w, b = _bit_word(idx)
     cur = words[tiles, w]
     new = cur & ~(jnp.uint32(1) << b)
@@ -111,7 +116,7 @@ def clear_bit(words: jax.Array, idx: jax.Array, mask: jax.Array) -> jax.Array:
 
 def test_bit(words: jax.Array, idx: jax.Array) -> jax.Array:
     T = words.shape[0]
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     w, b = _bit_word(idx)
     return ((words[tiles, w] >> b) & jnp.uint32(1)) != 0
 
@@ -129,7 +134,7 @@ def lowest_sharer(words: jax.Array) -> jax.Array:
     nonzero = words != 0
     w_idx = jnp.argmax(nonzero, axis=1).astype(jnp.int32)
     any_bit = nonzero.any(axis=1)
-    tiles = jnp.arange(words.shape[0], dtype=jnp.int32)
+    tiles = np.arange(words.shape[0], dtype=np.int32)
     w = words[tiles, w_idx]
     low = w & (~w + jnp.uint32(1))
     bit = jax.lax.population_count(low - jnp.uint32(1)).astype(jnp.int32)
@@ -164,9 +169,9 @@ def _dir_set_field(word, val, shift, mask):
 
 def unpack_sharers(words: jax.Array, n: int) -> jax.Array:
     """[T, SW] uint32 → bool[T, n] (bit s of row t)."""
-    s = jnp.arange(n)
-    w = (s // 32).astype(jnp.int32)
-    b = (s % 32).astype(jnp.uint32)
+    s = np.arange(n)
+    w = (s // 32).astype(np.int32)
+    b = (s % 32).astype(np.uint32)
     return ((words[:, w] >> b[None, :]) & jnp.uint32(1)) != 0
 
 
@@ -179,12 +184,44 @@ def _row_earliest(cell_type: jax.Array, cell_time: jax.Array):
     C = cell_type.shape[1]
     key = jnp.where(
         cell_type != MSG_NONE,
-        cell_time * C + jnp.arange(C, dtype=I64)[None, :],
+        cell_time * C + np.arange(C, dtype=np.int64)[None, :],
         FAR,
     )
     col = jnp.argmin(key, axis=1).astype(jnp.int32)
     found = jnp.take_along_axis(key, col[:, None].astype(jnp.int64), axis=1)[:, 0] < FAR
     return col, found
+
+
+def _req_earliest(mail):
+    """Earliest pending request per HOME over the per-requester lanes:
+    (requester int32[T], found bool[T]).
+
+    The compact form of the old [T, T] row scan: key = time * T +
+    requester, segment-min'd into home buckets — the SAME deterministic
+    total order `_row_earliest` used on the matrix, so the pop order is
+    bit-identical to the round-11 layout."""
+    T = mail.req_type.shape[0]
+    r = np.arange(T, dtype=np.int64)
+    live = mail.req_type != MSG_NONE
+    key = jnp.where(live, mail.req_time * T + r, FAR)
+    best = (
+        jnp.full((T + 1,), FAR, I64)
+        .at[jnp.where(live, mail.req_home, T)]
+        .min(key)
+    )[:T]
+    found = best < FAR
+    col = jnp.where(found, nn_mod(best, T), 0).astype(jnp.int32)
+    return col, found
+
+
+def _req_consume(mail, use_pop, r_col):
+    """Clear the popped requester lanes (each home pops at most one)."""
+    T = mail.req_type.shape[0]
+    r = np.arange(T, dtype=np.int32)
+    live = mail.req_type != MSG_NONE
+    popped = live & use_pop[mail.req_home] & (r_col[mail.req_home] == r)
+    return mail.replace(req_type=jnp.where(popped, MSG_NONE,
+                                           mail.req_type))
 
 
 def mem_net_latency_ps(mp: MemParams, src, dst, bits: int, enabled):
@@ -201,7 +238,8 @@ def mem_net_latency_ps(mp: MemParams, src, dst, bits: int, enabled):
 
         return atac_zeroload_ps(mp.net_atac, src, dst, bits, enabled)
     w = mp.mesh_width
-    hops = jnp.abs(src % w - dst % w) + jnp.abs(src // w - dst // w)
+    hops = (jnp.abs(nn_mod(src, w) - nn_mod(dst, w))
+            + jnp.abs(nn_div(src, w) - nn_div(dst, w)))
     flits = (bits + mp.flit_width_bits - 1) // mp.flit_width_bits
     cycles = hops.astype(I64) * mp.hop_latency_cycles + jnp.where(
         src == dst, 0, flits
@@ -261,8 +299,8 @@ def mem_net_fanout(mp: MemParams, noc, send_hs, bits: int, t0_ps, enabled):
        are empty, so serialized workloads remain exact).
     """
     T = mp.n_tiles
-    src = jnp.arange(T, dtype=jnp.int32)[:, None]
-    dst = jnp.arange(T, dtype=jnp.int32)[None, :]
+    src = np.arange(T, dtype=np.int32)[:, None]
+    dst = np.arange(T, dtype=np.int32)[None, :]
     if mp.net_atac is not None:
         # ATAC multicast (`network_model_atac.cc:372-500` broadcast over
         # the waveguide): the home's SEND HUB serializes its ONet copies
@@ -287,7 +325,7 @@ def mem_net_fanout(mp: MemParams, noc, send_hs, bits: int, t0_ps, enabled):
         t0_cyc = ps_to_cycles(t0_ps, p.freq_mhz)
         if p.contention_enabled:
             go = fan & (k_onet > 0) & jnp.asarray(enabled, bool)
-            home = jnp.arange(T, dtype=jnp.int32)
+            home = np.arange(T, dtype=np.int32)
             qid = jnp.where(go, _cluster_of(p, home),
                             2 * p.n_clusters).astype(jnp.int32)
             queues, hub_delay = qm.scatter_queue_delay(
@@ -313,8 +351,8 @@ def mem_net_fanout(mp: MemParams, noc, send_hs, bits: int, t0_ps, enabled):
     p = mp.net_hbh
     w = p.mesh_width
     flits = max(1, (bits + p.flit_width_bits - 1) // p.flit_width_bits)
-    hops = (jnp.abs(src % w - dst % w)
-            + jnp.abs(src // w - dst // w)).astype(I64)
+    hops = (jnp.abs(nn_mod(src, w) - nn_mod(dst, w))
+            + jnp.abs(nn_div(src, w) - nn_div(dst, w))).astype(I64)
     step = p.router_delay + p.link_delay
     zl = p.router_delay + (hops + 1) * step + jnp.where(
         src == dst, 0, flits)
@@ -322,7 +360,7 @@ def mem_net_fanout(mp: MemParams, noc, send_hs, bits: int, t0_ps, enabled):
     k = send_hs.sum(axis=1, dtype=I64)
     t0_cyc = ps_to_cycles(t0_ps, p.freq_mhz)
     if p.contention_enabled:
-        qid = (jnp.arange(T, dtype=jnp.int32) * NUM_PORTS + PORT_INJECT)
+        qid = (np.arange(T, dtype=np.int32) * NUM_PORTS + PORT_INJECT)
         queues, inj_delay = qm.scatter_queue_delay(
             p.queue, noc.queues, qid, t0_cyc, k * flits,
             fan & jnp.asarray(enabled, bool))
@@ -359,7 +397,7 @@ def _util_classify(counters, util_val, mask, enabled):
     total = (rd + wr).astype(jnp.int32)
     bucket = jnp.minimum(7, 32 - jax.lax.clz(total)).astype(jnp.int32)
     m = mask & jnp.asarray(enabled, bool)
-    tiles = jnp.arange(util_val.shape[0], dtype=jnp.int32)
+    tiles = np.arange(util_val.shape[0], dtype=np.int32)
     return counters.replace(
         line_util_hist=counters.line_util_hist.at[tiles, bucket].add(
             m.astype(I64), unique_indices=True),
@@ -371,18 +409,18 @@ def _util_row_local(l2_util, line_l, sets_mod_l):
     """This device's [Tl, W2] util row at each local lane's L2 set (the
     cross-device exchange happens via _rows_exchange at the call sites)."""
     Tl = l2_util.shape[0]
-    lt = jnp.arange(Tl, dtype=jnp.int32)
-    sets_l = (line_l % jnp.asarray(sets_mod_l)).astype(jnp.int32)
+    lt = np.arange(Tl, dtype=np.int32)
+    sets_l = nn_mod(line_l, jnp.asarray(sets_mod_l)).astype(jnp.int32)
     return l2_util[lt, sets_l]
 
 
 def _util_scatter(px: ParallelCtx, l2_util, line, sets_mod, way, cur, new):
     """Apply per-lane packed-counter updates block-locally (add-a-delta,
     unique rows)."""
-    sets = (line % jnp.asarray(sets_mod)).astype(jnp.int32)
+    sets = nn_mod(line, jnp.asarray(sets_mod)).astype(jnp.int32)
     sets_l, way_l, cur_l, new_l = px.lo((sets, way, cur, new))
     Tl = l2_util.shape[0]
-    lt = jnp.arange(Tl, dtype=jnp.int32)
+    lt = np.arange(Tl, dtype=np.int32)
     return l2_util.at[lt, sets_l, way_l].add(
         new_l - cur_l, unique_indices=True, indices_are_sorted=True)
 
@@ -465,21 +503,21 @@ class _DirSetView:
     """
 
     def __init__(self, px: ParallelCtx, d: "DirectoryArrays", line, mp):
-        self.sets = (line % mp.dir_sets).astype(jnp.int32)
+        self.sets = nn_mod(line, mp.dir_sets).astype(jnp.int32)
         self._line = line
         self._sharded = px.sharded
         self._dw = d.entry.shape[2]
         if px.sharded:
             line_l = px.lo(line)
             Tl = d.entry.shape[0]
-            lt = jnp.arange(Tl, dtype=jnp.int32)
-            sets_l = (line_l % mp.dir_sets).astype(jnp.int32)
+            lt = np.arange(Tl, dtype=np.int32)
+            sets_l = nn_mod(line_l, mp.dir_sets).astype(jnp.int32)
             self._word_r, self._sharers_r = px.ag((
                 d.entry[lt, sets_l], d.sharers[lt, sets_l]))
         else:
             self._d = d
             T = d.entry.shape[0]
-            self._tiles = jnp.arange(T, dtype=jnp.int32)
+            self._tiles = np.arange(T, dtype=np.int32)
             self._word_r = None
             self._sharers_r = None
 
@@ -563,7 +601,7 @@ def slots_present(mp: MemParams, rec: "RecView", enabled) -> jax.Array:
 
 def next_present_slot(present: jax.Array, slot: jax.Array) -> jax.Array:
     """First present slot index >= slot, else 3."""
-    k = jnp.arange(3)[None, :]
+    k = np.arange(3)[None, :]
     cand = jnp.where(present & (k >= slot[:, None]), k, 3)
     return cand.min(axis=1).astype(jnp.int32)
 
@@ -637,97 +675,143 @@ def mem_idle_out(mp: MemParams, ms, rec: "RecView", enabled) -> MemStepOut:
 # FULL-ARRAY dense pass (measured ~8 ms each at 1024 tiles, three per
 # iteration — the coherence-storm floor, PERF.md round-4 findings; the
 # same writes on the small [T, DS, DW] entry arrays cost little and stay
-# direct).  Staged mode: writes land in the small unique-key
-# (skey, sval) table (`_stage_put`); the engine's only sharers reads —
-# `_DirSetView.entry()` — overlay it; `dir_stage_flush` applies the
-# table to the big store once per inner_block iterations
-# (engine/step._quantum_loop), one amortized dense pass instead of
-# 3*inner_block.  Capacity = writes_per_iter * T * inner_block makes
-# mid-block overflow impossible.  Reference hot path this lifts:
+# direct).  Staged mode: writes land in the small per-LANE (skey, sval)
+# rows (`_stage_put`); the engine's sharers reads overlay them
+# (`_stage_overlay_rows`); `dir_stage_flush` applies the rows to the big
+# store once per inner_block iterations (engine/step._quantum_loop), one
+# amortized dense pass instead of 3*inner_block.
+#
+# Round-12 layout: the table is [T, c] per home lane (c = writes_per_
+# iter * inner_block), not one global [C = wpi * T * inner_block] list.
+# Every directory write is home-lane-local, so a put is a single
+# append-at-cursor scatter — the old layout's [T, C] unique-key dedup
+# scan (trip product T * wpi * T * inner_block at 1024 tiles) is gone,
+# and every staging operation's cost now scales with the per-lane
+# staged-entry count.  Keys may repeat within a lane row; reads take
+# the LATEST slot and the flush applies only each key's last slot, so
+# the big-store values are bit-identical to the unique-key layout.
+# Lane-locality also makes the table block-local under shard_map (each
+# device stages its own home rows), which is what lets big sharded
+# directories stage at all — the standing "dir_stage is single-device"
+# restriction fell with it.  Reference hot path this lifts:
 # `dram_directory_cntlr.cc:44-559` per-message directory updates.
 
 
-def _stage_key(d, sets, way):
-    T, DS, DW = d.entry.shape
-    tiles = jnp.arange(T, dtype=jnp.int32)
-    return (tiles * DS + sets) * DW + way
+def _stage_key(d, sets, way, dw=None):
+    """Within-lane staging key of a (set, way) entry.  `dw` overrides
+    the way count when the entry store is detached from the caller's
+    cond (the consolidated home phases)."""
+    DW = d.entry.shape[2] if dw is None else dw
+    return sets * DW + way
 
 
-def _stage_put(d, sets, way, mask, new_sh):
-    """Stage a masked per-lane sharers write.  Overwrites the entry's
-    existing slot if staged (unique-key invariant), else appends at the
-    next free slots (rank-compacted, so capacity tracks real writes).
+def _stage_put(d, sets, way, mask, new_sh, dw=None):
+    """Append a masked per-lane sharers write at each lane's cursor.
 
-    The whole put sits under a lax.cond on "any lane writes": compute
-    stretches then skip the [T, C] dedup scan and the table scatters.
-    Unlike the big-store conds this one is safe — the carried staging
-    table is a few MB, so the cond's double-buffering is noise."""
-    C = d.skey.shape[0]
-
-    def do(_):
-        key = _stage_key(d, sets, way)
-        m = d.skey[None, :] == key[:, None]        # [T, C]
-        found = m.any(axis=1)
-        c_found = jnp.argmax(m, axis=1).astype(jnp.int32)
-        app = mask & ~found
-        rank = jnp.cumsum(app.astype(jnp.int32)) - 1
-        # masked-off lanes target slot C: out of bounds, dropped.  In-
-        # bounds positions are unique (unique keys; distinct ranks).
-        pos = jnp.where(mask, jnp.where(found, c_found, d.sn + rank), C)
-        return (d.skey.at[pos].set(key, mode="drop", unique_indices=True),
-                d.sval.at[pos].set(new_sh, mode="drop",
-                                   unique_indices=True),
-                d.sn + jnp.sum(app, dtype=jnp.int32))
-
-    def skip(_):
-        return d.skey, d.sval, d.sn
-
-    skey, sval, sn = jax.lax.cond(jnp.any(mask), do, skip, None)
-    return d.replace(skey=skey, sval=sval, sn=sn)
+    ONE out-of-bounds-dropping scatter per table array — no dedup scan,
+    no cond.  Masked-off lanes target slot c (dropped); capacity
+    c = writes_per_iter * inner_block makes mid-block overflow
+    impossible, so in-bounds appends never collide."""
+    C = d.skey.shape[1]
+    T = d.skey.shape[0]
+    tiles = np.arange(T, dtype=np.int32)
+    key = _stage_key(d, sets, way, dw)
+    pos = jnp.where(mask, d.sn, C)
+    return d.replace(
+        skey=d.skey.at[tiles, pos].set(key, mode="drop",
+                                       unique_indices=True),
+        sval=d.sval.at[tiles, pos].set(new_sh, mode="drop",
+                                       unique_indices=True),
+        sn=d.sn + mask.astype(jnp.int32))
 
 
 def _stage_overlay(d, sets, way, sharers):
-    """The staged value of each lane's (set, way) entry, if any, else the
-    given big-store value ([T, SW])."""
+    """The latest staged value of each lane's (set, way) entry, if any,
+    else the given big-store value ([*, SW]).  Scans only the lane's own
+    [c] staging row."""
+    C = d.skey.shape[1]
     key = _stage_key(d, sets, way)
-    m = d.skey[None, :] == key[:, None]            # [T, C]
-    found = m.any(axis=1)
-    c = jnp.argmax(m, axis=1)
-    return jnp.where(found[:, None], d.sval[c], sharers)
+    m = (d.skey >= 0) & (d.skey == key[:, None])   # [T, c]
+    rank = np.arange(1, C + 1, dtype=np.int32)
+    best = jnp.max(jnp.where(m, rank, 0), axis=1)  # latest slot + 1
+    found = best > 0
+    c = jnp.where(found, best - 1, 0)
+    T = d.skey.shape[0]
+    return jnp.where(found[:, None],
+                     d.sval[np.arange(T, dtype=np.int32), c], sharers)
+
+
+def _stage_overlay_rows(d, sets, rows):
+    """Overlay each lane's staged writes onto gathered sharers SET rows.
+
+    `sets` int32[T, K] (the gathered rows' set indices), `rows`
+    uint32[T, K, DW*SW].  For every way of every gathered row the
+    LATEST staged slot matching (lane, set, way) wins — append order is
+    program order, so this reproduces the old unique-key overwrite
+    semantics exactly.  Cost scales with the per-lane capacity c."""
+    if d.skey is None:
+        return rows
+    T, C = d.skey.shape
+    SW = d.sval.shape[2]
+    K = sets.shape[1]
+    DW = rows.shape[2] // SW
+    valid = d.skey >= 0                                       # [T, c]
+    key = jnp.where(valid, d.skey, 0)
+    s_of = nn_div(key, DW)
+    w_of = nn_mod(key, DW)
+    m = valid[:, None, :] & (s_of[:, None, :] == sets[:, :, None])
+    mw = m[:, :, None, :] & (
+        w_of[:, None, None, :]
+        == np.arange(DW, dtype=np.int32)[None, None, :, None])
+    rank = np.arange(1, C + 1, dtype=np.int32)
+    best = jnp.max(jnp.where(mw, rank, 0), axis=3)            # [T, K, DW]
+    has = best > 0
+    idx = jnp.where(has, best - 1, 0)
+    vals = d.sval[np.arange(T, dtype=np.int32)[:, None, None], idx]
+    rows3 = rows.reshape(T, K, DW, SW)
+    out = jnp.where(has[..., None], vals, rows3)
+    return out.reshape(T, K, DW * SW)
 
 
 def dir_stage_flush(d):
-    """Apply the staging table to the big sharers store and reset it.
+    """Apply the staging rows to the big sharers store and reset them.
 
-    ROW-form add-a-delta: gather each staged entry's whole [DW*SW] set
+    ROW-form add-a-delta: gather each staged slot's whole [DW*SW] set
     row (structured [t, s] row indexing — the fast TPU gather path; the
     3D element-index form measured 90 ms/flush, PERF.md round-5), expand
-    the entry's delta into its way's slot, and scatter-add rows back.
-    Two staged entries in the same set touch disjoint way columns, so
-    duplicate (t, s) row adds stay exact; empty slots add zero out of
-    bounds (dropped).  The add aliases the loop-carried buffer in
-    place."""
+    the slot's delta into its way's column, and scatter-add rows back.
+    Only each key's LAST slot within its lane row applies (later slots
+    overwrite earlier ones, the append-order analog of the old layout's
+    in-place overwrite); two applied slots in the same set touch
+    disjoint way columns, so duplicate (t, s) row adds stay exact; empty
+    and superseded slots add zero out of bounds (dropped).  The add
+    aliases the loop-carried buffer in place."""
     if d.skey is None:
         return d
     T, DS, DW = d.entry.shape
-    SW = d.sval.shape[1]
-    C = d.skey.shape[0]
-    valid = d.skey >= 0
+    SW = d.sval.shape[2]
+    C = d.skey.shape[1]
+    tiles = np.arange(T, dtype=np.int32)[:, None]
+    valid = d.skey >= 0                                       # [T, c]
     key = jnp.where(valid, d.skey, 0)
-    w = key % DW
-    s = (key // DW) % DS
-    t = key // (DS * DW)
-    row = d.sharers[t, s]                          # [C, DW*SW]
-    row3 = row.reshape(C, DW, SW)
-    cur = jnp.take_along_axis(row3, w[:, None, None], axis=1)[:, 0]
-    delta = jnp.where(valid[:, None], d.sval - cur, jnp.uint32(0))
-    onehot = (jnp.arange(DW, dtype=jnp.int32)[None, :, None]
-              == w[:, None, None])
-    row_delta = jnp.where(onehot, delta[:, None, :],
-                          jnp.uint32(0)).reshape(C, DW * SW)
-    t_oob = jnp.where(valid, t, T)                 # dropped when invalid
+    w = nn_mod(key, DW)
+    s = nn_div(key, DW)
+    # a slot applies iff no LATER slot in its lane row stages the same key
+    later = (valid[:, :, None] & valid[:, None, :]
+             & (key[:, :, None] == key[:, None, :])
+             & (np.arange(C)[None, None, :] > np.arange(C)[None, :, None]))
+    is_last = valid & ~later.any(axis=2)
+    row = d.sharers[tiles, s]                                 # [T, c, DW*SW]
+    row3 = row.reshape(T, C, DW, SW)
+    cur = jnp.take_along_axis(row3, w[:, :, None, None], axis=2)[:, :, 0]
+    delta = jnp.where(is_last[..., None], d.sval - cur, jnp.uint32(0))
+    onehot = (np.arange(DW, dtype=np.int32)[None, None, :, None]
+              == w[:, :, None, None])
+    row_delta = jnp.where(onehot, delta[:, :, None, :],
+                          jnp.uint32(0)).reshape(T, C, DW * SW)
+    s_oob = jnp.where(is_last, s, DS)              # dropped when superseded
     return d.replace(
-        sharers=d.sharers.at[t_oob, s].add(row_delta, mode="drop"),
+        sharers=d.sharers.at[tiles, s_oob].add(row_delta, mode="drop"),
         skey=jnp.full_like(d.skey, -1),
         sn=jnp.zeros_like(d.sn))
 
@@ -755,7 +839,13 @@ class _DirAcc:
        is exact.
     """
 
-    def __init__(self):
+    def __init__(self, consolidated: bool = False):
+        # consolidated (round 12): deltas stay replicated full-width and
+        # the sharers row delta is recorded in EVERY mode (staged too —
+        # later phases' views forward it); `pack_c` is the plan shape
+        # and `_dir_apply_merged` lands all three phases' plans in one
+        # scatter per store at the end of the iteration.
+        self.consolidated = consolidated
         self._ref = None
         self.sets = None
         self.way = None
@@ -811,6 +901,27 @@ class _DirAcc:
             return base
         return base + (jnp.zeros((Tl, d.sharers.shape[2]), U32),)
 
+    def pack_c(self, d, n_tiles: int):
+        """The consolidated plan: (sets, way, entry_delta, sharers_row
+        _delta) — replicated full-width [T(, DW*SW)], zeros when the
+        phase made no writes of that kind."""
+        sets = (self.sets if self.sets is not None
+                else jnp.zeros(n_tiles, jnp.int32))
+        way = (self.way if self.way is not None
+               else jnp.zeros(n_tiles, jnp.int32))
+        ed = (self.entry_delta if self.entry_delta is not None
+              else jnp.zeros(n_tiles, I64))
+        shd = (self.sharers_delta if self.sharers_delta is not None
+               else jnp.zeros((n_tiles, d.sharers.shape[2]), U32))
+        return (sets, way, ed, shd)
+
+    @staticmethod
+    def zero_pack_c(d, n_tiles: int):
+        return (jnp.zeros(n_tiles, jnp.int32),
+                jnp.zeros(n_tiles, jnp.int32),
+                jnp.zeros(n_tiles, I64),
+                jnp.zeros((n_tiles, d.sharers.shape[2]), U32))
+
 
 def _dir_apply(d, pack):
     """Scatter a gated home phase's deferred delta plan into the big
@@ -820,13 +931,204 @@ def _dir_apply(d, pack):
     place."""
     sets, way, entry_delta = pack[:3]
     T = d.entry.shape[0]
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     d = d.replace(entry=d.entry.at[tiles, sets, way].add(
         entry_delta, unique_indices=True, indices_are_sorted=True))
     if len(pack) > 3:
         d = d.replace(sharers=d.sharers.at[tiles, sets].add(
             pack[3], unique_indices=True, indices_are_sorted=True))
     return d
+
+
+class _DirRowView:
+    """A `_DirSetView`-compatible view over ONE pre-gathered (and
+    delta-forwarded) directory set row per home lane — what the round-12
+    consolidated home phases read instead of re-gathering the big
+    stores.  Staged writes were already overlaid at gather time
+    (`_stage_overlay_rows`), and earlier phases' pending deltas were
+    forwarded in (`_DirWorkingSet.view`), so `entry()` is pure register
+    math."""
+
+    def __init__(self, line, sets, entry_row, sharers_row, dw):
+        self.sets = sets
+        self._line = line
+        self._word = entry_row      # int64[T, DW]
+        self._sh = sharers_row      # uint32[T, DW*SW]
+        self._dw = dw
+
+    def rows(self):
+        return dir_tag(self._word), dir_nsh(self._word)
+
+    def lookup(self):
+        tag_row = dir_tag(self._word)
+        way_hits = tag_row == self._line[:, None]
+        found = way_hits.any(axis=1)
+        way = jnp.argmax(way_hits, axis=1).astype(jnp.int32)
+        return found, way
+
+    def word_at(self, way):
+        return jnp.take_along_axis(self._word, way[:, None], axis=1)[:, 0]
+
+    def sharers_row3(self):
+        return self._sh.reshape(self._sh.shape[0], self._dw, -1)
+
+    def entry(self, way):
+        sharers = jnp.take_along_axis(
+            self.sharers_row3(), way[:, None, None], axis=1)[:, 0]
+        word = self.word_at(way)
+        return (dir_tag(word), dir_state(word), dir_owner(word),
+                sharers, dir_nsh(word))
+
+
+class _DirWorkingSet:
+    """The iteration's packed directory working set (round 12).
+
+    After the requester phase, every set the three home phases can
+    touch is known: the earliest EVICT cell's line, the earliest
+    REQUEST lane's line (or the saved post-NULLIFY original), and the
+    transaction line.  A transaction STARTED this iteration carries the
+    effective request line, whose set equals the request row's set
+    (directory tags are congruent to their set mod DS by construction),
+    so THREE set rows cover phase 5 too — `view_finish` selects by set
+    equality, where any ambiguity is harmless because equal sets mean
+    identical row content.
+
+    ONE packed [T, 3, DW] entry-row + [T, 3, DW*SW] sharers-row gather
+    (one collective under shard_map, with the per-lane staging rows
+    overlaid block-locally first) serves all three phases; each phase's
+    view forwards the pending delta plans of the phases before it, and
+    `_dir_apply_merged` lands every plan in ONE scatter per store at
+    the end of the iteration.  This is the packed CacheRow exchange
+    form promoted to the iteration's working set: the six phases
+    operate on rows-in-registers, and the big stores see exactly one
+    gather and one scatter per iteration."""
+
+    def __init__(self, px: ParallelCtx, d: "DirectoryArrays", mp, lines):
+        self._dw = d.entry.shape[2]
+        self._dir_sets = mp.dir_sets
+        self.sets3 = jnp.stack(
+            [nn_mod(ln, mp.dir_sets).astype(jnp.int32) for ln in lines],
+            axis=1)                                           # [T, 3]
+        if px.sharded:
+            sets_l = px.lo(self.sets3)
+            Tl = d.entry.shape[0]
+            lt = np.arange(Tl, dtype=np.int32)[:, None]
+            ew = d.entry[lt, sets_l]                          # [Tl, 3, DW]
+            sh = d.sharers[lt, sets_l]                        # [Tl, 3, DW*SW]
+            if d.skey is not None:
+                sh = _stage_overlay_rows(d, sets_l, sh)
+            self.entry_rows, self.sharer_rows = px.ag((ew, sh))
+        else:
+            T = d.entry.shape[0]
+            tl = np.arange(T, dtype=np.int32)[:, None]
+            self.entry_rows = d.entry[tl, self.sets3]
+            sh = d.sharers[tl, self.sets3]
+            if d.skey is not None:
+                sh = _stage_overlay_rows(d, self.sets3, sh)
+            self.sharer_rows = sh
+
+    def _forward(self, sets, ew, sh, packs):
+        """Add earlier phases' pending deltas where their target set is
+        this view's set (all directory writes are home-lane-local, so a
+        per-lane set compare decides).  Deltas were computed against the
+        then-current forwarded view, so the adds chain exactly."""
+        DW = self._dw
+        for (psets, pway, ped, pshd) in packs:
+            m = psets == sets
+            onehot = (np.arange(DW, dtype=np.int32)[None, :]
+                      == pway[:, None])
+            ew = ew + jnp.where(m[:, None] & onehot, ped[:, None],
+                                jnp.zeros_like(ew))
+            sh = sh + jnp.where(m[:, None], pshd, jnp.zeros_like(sh))
+        return ew, sh
+
+    def view(self, k: int, line, packs) -> _DirRowView:
+        ew, sh = self._forward(self.sets3[:, k], self.entry_rows[:, k],
+                               self.sharer_rows[:, k], packs)
+        return _DirRowView(line, self.sets3[:, k], ew, sh, self._dw)
+
+    def view_finish(self, line, packs) -> _DirRowView:
+        sets = nn_mod(line, self._dir_sets).astype(jnp.int32)
+        use1 = sets == self.sets3[:, 1]
+        ew = jnp.where(use1[:, None], self.entry_rows[:, 1],
+                       self.entry_rows[:, 2])
+        sh = jnp.where(use1[:, None], self.sharer_rows[:, 1],
+                       self.sharer_rows[:, 2])
+        ew, sh = self._forward(sets, ew, sh, packs)
+        return _DirRowView(line, sets, ew, sh, self._dw)
+
+
+def _dir_apply_merged(d, px: ParallelCtx, packs):
+    """ONE merged scatter per big directory store per iteration: the
+    home phases' consolidated delta plans land together at the end of
+    the engine step.  Duplicate targets (two phases updating the same
+    per-lane entry) are folded into the earliest plan and the duplicate
+    slot redirected out of bounds, so the scatters keep unique indices
+    (in-place friendly) and the summed deltas stay exact — each phase's
+    delta was computed against the forwarded view, so the fold telescopes
+    to final-minus-initial.  Sharers deltas apply only in unstaged mode
+    (staged writes ride the per-lane table and flush per block)."""
+    packs = [tuple(px.lo(p)) for p in packs]
+    Tl = d.entry.shape[0]
+    t = np.arange(Tl, dtype=np.int32)
+    sets = [p[0] for p in packs]
+    way = [p[1] for p in packs]
+    ed = [p[2] for p in packs]
+    shd = [p[3] for p in packs]
+    n = len(packs)
+    drop_e = [jnp.zeros(Tl, jnp.bool_) for _ in range(n)]
+    drop_s = [jnp.zeros(Tl, jnp.bool_) for _ in range(n)]
+    for j in range(1, n):
+        for i in range(j):
+            eq_e = ((sets[i] == sets[j]) & (way[i] == way[j])
+                    & ~drop_e[i] & ~drop_e[j])
+            ed[i] = ed[i] + jnp.where(eq_e, ed[j], 0)
+            drop_e[j] = drop_e[j] | eq_e
+            eq_s = (sets[i] == sets[j]) & ~drop_s[i] & ~drop_s[j]
+            shd[i] = shd[i] + jnp.where(eq_s[:, None], shd[j],
+                                        jnp.zeros_like(shd[j]))
+            drop_s[j] = drop_s[j] | eq_s
+    t_e = jnp.concatenate([jnp.where(dr, Tl, t) for dr in drop_e])
+    s_all = jnp.concatenate(sets)
+    w_all = jnp.concatenate(way)
+    ed_all = jnp.concatenate(ed)
+    out = d.replace(entry=d.entry.at[t_e, s_all, w_all].add(
+        ed_all, mode="drop", unique_indices=True))
+    if d.skey is None:
+        t_s = jnp.concatenate([jnp.where(dr, Tl, t) for dr in drop_s])
+        shd_all = jnp.concatenate(shd)
+        out = out.replace(sharers=out.sharers.at[t_s, s_all].add(
+            shd_all, mode="drop", unique_indices=True))
+    return out
+
+
+def _cond_dir_c(pred, fn, ms, n_tiles: int):
+    """Round-12 form of `_cond_dir`: the phase reads the directory only
+    through its pre-gathered `_DirRowView` (closed over by `fn` — cond
+    inputs), so BOTH big stores detach from the cond entirely; the cond
+    returns the phase's consolidated delta plan for forwarding and the
+    end-of-iteration merged scatter.  The per-lane staging rows (small,
+    lane-local) stay carried — staged puts happen inside."""
+    d0 = ms.directory
+
+    def detach(m):
+        return m.replace(directory=m.directory.replace(
+            entry=None, sharers=None))
+
+    def run(m):
+        # the phase runs with BOTH big stores detached — its only
+        # directory reads are the view rows, its only writes the plan
+        acc = _DirAcc(consolidated=True)
+        m2, prog = fn(m, acc)
+        return m2, prog, acc.pack_c(d0, n_tiles)
+
+    def skip(m):
+        return m, jnp.zeros((), jnp.int32), _DirAcc.zero_pack_c(
+            d0, n_tiles)
+
+    ms2, prog, pack = jax.lax.cond(pred, run, skip, detach(ms))
+    d = ms2.directory.replace(entry=d0.entry, sharers=d0.sharers)
+    return ms2.replace(directory=d), prog, pack
 
 
 def _cond_nodir(pred, fn, ms):
@@ -876,7 +1178,8 @@ def _cond_dir(pred, fn, ms):
 
 def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
                 dstate=None, owner=None, sharers=None, nsharers=None,
-                acc: "_DirAcc | None" = None):
+                acc: "_DirAcc | None" = None,
+                view: "_DirRowView | None" = None):
     """Masked per-lane write of one directory entry.
 
     Add-a-delta scatters (new = cur + (new - cur) under mask): per-lane
@@ -885,11 +1188,50 @@ def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
     replicated full-width; a sharded px applies only this device's home
     rows.  With `acc` set (per-phase gating) the entry-word and unstaged
     sharers deltas are accumulated instead of scattered — the caller's
-    lax.cond returns them and `_dir_apply` lands them outside it."""
+    lax.cond returns them and `_dir_apply` lands them outside it.
+
+    With `view` set (round-12 consolidation) the current values are
+    read from the phase's forwarded working-set row instead of the big
+    stores (which may be detached from the cond entirely), deltas stay
+    replicated full-width in the acc — `_dir_apply_merged` lands every
+    phase's plan in one scatter per store at the end of the iteration —
+    and the sharers row delta is recorded in staged mode too so later
+    phases' views can forward it."""
+    if view is not None:
+        ref = (sets, way)
+        out = d
+        cur = view.word_at(way)
+        new = cur
+        if tags is not None:
+            new = _dir_set_field(new, tags.astype(I64) + 1, 0, _TAG_MASK)
+        if dstate is not None:
+            new = _dir_set_field(new, jnp.asarray(dstate, jnp.uint8),
+                                 DIR_STATE_SHIFT, 7)
+        if owner is not None:
+            new = _dir_set_field(new, owner.astype(I64) + 1,
+                                 DIR_OWNER_SHIFT, _ID_MASK)
+        if nsharers is not None:
+            new = _dir_set_field(new, nsharers, DIR_NSH_SHIFT, _ID_MASK)
+        if new is not cur:
+            delta = jnp.where(mask, new - cur, jnp.zeros_like(cur))
+            acc.add_entry(ref, sets, way, delta)
+        if sharers is not None:
+            DW = view._dw
+            row3 = view.sharers_row3()
+            onehot = (np.arange(DW, dtype=np.int32)[None, :, None]
+                      == way[:, None, None]) & mask[:, None, None]
+            new3 = jnp.where(onehot, sharers[:, None, :], row3)
+            row_delta = (new3 - row3).reshape(row3.shape[0], -1)
+            acc.add_sharers(ref, sets, way, row_delta)
+            if out.skey is not None:
+                out = _stage_put(out, *px.lo((sets, way, mask, sharers)),
+                                 dw=DW)
+        return out
+
     ref = (sets, way)
     sets, way, mask = px.lo((sets, way, mask))
     T = d.entry.shape[0]
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     out = d
 
     # ONE packed RMW scatter updates every written word field together
@@ -917,8 +1259,9 @@ def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
     if sharers is not None:
         new_sh = px.lo(sharers)                       # [Tl, SW]
         if out.skey is not None:
-            # staged mode (single-device programs only — the Simulator
-            # never enables staging under a mesh)
+            # staged mode (legacy view: single-device programs only —
+            # the Simulator forbids staging under a mesh without the
+            # consolidated base)
             assert not px.sharded
             out = _stage_put(out, sets, way, mask, new_sh)
         else:
@@ -929,7 +1272,7 @@ def _dir_update(d, sets, way, mask, *, px: ParallelCtx = IDENT, tags=None,
             DW = out.entry.shape[2]
             row = out.sharers[tiles, sets]            # [Tl, DW*SW]
             row3 = row.reshape(row.shape[0], DW, -1)
-            onehot = (jnp.arange(DW, dtype=jnp.int32)[None, :, None]
+            onehot = (np.arange(DW, dtype=np.int32)[None, :, None]
                       == way[:, None, None]) & mask[:, None, None]
             new3 = jnp.where(onehot, new_sh[:, None, :], row3)
             row_delta = (new3 - row3).reshape(row.shape)
@@ -957,14 +1300,14 @@ def memory_engine_step(
     px: ParallelCtx = IDENT,  # shard_map exchange context (parallel/px.py)
 ) -> MemStepOut:
     T = mp.n_tiles
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     progress = jnp.zeros((), jnp.int32)
     fmhz = freq_mhz.astype(I64)
 
     mc = jnp.asarray(mp.mc_tiles, jnp.int32)
 
     def home_of(line):
-        return mc[(line % len(mp.mc_tiles)).astype(jnp.int32)]
+        return mc[nn_mod(line, len(mp.mc_tiles)).astype(jnp.int32)]
 
     def ccycles(n, f=None):
         """cycles→ps at per-tile cache frequency (or given), model-gated."""
@@ -1045,9 +1388,12 @@ def memory_engine_step(
         # test bits, which must be read before this phase's own writes).
         s_line_l = px.lo(s_line)
         rows_l = (
-            ca.gather_row(ms.l1i, s_line_l, px.lo_const(mp.l1i.sets_mod)),
-            ca.gather_row(ms.l1d, s_line_l, px.lo_const(mp.l1d.sets_mod)),
-            ca.gather_row(ms.l2, s_line_l, px.lo_const(mp.l2.sets_mod)),
+            ca.gather_row(ms.l1i, s_line_l, px.lo_const(mp.l1i.sets_mod),
+                          nonneg=True),
+            ca.gather_row(ms.l1d, s_line_l, px.lo_const(mp.l1d.sets_mod),
+                          nonneg=True),
+            ca.gather_row(ms.l2, s_line_l, px.lo_const(mp.l2.sets_mod),
+                          nonneg=True),
         )
         if mp.l2.track_miss_types:
             mt_bits_l = (_mt_test(ms.mt, MT_EVICTED, s_line_l),
@@ -1148,7 +1494,7 @@ def memory_engine_step(
         l2_cloc = px.entry_set(ms.l2_cloc, ev_sets_l, ev_way_l,
                                px.lo(l1_ev) & ev_hit_l, 0)
         # record new cached-loc for the filled line
-        f_sets = (s_line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
+        f_sets = nn_mod(s_line, jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
         new_cloc = jnp.where(s_comp_l1i, MOD_L1I, MOD_L1D).astype(jnp.uint8)
         l2_cloc = px.entry_set(
             l2_cloc, *px.lo((f_sets, l2_way, l2_hit_now, new_cloc)))
@@ -1198,17 +1544,16 @@ def memory_engine_step(
                           mail.evict_time[w_home, tiles])),
         )
         rq_type = jnp.where(s_write, MSG_EX_REQ, MSG_SH_REQ).astype(jnp.uint8)
-        rq_home = jnp.where(l2_miss_go, s_home, 0)
         noc, rq_arrival = mem_net_send(
             mp, noc, tiles, s_home, mp.req_bits, req_send_ps, l2_miss_go,
             enabled)
+        # per-requester lane (one outstanding miss per tile): plain
+        # masked selects, no matrix scatter
         mail = mail.replace(
-            req_type=mail.req_type.at[rq_home, tiles].set(
-                jnp.where(l2_miss_go, rq_type, mail.req_type[rq_home, tiles])),
-            req_line=mail.req_line.at[rq_home, tiles].set(
-                jnp.where(l2_miss_go, s_line, mail.req_line[rq_home, tiles])),
-            req_time=mail.req_time.at[rq_home, tiles].set(
-                jnp.where(l2_miss_go, rq_arrival, mail.req_time[rq_home, tiles])),
+            req_type=jnp.where(l2_miss_go, rq_type, mail.req_type),
+            req_home=jnp.where(l2_miss_go, s_home, mail.req_home),
+            req_line=jnp.where(l2_miss_go, s_line, mail.req_line),
+            req_time=jnp.where(l2_miss_go, rq_arrival, mail.req_time),
         )
 
         # --- requester bookkeeping for this iteration's starts ----------------
@@ -1230,7 +1575,7 @@ def memory_engine_step(
             # per-slot latency for the iocoom operand algebra
             slot_lat_ps=jnp.where(
                 (slot_done_now[:, None]
-                 & (jnp.arange(3)[None, :] == slot[:, None])),
+                 & (np.arange(3)[None, :] == slot[:, None])),
                 (slot_done_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
             instr_buf=new_instr_buf,
             # slot advances on completion; on miss it stays (the reply path
@@ -1313,6 +1658,7 @@ def memory_engine_step(
     # only small per-phase state — see _cond_nodir/_cond_dir.
 
     gate = bool(getattr(mp, "phase_gate", False))
+    consolidate = bool(getattr(mp, "base_consolidate", True))
 
     def _phase_requester(ms):
         prog = jnp.zeros((), jnp.int32)
@@ -1337,17 +1683,51 @@ def memory_engine_step(
     # ======================================================================
     # (2) homes consume one EVICT per iteration
     # ======================================================================
+    # Round-12 consolidated base: after the requester phase every set
+    # the home phases can touch is known, so ONE packed working-set
+    # gather (entry + sharers rows, staging overlaid) serves phases
+    # 2/3/5, each phase's cond returns its delta plan for forwarding,
+    # and the plans land in ONE merged scatter per store after phase 5.
+    ws = None
+    packs = []
+    if consolidate:
+        mail0 = ms.mail
+        src_e0, _ = _row_earliest(mail0.evict_type, mail0.evict_time)
+        eline0 = mail0.evict_line[tiles, src_e0]
+        use_saved0 = ~ms.txn.active & ms.txn.saved_valid
+        r_col0, _ = _req_earliest(mail0)
+        rline0 = jnp.where(use_saved0, ms.txn.saved_line,
+                           mail0.req_line[r_col0])
+        ws = _DirWorkingSet(px, ms.directory, mp,
+                            (eline0, rline0, ms.txn.line))
+
+    def _run_dir_phase(pred, fn):
+        """One home phase in the selected regime; consolidated runs
+        collect the phase's delta plan into `packs`."""
+        nonlocal ms, packs
+        if consolidate:
+            if gate:
+                ms, p, pk = _cond_dir_c(pred, fn, ms, T)
+            else:
+                a = _DirAcc(consolidated=True)
+                d0 = ms.directory
+                ms, p = fn(ms, a)
+                pk = a.pack_c(d0, T)
+            packs.append(pk)
+            return p
+        if gate:
+            ms, p = _cond_dir(pred, fn, ms)
+            return p
+        ms, p = fn(ms, None)
+        return p
+
     pred2 = (ms.mail.evict_type != MSG_NONE).any()
-    if gate:
-        ms, p = _cond_dir(
-            pred2,
-            lambda m, a: _home_evictions(
-                mp, m, dir_access_ps, enabled, jnp.zeros((), jnp.int32),
-                px, acc=a),
-            ms)
-    else:
-        ms, p = _home_evictions(mp, ms, dir_access_ps, enabled,
-                                jnp.zeros((), jnp.int32), px)
+    view2 = ws.view(0, eline0, packs) if consolidate else None
+    p = _run_dir_phase(
+        pred2,
+        lambda m, a: _home_evictions(
+            mp, m, dir_access_ps, enabled, jnp.zeros((), jnp.int32),
+            px, acc=a, dsv=view2))
     progress = progress + p
 
     # ======================================================================
@@ -1355,18 +1735,13 @@ def memory_engine_step(
     # ======================================================================
     pred3 = ((ms.mail.req_type != MSG_NONE).any()
              | (ms.txn.saved_valid & ~ms.txn.active).any())
-    if gate:
-        ms, p = _cond_dir(
-            pred3,
-            lambda m, a: _home_starts(
-                mp, m, dram_lat_ps, dir_access_ps, sync_dir_l2,
-                sync_dir_net, enabled, jnp.zeros((), jnp.int32), px,
-                acc=a),
-            ms)
-    else:
-        ms, p = _home_starts(mp, ms, dram_lat_ps, dir_access_ps,
-                             sync_dir_l2, sync_dir_net, enabled,
-                             jnp.zeros((), jnp.int32), px)
+    view3 = ws.view(1, rline0, list(packs)) if consolidate else None
+    p = _run_dir_phase(
+        pred3,
+        lambda m, a: _home_starts(
+            mp, m, dram_lat_ps, dir_access_ps, sync_dir_l2,
+            sync_dir_net, enabled, jnp.zeros((), jnp.int32), px,
+            acc=a, dsv=view3))
     progress = progress + p
 
     # ======================================================================
@@ -1390,18 +1765,18 @@ def memory_engine_step(
     # (5) homes consume ACKs, finish transactions
     # ======================================================================
     pred5 = (ms.mail.ack_type != MSG_NONE).any() | ms.txn.active.any()
-    if gate:
-        ms, p = _cond_dir(
-            pred5,
-            lambda m, a: _home_acks_and_finish(
-                mp, m, dram_lat_ps, dir_access_ps, enabled,
-                jnp.zeros((), jnp.int32), px, acc=a),
-            ms)
-    else:
-        ms, p = _home_acks_and_finish(mp, ms, dram_lat_ps, dir_access_ps,
-                                      enabled, jnp.zeros((), jnp.int32),
-                                      px)
+    view5 = (ws.view_finish(ms.txn.line, list(packs))
+             if consolidate else None)
+    p = _run_dir_phase(
+        pred5,
+        lambda m, a: _home_acks_and_finish(
+            mp, m, dram_lat_ps, dir_access_ps, enabled,
+            jnp.zeros((), jnp.int32), px, acc=a, dsv=view5))
     progress = progress + p
+    if consolidate:
+        # the ONE merged scatter per big store for this iteration
+        ms = ms.replace(directory=_dir_apply_merged(
+            ms.directory, px, packs))
 
     # ======================================================================
     # (6) requesters consume replies (fill L2+L1, complete slot)
@@ -1467,7 +1842,7 @@ def _apply_functional(mp, ms: MemState, rec: RecView, slot, s_addr, s_write,
 def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
                  sync_l2_net, sync_l1d_l2, px: ParallelCtx = IDENT):
     T = mp.n_tiles
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     mail = ms.mail
 
     def ccyc(n):
@@ -1484,11 +1859,13 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     # keeps the direct element read)
     fline_l = px.lo(fline)
     l2_mod_l = px.lo_const(mp.l2.sets_mod)
-    sets_l = (fline_l % jnp.asarray(l2_mod_l)).astype(jnp.int32)
-    lt = jnp.arange(ms.l2.meta.shape[0], dtype=jnp.int32)
-    rows_l = (ca.gather_row(ms.l2, fline_l, l2_mod_l),
-              ca.gather_row(ms.l1i, fline_l, px.lo_const(mp.l1i.sets_mod)),
-              ca.gather_row(ms.l1d, fline_l, px.lo_const(mp.l1d.sets_mod)))
+    sets_l = nn_mod(fline_l, jnp.asarray(l2_mod_l)).astype(jnp.int32)
+    lt = np.arange(ms.l2.meta.shape[0], dtype=np.int32)
+    rows_l = (ca.gather_row(ms.l2, fline_l, l2_mod_l, nonneg=True),
+              ca.gather_row(ms.l1i, fline_l, px.lo_const(mp.l1i.sets_mod),
+                            nonneg=True),
+              ca.gather_row(ms.l1d, fline_l, px.lo_const(mp.l1d.sets_mod),
+                            nonneg=True))
     util_row_l = (_util_row_local(ms.l2_util, fline_l, l2_mod_l)
                   if mp.l2.track_line_utilization else None)
     if px.sharded:
@@ -1515,7 +1892,7 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
     done_ps = ftime + sync_l2_net + l2_cost + l1_cost + 2 * sync_l1d_l2
 
     # invalidate / downgrade L1 (whichever L1 holds it, by cached-loc)
-    sets = (fline % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
+    sets = nn_mod(fline, jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     if cloc_row is not None:
         cloc = jnp.take_along_axis(cloc_row, l2_way[:, None], axis=1)[:, 0]
     else:
@@ -1599,9 +1976,10 @@ def _sharer_step(mp, ms: MemState, fmhz, enabled, progress,
 
 
 def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress,
-                    px: ParallelCtx = IDENT, acc: "_DirAcc | None" = None):
+                    px: ParallelCtx = IDENT, acc: "_DirAcc | None" = None,
+                    dsv=None):
     T = mp.n_tiles
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     mail = ms.mail
 
     src, found = _row_earliest(mail.evict_type, mail.evict_time)
@@ -1610,7 +1988,9 @@ def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress,
     etime = mail.evict_time[tiles, src]
 
     d = ms.directory
-    dsv = _DirSetView(px, d, eline, mp)
+    if dsv is None:
+        dsv = _DirSetView(px, d, eline, mp)
+    vw = dsv if isinstance(dsv, _DirRowView) else None
     sets = dsv.sets
     dfound, way = dsv.lookup()
     apply = found & dfound
@@ -1632,7 +2012,7 @@ def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress,
     ).astype(jnp.uint8)
     d = _dir_update(d, sets, way, apply, px=px, dstate=new_dstate,
                     owner=new_owner, sharers=new_sharers, nsharers=new_nsh,
-                    acc=acc)
+                    acc=acc, view=vw)
 
     # active same-line transaction: treat the eviction as the ack
     txn = ms.txn
@@ -1670,9 +2050,9 @@ def _home_evictions(mp, ms: MemState, dir_access_ps, enabled, progress,
 
 def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
                           enabled, progress, px: ParallelCtx = IDENT,
-                          acc: "_DirAcc | None" = None):
+                          acc: "_DirAcc | None" = None, dsv=None):
     T = mp.n_tiles
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     mail = ms.mail
     txn = ms.txn
 
@@ -1713,7 +2093,9 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     is_nullify = txn.mtype == MSG_NULLIFY
 
     d = ms.directory
-    dsv = _DirSetView(px, d, txn.line, mp)
+    if dsv is None:
+        dsv = _DirSetView(px, d, txn.line, mp)
+    vw = dsv if isinstance(dsv, _DirRowView) else None
     sets = dsv.sets
     dfound, way = dsv.lookup()
     r = txn.requester
@@ -1749,7 +2131,7 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         sharers=jnp.where(exf[:, None], rbit_words,
                           set_bit(cur_sharers, r, shf)),
         nsharers=jnp.where(exf, 1, cur_nsh + (~had).astype(jnp.int32)),
-        acc=acc)
+        acc=acc, view=vw)
     # NULLIFY finish: the entry was already replaced at allocation; nothing
     # directory-side remains (`processNullifyReq` UNCACHED branch)
 
@@ -1808,26 +2190,27 @@ def _home_acks_and_finish(mp, ms: MemState, dram_lat_ps, dir_access_ps,
 
 def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
                  sync_dir_l2, sync_dir_net, enabled, progress,
-                 px: ParallelCtx = IDENT, acc: "_DirAcc | None" = None):
+                 px: ParallelCtx = IDENT, acc: "_DirAcc | None" = None,
+                 dsv=None):
     T = mp.n_tiles
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     mail = ms.mail
     txn = ms.txn
 
     can_start = ~txn.active
     # source 1: saved original request (after a NULLIFY completed)
     use_saved = can_start & txn.saved_valid
-    # source 2: earliest pending request cell
-    r_col, r_found = _row_earliest(mail.req_type, mail.req_time)
+    # source 2: earliest pending request lane targeting this home
+    r_col, r_found = _req_earliest(mail)
     use_pop = can_start & ~use_saved & r_found
 
     starting = use_saved | use_pop
     rtype = jnp.where(use_saved, txn.saved_type,
-                      mail.req_type[tiles, r_col]).astype(jnp.uint8)
-    rline = jnp.where(use_saved, txn.saved_line, mail.req_line[tiles, r_col])
+                      mail.req_type[r_col]).astype(jnp.uint8)
+    rline = jnp.where(use_saved, txn.saved_line, mail.req_line[r_col])
     rreq = jnp.where(use_saved, txn.saved_requester, r_col)
     rtime = jnp.where(use_saved, txn.saved_time_ps,
-                      mail.req_time[tiles, r_col])
+                      mail.req_time[r_col])
     # message sync at the directory (`handleMsgFromL2Cache` entry) —
     # charged once per message: saved_time_ps already includes it, so
     # resumed requests (post-NULLIFY) must not pay it again
@@ -1839,16 +2222,15 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     rtime = jnp.where(starting & (rline == txn.last_line),
                       jnp.maximum(rtime, txn.last_done_ps), rtime)
 
-    # consume the popped cell
-    cr = jnp.where(use_pop, r_col, 0)
-    mail = mail.replace(
-        req_type=mail.req_type.at[tiles, cr].set(
-            jnp.where(use_pop, MSG_NONE, mail.req_type[tiles, cr])))
+    # consume the popped lane
+    mail = _req_consume(mail, use_pop, r_col)
     txn = txn.replace(saved_valid=txn.saved_valid & ~use_saved)
 
     # ---- directory entry lookup / allocation -----------------------------
     d = ms.directory
-    dsv = _DirSetView(px, d, rline, mp)
+    if dsv is None:
+        dsv = _DirSetView(px, d, rline, mp)
+    vw = dsv if isinstance(dsv, _DirRowView) else None
     sets = dsv.sets
     dfound, way = dsv.lookup()
     tag_row, nsh_row = dsv.rows()
@@ -1954,7 +2336,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
     # when dfound).
     upd = is_new | imm
     d = _dir_update(
-        d, sets, alloc_way, upd, px=px, acc=acc,
+        d, sets, alloc_way, upd, px=px, acc=acc, view=vw,
         tags=jnp.where(is_new, rline, v_line),
         dstate=jnp.where(
             imm, jnp.where(imm_ex, DIR_MODIFIED, DIR_SHARED),
@@ -2032,7 +2414,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         # drop the victim from the entry now — its INV/FLUSH ack is consumed
         # by this transaction, not the eviction path (one txn per home)
         d = _dir_update(
-            d, sets, alloc_way, sh_over, px=px, acc=acc,
+            d, sets, alloc_way, sh_over, px=px, acc=acc, view=vw,
             sharers=v_sharers & ~victim_bits,
             nsharers=v_nsh - 1,
             owner=jnp.where(victim_is_owner, -1, v_owner),
@@ -2050,7 +2432,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
         fwd_msg = jnp.where(sh_over_m, MSG_FLUSH_REQ, fwd_msg).astype(
             jnp.uint8)
         d = _dir_update(
-            d, sets, alloc_way, sh_over_m, px=px, acc=acc,
+            d, sets, alloc_way, sh_over_m, px=px, acc=acc, view=vw,
             sharers=jnp.zeros((T, mp.sharer_words), U32),
             nsharers=jnp.zeros(T, jnp.int32),
             owner=jnp.full(T, -1, jnp.int32),
@@ -2137,7 +2519,7 @@ def _home_starts(mp, ms: MemState, dram_lat_ps, dir_access_ps,
 def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
                     progress, sync_l2_net, px: ParallelCtx = IDENT):
     T = mp.n_tiles
-    tiles = jnp.arange(T, dtype=jnp.int32)
+    tiles = np.arange(T, dtype=np.int32)
     mail = ms.mail
 
     def ccyc(n):
@@ -2152,9 +2534,12 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
     # miss-type test bits — the victim's own bitmap write is folded back
     # in below via the bucket-collision correction)
     line_l = px.lo(line)
-    rows_l = (ca.gather_row(ms.l2, line_l, px.lo_const(mp.l2.sets_mod)),
-              ca.gather_row(ms.l1i, line_l, px.lo_const(mp.l1i.sets_mod)),
-              ca.gather_row(ms.l1d, line_l, px.lo_const(mp.l1d.sets_mod)))
+    rows_l = (ca.gather_row(ms.l2, line_l, px.lo_const(mp.l2.sets_mod),
+                            nonneg=True),
+              ca.gather_row(ms.l1i, line_l, px.lo_const(mp.l1i.sets_mod),
+                            nonneg=True),
+              ca.gather_row(ms.l1d, line_l, px.lo_const(mp.l1d.sets_mod),
+                            nonneg=True))
     if mp.l2.track_miss_types:
         mt_bits_l = (_mt_test(ms.mt, MT_EVICTED, line_l),
                      _mt_test(ms.mt, MT_INVALIDATED, line_l))
@@ -2194,7 +2579,7 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
                 jnp.where(fill & en, init, lu_cur)),
             counters=_util_classify(ms.counters, lu_cur, evict_go,
                                     enabled))
-    sets = (line % jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
+    sets = nn_mod(line, jnp.asarray(mp.l2.sets_mod)).astype(jnp.int32)
     l2_cloc = px.entry_set(
         ms.l2_cloc, *px.lo((
             sets, way, fill,
@@ -2276,7 +2661,7 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
         acc_ps=ms.req.acc_ps + jnp.where(fill, done_ps - clock_ps, 0),
         slot_lat_ps=jnp.where(
             (fill[:, None]
-             & (jnp.arange(3)[None, :] == ms.req.slot[:, None])),
+             & (np.arange(3)[None, :] == ms.req.slot[:, None])),
             (done_ps - clock_ps)[:, None], ms.req.slot_lat_ps),
     )
     ms = ms.replace(l1i=l1i, l1d=l1d, l2=l2, l2_cloc=l2_cloc, mail=mail,

@@ -47,7 +47,8 @@ from graphite_tpu.memory.cache_array import (
     state_readable, state_writable,
 )
 from graphite_tpu.memory.engine import (
-    MemStepOut, RecView, _dir_set_field, _ID_MASK, _row_earliest,
+    MemStepOut, RecView, _dir_set_field, _ID_MASK, _req_consume,
+    _req_earliest, _row_earliest,
     _rows_exchange, clear_bit, lowest_sharer, mem_net_fanout,
     mem_net_latency_ps, mem_net_send, set_bit, test_bit, unpack_sharers,
 )
@@ -468,14 +469,13 @@ def shl2_engine_step(
             mp, ms.noc, tiles, s_home, mp.req_bits, req_send_ps, l1_miss,
             enabled)
         mail = ms.mail
-        rq_home = jnp.where(l1_miss, s_home, 0)
+        # per-requester lane (one outstanding miss per tile): plain
+        # masked selects, no matrix scatter
         mail = mail.replace(
-            req_type=mail.req_type.at[rq_home, tiles].set(
-                jnp.where(l1_miss, rq_type, mail.req_type[rq_home, tiles])),
-            req_line=mail.req_line.at[rq_home, tiles].set(
-                jnp.where(l1_miss, s_line, mail.req_line[rq_home, tiles])),
-            req_time=mail.req_time.at[rq_home, tiles].set(
-                jnp.where(l1_miss, rq_arrival, mail.req_time[rq_home, tiles])),
+            req_type=jnp.where(l1_miss, rq_type, mail.req_type),
+            req_home=jnp.where(l1_miss, s_home, mail.req_home),
+            req_line=jnp.where(l1_miss, s_line, mail.req_line),
+            req_time=jnp.where(l1_miss, rq_arrival, mail.req_time),
         )
 
         slot_done_now = ibuf_hit | l1_hit_now
@@ -930,23 +930,20 @@ def _home_starts(mp, ms: ShL2State, l2_access, sync_l2_net, enabled,
 
     can_start = ~txn.active
     use_saved = can_start & txn.saved_valid
-    r_col, r_found = _row_earliest(mail.req_type, mail.req_time)
+    r_col, r_found = _req_earliest(mail)
     use_pop = can_start & ~use_saved & r_found
     starting = use_saved | use_pop
     rtype = jnp.where(use_saved, txn.saved_type,
-                      mail.req_type[tiles, r_col]).astype(jnp.uint8)
-    rline = jnp.where(use_saved, txn.saved_line, mail.req_line[tiles, r_col])
+                      mail.req_type[r_col]).astype(jnp.uint8)
+    rline = jnp.where(use_saved, txn.saved_line, mail.req_line[r_col])
     rreq = jnp.where(use_saved, txn.saved_requester, r_col)
     rcomp = jnp.where(use_saved, txn.saved_comp, MOD_L1D).astype(jnp.uint8)
     rtime = jnp.where(use_saved, txn.saved_time_ps,
-                      mail.req_time[tiles, r_col])
+                      mail.req_time[r_col])
     rtime = rtime + jnp.where(use_saved, 0, sync_l2_net)
     rtime = jnp.where(starting & (rline == txn.last_line),
                       jnp.maximum(rtime, txn.last_done_ps), rtime)
-    cr = jnp.where(use_pop, r_col, 0)
-    mail = mail.replace(
-        req_type=mail.req_type.at[tiles, cr].set(
-            jnp.where(use_pop, MSG_NONE, mail.req_type[tiles, cr])))
+    mail = _req_consume(mail, use_pop, r_col)
     txn = txn.replace(saved_valid=txn.saved_valid & ~use_saved)
 
     # ---- L2 slice lookup / allocation (all on rline's SET: the victim

@@ -250,17 +250,29 @@ class MemParams:
     # shared-L2 engine's requester phase does not read it (its L1-only
     # hit path is already a single cheap lookup per iteration)
     requester_unroll: int = 1
-    # Directory write-staging capacity (0 = disabled).  XLA TPU lowers a
-    # per-lane scatter on the big [T, DS, DW*SW] sharers store as a
-    # FULL-ARRAY dense pass (~8 ms each at 1024 tiles, three per engine
-    # iteration — the coherence-storm floor, PERF.md round-4 findings).
-    # When enabled, sharers writes accumulate in a small unique-key
-    # [cap, SW] staging table (reads overlay it) and flush to the big
-    # store ONCE per inner_block iterations — one amortized dense pass
-    # instead of 3*inner_block.  The Simulator sizes cap =
-    # writes_per_iter * T * inner_block (overflow-impossible) and
-    # auto-enables on big directories; single-device programs only.
+    # Directory write-staging capacity PER HOME LANE (0 = disabled).
+    # XLA TPU lowers a per-lane scatter on the big [T, DS, DW*SW]
+    # sharers store as a FULL-ARRAY dense pass (~8 ms each at 1024
+    # tiles, three per engine iteration — the coherence-storm floor,
+    # PERF.md round-4 findings).  When enabled, sharers writes append
+    # into per-lane [T, cap, SW] staging rows (reads overlay the latest
+    # match) and flush to the big store ONCE per inner_block iterations
+    # — one amortized dense pass instead of 3*inner_block.  The
+    # Simulator sizes cap = writes_per_iter * inner_block (overflow-
+    # impossible) and auto-enables on big directories.  Lane-local by
+    # construction, so the rows shard with the directory under
+    # shard_map (round 12; the old global-table form was single-device
+    # only).
     dir_stage_cap: int = 0
+    # Round-12 base consolidation: the three home phases read the
+    # directory through ONE packed per-iteration set-row gather (entry +
+    # sharers, one collective under shard_map) with pending-delta
+    # forwarding between phases, and their delta plans land in ONE
+    # merged scatter per store at the end of the iteration.  False
+    # restores the round-11 per-phase gather/apply layout (bit-identical
+    # by construction — `tools/regress.py --smoke` pins it), kept as the
+    # equivalence oracle.
+    base_consolidate: bool = True
     # Per-phase activity gating (round 6): each protocol phase runs under
     # its OWN scalar-predicate lax.cond whose carried operands are only
     # the small per-phase state — the big directory/sharers stores are
@@ -468,6 +480,8 @@ class MemParams:
             icache_modeling=cfg.get_bool("general/enable_icache_modeling", False),
             func_mem_words=cfg.get_int("general/functional_memory_kb", 256) * 256,
             requester_unroll=requester_unroll,
+            base_consolidate=cfg.get_bool("general/base_consolidate",
+                                          True),
         )
 
     def sync_cycles(self, module_a: int, module_b: int) -> int:

@@ -1,10 +1,12 @@
 """Memory-subsystem state: caches, directory, protocol mailboxes, DRAM.
 
 Layout notes (all leading axis = tile):
- - The per-(home, requester) REQUEST matrix has a single slot per pair
+ - REQUEST cells live per REQUESTER lane ([T] + a target-home vector)
    because each tile has exactly one outstanding L2 miss
-   (`l2_cache_cntlr.h` _outstanding_shmem_msg) — the dense analog of the
-   per-address request queue in `dram_directory_cntlr.cc:59-96`.
+   (`l2_cache_cntlr.h` _outstanding_shmem_msg) — the compact analog of
+   the per-address request queue in `dram_directory_cntlr.cc:59-96`;
+   homes pop the earliest (time, requester) via a segment-min over the
+   lanes targeting them.
  - FWD cells [sharer, home] carry INV/FLUSH/WB requests from a home's
    active transaction; a home owns its column (one transaction at a time)
    and clears it when the transaction ends, so stale messages cannot leak
@@ -98,13 +100,16 @@ class DirectoryArrays:
     # "array padding"), and the set-row form matches how every phase
     # reads it anyway
     sharers: jax.Array   # uint32[T, DS, DW*SW]
-    # sharers write-staging table (MemParams.dir_stage_cap > 0; see
-    # engine._stage_put / dir_stage_flush).  Unique-key invariant: at
-    # most one live slot per directory entry — writes overwrite their
-    # existing slot.  None when staging is disabled.
-    skey: "object" = None  # int32[C] (t*DS + set)*DW + way, -1 = empty
-    sval: "object" = None  # uint32[C, SW] staged sharer words
-    sn: "object" = None    # int32[] slots appended since last flush
+    # sharers write-staging rows, PER HOME LANE (MemParams.dir_stage_cap
+    # > 0; see engine._stage_put / dir_stage_flush).  Append-only: a put
+    # lands at the lane's cursor `sn`; keys may repeat within a row —
+    # reads take the latest match and the flush applies only each key's
+    # last slot (round 12; every directory write is home-lane-local, so
+    # the rows are block-local under shard_map).  None when staging is
+    # disabled.
+    skey: "object" = None  # int32[T, c] set*DW + way, -1 = empty
+    sval: "object" = None  # uint32[T, c, SW] staged sharer words
+    sn: "object" = None    # int32[T] slots appended since last flush
 
 
 @struct.dataclass
@@ -140,9 +145,19 @@ class TxnState:
 
 @struct.dataclass
 class MemMailboxes:
-    req_type: jax.Array    # uint8[T(home), T(requester)]
-    req_line: jax.Array    # int32[T, T]
-    req_time: jax.Array    # int64[T, T]
+    # The request "matrix" is stored per REQUESTER lane: each tile has
+    # exactly one outstanding L2 (shared-L2: L1) miss (`l2_cache_cntlr.h`
+    # _outstanding_shmem_msg — the requester sits in PHASE_WAIT_REPLY
+    # until its reply fills), so the writer set of the old [T, T] form's
+    # column was provably one tile and the [T, T] matrix carried T-1
+    # dead cells per lane.  Round 12 compacts it to [T] lanes +
+    # `req_home`; the home-side pop is a segment-min over requesters
+    # with the SAME (time, requester) key order as the old row scan
+    # (engine._req_earliest), so the compaction is bit-exact.
+    req_type: jax.Array    # uint8[T(requester)]
+    req_home: jax.Array    # int32[T] target home of the live request
+    req_line: jax.Array    # int32[T]
+    req_time: jax.Array    # int64[T]
     evict_type: jax.Array  # uint8[T(home), T(src)]
     evict_line: jax.Array  # int32[T, T]
     evict_time: jax.Array  # int64[T, T]
@@ -256,9 +271,10 @@ def init_mem_common(mp: MemParams) -> dict:
         return jnp.zeros(T, I64)
 
     mail = MemMailboxes(
-        req_type=jnp.zeros((T, T), jnp.uint8),
-        req_line=jnp.zeros((T, T), jnp.int32),
-        req_time=jnp.zeros((T, T), I64),
+        req_type=jnp.zeros(T, jnp.uint8),
+        req_home=jnp.zeros(T, jnp.int32),
+        req_line=jnp.zeros(T, jnp.int32),
+        req_time=jnp.zeros(T, I64),
         evict_type=jnp.zeros((T, T), jnp.uint8),
         evict_line=jnp.zeros((T, T), jnp.int32),
         evict_time=jnp.zeros((T, T), I64),
@@ -327,11 +343,11 @@ def init_mem_state(mp: MemParams) -> MemState:
     directory = DirectoryArrays(
         entry=jnp.zeros((T, DS, DW), I64),
         sharers=jnp.zeros((T, DS, DW * SW), jnp.uint32),
-        skey=(jnp.full((mp.dir_stage_cap,), -1, jnp.int32)
+        skey=(jnp.full((T, mp.dir_stage_cap), -1, jnp.int32)
               if mp.dir_stage_cap else None),
-        sval=(jnp.zeros((mp.dir_stage_cap, SW), jnp.uint32)
+        sval=(jnp.zeros((T, mp.dir_stage_cap, SW), jnp.uint32)
               if mp.dir_stage_cap else None),
-        sn=(jnp.zeros((), jnp.int32) if mp.dir_stage_cap else None),
+        sn=(jnp.zeros(T, jnp.int32) if mp.dir_stage_cap else None),
     )
     txn = TxnState(
         active=jnp.zeros(T, jnp.bool_),

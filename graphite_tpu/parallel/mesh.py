@@ -145,6 +145,9 @@ _SHARD_MAP_LOCAL = {
     "mem.l1i.meta", "mem.l1d.meta", "mem.l2.meta",
     "mem.l2_cloc", "mem.l2_util", "mem.mt",
     "mem.directory.entry", "mem.directory.sharers",
+    # round-12 per-HOME-LANE staging rows: lane-local by construction,
+    # so they shard with the directory they stage for
+    "mem.directory.skey", "mem.directory.sval", "mem.directory.sn",
     # shared-L2 engine: the L2-slice-embedded directory (engine_shl2)
     "mem.dir.word", "mem.dir.sharers",
 }

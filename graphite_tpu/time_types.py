@@ -23,6 +23,8 @@ import dataclasses
 
 import jax.numpy as jnp
 
+from graphite_tpu.intmath import nn_ceil_div
+
 # Conversion factors.
 PS_PER_NS = 1000
 PS_PER_CYCLE_NUMERATOR = 1_000_000  # ps/cycle = 1e6 / freq_mhz
@@ -37,8 +39,14 @@ def ghz_to_mhz(freq_ghz: float) -> int:
 
 
 def _ceil_div(a, b):
-    """Ceil division for non-negative ints; works on ints and jnp arrays."""
-    return (a + b - 1) // b
+    """Ceil division for non-negative ints; works on ints and jnp arrays.
+
+    Every caller's operands are non-negative by contract (cycle counts,
+    picosecond durations, MHz frequencies), so the device form routes
+    through `intmath.nn_ceil_div` — a single `lax.div` instead of the
+    ~9-equation sign-fixup chain jnp's `//` lowers to, bit-identical on
+    non-negative operands (PERF.md round 12)."""
+    return nn_ceil_div(a, b)
 
 
 def cycles_to_ps(cycles, freq_mhz):

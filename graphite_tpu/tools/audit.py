@@ -79,6 +79,16 @@ def main(argv=None) -> int:
                     help="refresh BUDGETS.json baselines+ceilings from "
                     "this run's measurements (after an INTENTIONAL "
                     "change; merges, so --programs subsets are safe)")
+    ap.add_argument("--ratchet", action="store_true",
+                    help="with --budget-update: only LOWER ceilings — "
+                    "refuse (exit nonzero, nothing written) if any "
+                    "metric's new ceiling would exceed the checked-in "
+                    "one, unless named via --allow-increase")
+    ap.add_argument("--allow-increase", action="append", default=[],
+                    metavar="METRIC",
+                    help="with --budget-update --ratchet: permit this "
+                    "metric's ceiling to rise (repeatable; an explicit, "
+                    "reviewed exception to the ratchet)")
     ap.add_argument("--budgets-file", default=None,
                     help="override the BUDGETS.json path (default: "
                     "repo root)")
@@ -110,6 +120,12 @@ def main(argv=None) -> int:
     if args.lock and args.lock_update:
         ap.error("--lock and --lock-update are mutually exclusive "
                  "(gate against the registry OR refresh it, not both)")
+    if args.ratchet and not args.budget_update:
+        ap.error("--ratchet modifies the --budget-update refresh; it "
+                 "does nothing without it")
+    if args.allow_increase and not args.ratchet:
+        ap.error("--allow-increase is a ratchet exception; it needs "
+                 "--budget-update --ratchet")
     if args.regression_fixture and args.lock_fixture:
         ap.error("--regression-fixture and --lock-fixture each swap in "
                  "their own known-bad program; run the self-tests "
@@ -150,6 +166,13 @@ def main(argv=None) -> int:
     from graphite_tpu.analysis.audit import (
         DEFAULT_MAX_COND_BYTES, audit, default_programs,
     )
+
+    unknown_metrics = [m for m in args.allow_increase
+                       if m not in cost.BUDGET_METRICS]
+    if unknown_metrics:
+        ap.error(f"--allow-increase: unknown metric(s) "
+                 f"{unknown_metrics} (choose from "
+                 f"{', '.join(cost.BUDGET_METRICS)})")
 
     t0 = time.perf_counter()
     names = None
@@ -226,12 +249,20 @@ def main(argv=None) -> int:
     budget_findings = []
     if args.budget or args.budget_update:
         if args.budget_update:
-            path = cost.save_budgets(
-                cost_reports, args.budgets_file,
-                fingerprints={s.name: identity.fingerprint(s.closed)
-                              for s in specs},
-                registry=lock)
+            try:
+                path = cost.save_budgets(
+                    cost_reports, args.budgets_file,
+                    fingerprints={s.name: identity.fingerprint(s.closed)
+                                  for s in specs},
+                    registry=lock,
+                    ratchet=args.ratchet,
+                    allow_increase=tuple(args.allow_increase))
+            except cost.BudgetRatchetError as e:
+                print(json.dumps({"budget_ratchet_refused": True,
+                                  "error": str(e)}))
+                return 1
             print(json.dumps({"budgets_updated": True, "path": path,
+                              "ratchet": bool(args.ratchet),
                               "programs": [r.program
                                            for r in cost_reports]}))
         else:

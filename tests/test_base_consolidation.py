@@ -1,0 +1,368 @@
+"""Round-12 base consolidation: one packed directory working-set gather
+and one merged row scatter per engine iteration, bit-identical to the
+round-11 per-phase layout, plus the budget ratchet that locks the win in.
+
+The structural claims are jaxpr-level (via the shared analysis/walk
+traversal) at a 1024-tile shape — the config-5 regime the consolidation
+exists for; the equivalence claims are randomized-trace bit-identity
+(consolidated vs round-11 layout) and serialized-trace golden-oracle
+exactness for both memory engines.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine.simulator import Simulator
+from graphite_tpu.golden import run_golden
+from graphite_tpu.trace import synthetic
+from graphite_tpu.trace.schema import TraceBatch, TraceBuilder
+
+MSI = "pr_l1_pr_l2_dram_directory_msi"
+MOSI = "pr_l1_pr_l2_dram_directory_mosi"
+SHL2_MESI = "pr_l1_sh_l2_mesi"
+
+
+def make_config(n_tiles, proto=MSI, extra=""):
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = true
+[network]
+user = magic
+memory = magic
+[caching_protocol]
+type = {proto}
+[core/static_instruction_costs]
+mov = 1
+ialu = 1
+{extra}
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+def mutex_rmw(n, rounds, base=0x900000, lines=2):
+    bs = [TraceBuilder() for _ in range(n)]
+    bs[0].mutex_init(0)
+    bs[0].barrier_init(9, n)
+    for b in bs:
+        b.barrier_wait(9)
+    for r in range(n * rounds):
+        t = r % n
+        addr = base + (r % lines) * 64
+        bs[t].mutex_lock(0)
+        bs[t].load(addr, 8)
+        bs[t].store(addr, 8)
+        bs[t].mutex_unlock(0)
+    return TraceBatch.from_builders(bs)
+
+
+def _assert_results_equal(ra, rb):
+    np.testing.assert_array_equal(np.asarray(ra.clock_ps),
+                                  np.asarray(rb.clock_ps))
+    np.testing.assert_array_equal(np.asarray(ra.instruction_count),
+                                  np.asarray(rb.instruction_count))
+    for k in ra.mem_counters:
+        np.testing.assert_array_equal(np.asarray(ra.mem_counters[k]),
+                                      np.asarray(rb.mem_counters[k]),
+                                      err_msg=k)
+
+
+# ---- program structure at the 1024-tile shape -----------------------------
+
+# same unique-aval geometry trick as test_phase_gating: the directory
+# entry/sharers avals must not collide with any cache meta array
+GEOM = """
+[l1_icache/T1]
+cache_size = 4
+associativity = 2
+[l1_dcache/T1]
+cache_size = 8
+associativity = 4
+[l2_cache/T1]
+cache_size = 32
+associativity = 8
+[dram_directory]
+total_entries = 64
+associativity = 4
+"""
+
+
+def _big_shape_sim(T=1024, **kw):
+    sc = make_config(T, MSI, extra=GEOM)
+    bs = []
+    for t in range(T):
+        b = TraceBuilder()
+        b.load(0x100000 + t * 64, 8)
+        b.store(0x100000 + (t % 7) * 64, 8)
+        bs.append(b)
+    batch = TraceBatch.from_builders(bs)
+    sim = Simulator(sc, batch, phase_gate=True, mem_gate_bytes=0, **kw)
+    assert sim.params.mem_gate is False
+    return sim
+
+
+def _iteration_jaxpr(sim):
+    from graphite_tpu.engine.step import subquantum_iteration
+
+    qend = jnp.asarray(2**61, jnp.int64)
+    return jax.make_jaxpr(
+        lambda st: subquantum_iteration(sim.params, sim.device_trace,
+                                        st, qend))(sim.state)
+
+
+def _store_ops(closed, sig):
+    """(gathers, scatters) on the store with aval signature `sig` at any
+    depth of the iteration program."""
+    from graphite_tpu.analysis.walk import aval_sig, iter_eqns
+
+    gathers, scatters = 0, 0
+    for eqn in iter_eqns(closed):
+        name = eqn.primitive.name
+        in_sigs = [aval_sig(v.aval) for v in eqn.invars
+                   if not isinstance(v, jax.core.Literal)]
+        if name == "gather" and in_sigs and in_sigs[0] == sig:
+            gathers += 1
+        if name.startswith("scatter") and in_sigs and in_sigs[0] == sig:
+            scatters += 1
+    return gathers, scatters
+
+
+def test_one_gather_one_merged_scatter_1024_shape():
+    """The consolidated iteration touches each big directory store
+    exactly once in each direction: ONE packed working-set row gather up
+    front, ONE merged row scatter at the end — for the sharers store AND
+    the packed entry-word store."""
+    sim = _big_shape_sim()
+    closed = _iteration_jaxpr(sim)
+    d = sim.state.mem.directory
+    sharers_sig = (tuple(d.sharers.shape), str(d.sharers.dtype))
+    entry_sig = (tuple(d.entry.shape), str(d.entry.dtype))
+
+    g, s = _store_ops(closed, sharers_sig)
+    assert (g, s) == (1, 1), (
+        f"sharers store: expected exactly one row gather and one merged "
+        f"row scatter per iteration, found {g} gather(s), {s} "
+        f"scatter(s)")
+    g, s = _store_ops(closed, entry_sig)
+    assert (g, s) == (1, 1), (
+        f"entry store: expected exactly one row gather and one merged "
+        f"row scatter per iteration, found {g} gather(s), {s} "
+        f"scatter(s)")
+
+
+def test_staged_iteration_has_no_sharers_scatter_1024_shape():
+    """With directory write-staging the iteration still gathers the
+    sharers store exactly once (overlaying the per-lane staging rows)
+    but never scatters it — the amortized flush outside the iteration
+    is the store's only writer."""
+    sim = _big_shape_sim(dir_stage=True, inner_block=4)
+    closed = _iteration_jaxpr(sim)
+    d = sim.state.mem.directory
+    sharers_sig = (tuple(d.sharers.shape), str(d.sharers.dtype))
+    g, s = _store_ops(closed, sharers_sig)
+    assert (g, s) == (1, 0), (g, s)
+
+
+def test_phase_conds_survive_consolidation_1024_shape():
+    """The six per-phase gating conds are unchanged in count — the
+    consolidation moves the big-store traffic out of the phases, not
+    the phases themselves."""
+    from graphite_tpu.analysis.rules import phase_conds
+
+    sim = _big_shape_sim()
+    closed = _iteration_jaxpr(sim)
+    assert len(phase_conds(closed, 1024)) == 6
+
+
+# ---- bit-identity: consolidated vs round-11 layout ------------------------
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI])
+@pytest.mark.parametrize("gate", [True, False])
+def test_consolidated_matches_round11_randomized(proto, gate):
+    """Randomized coherence traffic: the consolidated base must be
+    bit-identical to the round-11 per-phase layout, gated and ungated."""
+    sc = make_config(8, proto)
+    for seed in (3, 11):
+        batch = synthetic.memory_stress_trace(
+            8, n_accesses=40, working_set_bytes=1 << 12,
+            write_fraction=0.4, shared_fraction=0.6, seed=seed)
+        r_new = Simulator(sc, batch, phase_gate=gate,
+                          mem_gate_bytes=0).run()
+        r_old = Simulator(sc, batch, phase_gate=gate, mem_gate_bytes=0,
+                          base_consolidate=False).run()
+        _assert_results_equal(r_new, r_old)
+
+
+def test_consolidated_staged_matches_round11():
+    """Consolidation composes with directory write-staging (per-lane
+    rows, round 12): staged consolidated == staged round-11 layout ==
+    unstaged, on shared-line traffic crossing many flush boundaries."""
+    sc = make_config(8, MSI)
+    batch = synthetic.memory_stress_trace(
+        8, n_accesses=40, working_set_bytes=1 << 12,
+        write_fraction=0.5, shared_fraction=0.7, seed=5)
+    r_new = Simulator(sc, batch, mem_gate_bytes=0, dir_stage=True,
+                      inner_block=4).run()
+    r_old = Simulator(sc, batch, mem_gate_bytes=0, dir_stage=True,
+                      inner_block=4, base_consolidate=False).run()
+    r_uns = Simulator(sc, batch, mem_gate_bytes=0, dir_stage=False,
+                      inner_block=4).run()
+    _assert_results_equal(r_new, r_old)
+    _assert_results_equal(r_new, r_uns)
+
+
+# ---- sharded staging: the standing dir_stage gap, closed ------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 virtual devices")
+def test_sharded_dir_stage_matches_single_device():
+    """Round 12 closes the "dir_stage is single-device" gap: the
+    per-lane staging rows shard with the directory and ride the
+    consolidated working-set gather block-locally, so a meshed staged
+    run must be bit-identical to the single-device staged (and
+    unstaged) runs."""
+    from graphite_tpu.parallel.mesh import make_tile_mesh
+    from graphite_tpu.tools._template import coherence_stress_workload
+
+    sc, batch = coherence_stress_workload(64, protocol=MSI)
+    r_solo = Simulator(sc, batch, dir_stage=True, inner_block=4).run()
+    r_mesh = Simulator(sc, batch, dir_stage=True, inner_block=4,
+                       mesh=make_tile_mesh(8)).run()
+    r_uns = Simulator(sc, batch, dir_stage=False, inner_block=4).run()
+    _assert_results_equal(r_solo, r_mesh)
+    _assert_results_equal(r_solo, r_uns)
+    assert int(np.asarray(r_solo.mem_counters["l2_misses"]).sum()) > 0
+
+
+def test_legacy_layout_refuses_sharded_staging():
+    from graphite_tpu.parallel.mesh import make_tile_mesh
+    from graphite_tpu.tools._template import coherence_stress_workload
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    sc, batch = coherence_stress_workload(64, protocol=MSI)
+    with pytest.raises(ValueError, match="base_consolidate"):
+        Simulator(sc, batch, dir_stage=True, mesh=make_tile_mesh(8),
+                  base_consolidate=False)
+
+
+# ---- golden-oracle exactness (serialized traffic) -------------------------
+
+
+@pytest.mark.parametrize("proto", [MSI, MOSI, SHL2_MESI])
+def test_consolidated_golden_exact(proto):
+    """Serialized RMW traffic: the consolidated engines (private-L2 MSI/
+    MOSI and shared-L2 MESI) stay bit-exact vs the golden interpreters."""
+    sc = make_config(4, proto)
+    batch = mutex_rmw(4, 4, lines=3)
+    res = Simulator(sc, batch, phase_gate=True, mem_gate_bytes=0).run()
+    gold = run_golden(sc, batch)
+    np.testing.assert_array_equal(res.clock_ps, gold.clock_ps)
+    for k, g in gold.mem_counters.items():
+        np.testing.assert_array_equal(np.asarray(res.mem_counters[k]), g,
+                                      err_msg=k)
+
+
+def test_consolidated_staged_golden_exact():
+    sc = make_config(4, MSI)
+    batch = mutex_rmw(4, 4, lines=3)
+    res = Simulator(sc, batch, phase_gate=True, mem_gate_bytes=0,
+                    dir_stage=True, inner_block=4).run()
+    gold = run_golden(sc, batch)
+    np.testing.assert_array_equal(res.clock_ps, gold.clock_ps)
+    for k, g in gold.mem_counters.items():
+        np.testing.assert_array_equal(np.asarray(res.mem_counters[k]), g,
+                                      err_msg=k)
+
+
+# ---- the budget ratchet ---------------------------------------------------
+
+
+def _fake_report(name="gated-msi", kernels=100, tiles=8):
+    from graphite_tpu.analysis.cost import CostReport
+
+    return CostReport(
+        program=name, tiles=tiles, n_eqns_total=kernels,
+        kernels_per_iter=kernels, bytes_per_iter=10 * kernels,
+        arg_bytes=64, out_bytes=64, peak_bytes=1024)
+
+
+def test_ratchet_refuses_raised_ceiling(tmp_path):
+    from graphite_tpu.analysis.cost import (
+        BudgetRatchetError, load_budgets, save_budgets,
+    )
+
+    path = str(tmp_path / "budgets.json")
+    save_budgets([_fake_report(kernels=100)], path)
+    # a lower re-measurement ratchets down fine
+    save_budgets([_fake_report(kernels=50)], path, ratchet=True)
+    assert load_budgets(path)["gated-msi"]["measured"][
+        "kernels_per_iter"] == 50
+    # a higher one is refused, and the file is untouched
+    with pytest.raises(BudgetRatchetError) as e:
+        save_budgets([_fake_report(kernels=90)], path, ratchet=True)
+    assert "kernels_per_iter" in str(e.value)
+    assert load_budgets(path)["gated-msi"]["measured"][
+        "kernels_per_iter"] == 50
+    # unless the raised metrics are named explicitly
+    save_budgets([_fake_report(kernels=90)], path, ratchet=True,
+                 allow_increase=("kernels_per_iter", "n_eqns_total",
+                                 "bytes_per_iter"))
+    assert load_budgets(path)["gated-msi"]["measured"][
+        "kernels_per_iter"] == 90
+
+
+def test_ratchet_cli_self_test(tmp_path, capsys):
+    """The CLI fixture: a ratcheted --budget-update against ceilings
+    tightened below the real program's cost MUST exit nonzero and write
+    nothing — the refusal is the self-test that the ratchet gates."""
+    from graphite_tpu.tools.audit import main
+
+    budgets = str(tmp_path / "budgets.json")
+    no_lock = str(tmp_path / "absent.lock")
+    rc = main(["--programs", "gated-msi", "--budget-update",
+               "--budgets-file", budgets, "--lock-file", no_lock])
+    assert rc == 0
+    with open(budgets) as f:
+        data = json.load(f)
+    # tighten every ceiling below what the program actually measures
+    for m, v in data["gated-msi"]["measured"].items():
+        data["gated-msi"]["ceiling"][m] = max(int(v) - 1, 0)
+    with open(budgets, "w") as f:
+        json.dump(data, f)
+    rc = main(["--programs", "gated-msi", "--budget-update", "--ratchet",
+               "--budgets-file", budgets, "--lock-file", no_lock])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "budget_ratchet_refused" in out
+    with open(budgets) as f:
+        after = json.load(f)
+    assert after["gated-msi"]["ceiling"] == data["gated-msi"]["ceiling"]
+    # naming every metric lets the refresh through
+    rc = main(["--programs", "gated-msi", "--budget-update", "--ratchet",
+               "--budgets-file", budgets, "--lock-file", no_lock]
+              + sum((["--allow-increase", m] for m in
+                     data["gated-msi"]["measured"]), []))
+    assert rc == 0
+
+
+def test_ratchet_flag_combinations():
+    from graphite_tpu.tools.audit import main
+
+    with pytest.raises(SystemExit):
+        main(["--ratchet"])                       # needs --budget-update
+    with pytest.raises(SystemExit):
+        main(["--budget-update", "--allow-increase",
+              "kernels_per_iter"])                # needs --ratchet
+    with pytest.raises(SystemExit):
+        main(["--budget-update", "--ratchet", "--allow-increase",
+              "not_a_metric"])                    # unknown metric
