@@ -342,6 +342,63 @@ scheme = lax
                 "mean_clock_spread_ps"),
         })
 
+    # Campaign-service throughput (round 13, serve/ subsystem): N
+    # same-class jobs submitted through the admission-controlled
+    # service, batched and served off the fingerprint-keyed compiled-
+    # program cache — the service-level view of the round-7 batching
+    # win (jobs/s is COMPILE-INCLUSIVE: one compile amortized over the
+    # whole job stream is exactly the economics the service sells).
+    # The sequential baseline runs the SAME jobs one-by-one through the
+    # bit-exact oracle path (a fresh Simulator per job with the knobs
+    # baked static — what a campaign without the service pays).
+    # Skippable via BENCH_SERVE=0; sizes via BENCH_SERVE_JOBS/_BATCH.
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        import dataclasses as _dcs
+
+        from graphite_tpu.serve import CampaignService, Job
+        from graphite_tpu.tools._template import config_text
+
+        sv_jobs = int(os.environ.get("BENCH_SERVE_JOBS", "8"))
+        sv_batch = int(os.environ.get("BENCH_SERVE_BATCH", "4"))
+        sv_tiles = int(os.environ.get("BENCH_SERVE_TILES", "16"))
+        sc_sv = SimConfig(ConfigFile.from_string(config_text(
+            sv_tiles, shared_mem=True, clock_scheme="lax")))
+
+        def _sv_trace(seed):
+            return synthetic.memory_stress_trace(
+                sv_tiles, n_accesses=24, working_set_bytes=1 << 13,
+                write_fraction=0.4, shared_fraction=0.5, seed=seed)
+
+        jobs = [Job(f"bench-{i}", sc_sv, _sv_trace(i + 1),
+                    knobs={"dram_latency_ns": 40 + 10 * i}, seed=i + 1)
+                for i in range(sv_jobs)]
+        service = CampaignService(batch_size=sv_batch)
+        t0 = time.perf_counter()
+        for job in jobs:
+            service.submit(job)
+        served = service.run_all()
+        serve_wall = time.perf_counter() - t0
+        assert len(served) == sv_jobs and all(r.ok for r in served)
+        t0 = time.perf_counter()
+        for job in jobs:
+            seq_sim = Simulator(sc_sv, job.trace)
+            seq_sim.params = _dcs.replace(
+                seq_sim.params,
+                mem=_dcs.replace(seq_sim.params.mem, **job.knobs))
+            seq_sim.run()
+        seq_wall = time.perf_counter() - t0
+        sv_c = service.counters
+        companions.update({
+            "serve_jobs": sv_jobs,
+            "serve_jobs_per_s": round(sv_jobs / serve_wall, 3),
+            "serve_batch_occupancy": round(
+                sv_c["mean_batch_occupancy"], 3),
+            "serve_cache_hit_rate": round(sv_c["cache_hit_rate"], 3),
+            "serve_compile_count": sv_c["compile_count"],
+            "serve_vs_sequential": round(seq_wall / serve_wall, 3),
+            "sequential_jobs_per_s": round(sv_jobs / seq_wall, 3),
+        })
+
     # Static cost-model trajectory (round 12): the audited gated-MSI
     # program's per-iteration kernel/byte proxy and its per-phase/base
     # split (analysis/cost.py — the SAME numbers BUDGETS.json gates), so

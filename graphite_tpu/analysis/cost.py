@@ -80,6 +80,18 @@ def tree_bytes(tree) -> int:
     return total
 
 
+def trace_record_bytes(trace) -> int:
+    """Bytes of ONE record across every field of a trace dataclass
+    (TraceBatch or any per-record array bundle) — the per-record unit
+    the streaming-window bound (Simulator.residency_breakdown) and the
+    campaign service's admission bill both price from.  One definition,
+    so adding or retyping a trace field moves every residency estimate
+    together."""
+    return int(sum(
+        np.dtype(np.asarray(getattr(trace, f.name)).dtype).itemsize
+        for f in dataclasses.fields(trace)))
+
+
 def residency_breakdown(*, state=None, trace=None, batch: int = 1,
                         telemetry_spec=None,
                         stream_window_bytes: "int | None" = None,

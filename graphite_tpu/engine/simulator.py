@@ -848,11 +848,11 @@ class Simulator:
             # the prefetch staging — pure arithmetic, never materialized
             # (this runs inside refusal paths on memory-constrained
             # devices, so it must not allocate what it is pricing)
-            per_record = sum(
-                np.dtype(getattr(self.trace_batch, f.name).dtype).itemsize
-                for f in dataclasses.fields(self.trace_batch))
+            from graphite_tpu.analysis.cost import trace_record_bytes
+
             stream_bytes = (2 * self.params.n_tiles
-                            * STREAM_WINDOW_RECORDS * per_record)
+                            * STREAM_WINDOW_RECORDS
+                            * trace_record_bytes(self.trace_batch))
         return residency_breakdown(
             state=state, trace=self.device_trace,
             telemetry_spec=spec, stream_window_bytes=stream_bytes)

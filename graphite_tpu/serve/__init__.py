@@ -1,0 +1,15 @@
+"""Campaign service: admission-controlled job batching over a
+fingerprint-keyed compiled-program cache (see serve/service.py)."""
+
+from graphite_tpu.serve.admission import (      # noqa: F401
+    AdmissionController, JobClass, QueueFullError,
+)
+from graphite_tpu.serve.cache import (          # noqa: F401
+    CacheEntry, ProgramCache, ProgramCacheError,
+)
+from graphite_tpu.serve.job import (            # noqa: F401
+    CLOCK_SCHEMES, Job, JobResult, STATUS_FAILED, STATUS_OK,
+)
+from graphite_tpu.serve.service import (        # noqa: F401
+    BatchReport, CampaignService,
+)

@@ -1,0 +1,234 @@
+"""Admission control: budget bin-packing + FIFO queueing for the service.
+
+The admission controller answers three questions per submitted job,
+entirely from host-side arithmetic (no tracing, no compile):
+
+ - *which program class* does it belong to?  Jobs co-batch only when
+   they provably share one compiled program: same config digest, same
+   tile count, same memory-ness, same telemetry spec, and the same
+   bucketed mailbox depth / trace length (lengths and depths round up
+   to powers of two so successive batches share one [B, T, L] shape —
+   and therefore one program-cache entry);
+
+ - *can it ever fit*?  The per-sim residency bill — state pytree +
+   padded trace rows + telemetry ring, the exact consumers
+   `analysis/cost.residency_breakdown` itemizes — is compared against
+   `hbm_budget_bytes`.  A job whose B=1 bill exceeds the budget can
+   never be admitted and is rejected IMMEDIATELY with the itemized
+   breakdown (`ResidencyBudgetError`, the round-10 refusal type);
+
+ - *how many co-batch*?  Every campaign consumer scales linearly in B,
+   so the class's batch capacity is `budget // per_sim_total`, clamped
+   to the service's `batch_size`.  No admitted batch's
+   `residency_breakdown` total can exceed the budget by construction
+   (and the SweepRunner's own pre-compile fail-fast re-proves it).
+
+Jobs that fit but not *now* wait in per-class FIFO queues under a
+global `max_pending` bound — when the queue is full, `admit` raises
+`QueueFullError` (backpressure: the caller must drain results before
+submitting more).  `next_batch` serves the class whose HEAD job is
+globally oldest, so no class starves behind a busier one (FIFO
+fairness across classes, strict FIFO within one).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+
+from graphite_tpu.serve.job import Job, config_digest
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the pending queue is at `max_pending`."""
+
+
+def _pow2_bucket(n: int, lo: int) -> int:
+    """Smallest power of two >= max(n, lo)."""
+    n = max(int(n), int(lo))
+    return 1 << (n - 1).bit_length()
+
+
+@dataclasses.dataclass
+class Pending:
+    """One queued job plus its service bookkeeping."""
+
+    job: Job
+    seq: int           # global submission order (FIFO fairness key)
+    attempts: int = 0  # failed executions so far (split/retry budget)
+
+
+class JobClass:
+    """One program class: jobs that provably share a compiled program.
+
+    A probe Simulator is built once (never run) to read the engine
+    params and the per-sim state bytes, then dropped; the class keeps
+    the per-sim residency bill, the batch capacity the budget allows,
+    and the class FIFO.
+    """
+
+    def __init__(self, key: tuple, job: Job, *, mailbox_depth: int,
+                 pad_length: int, hbm_budget_bytes: int, batch_size: int):
+        from graphite_tpu.analysis.cost import tree_bytes
+        from graphite_tpu.engine.simulator import Simulator
+
+        self.key = key
+        self.config = job.resolved_config()
+        self.mailbox_depth = int(mailbox_depth)
+        self.pad_length = int(pad_length)
+        self.fifo: "collections.deque[Pending]" = collections.deque()
+        # The probe: ONE Simulator built exactly the way the batch
+        # runner will build its per-sim program (same config, same
+        # mailbox depth), so its state pytree IS the per-sim state bill.
+        # Telemetry stays off the probe — the ring is priced separately
+        # (obs.TelemetrySpec.ring_bytes, the one size model).
+        from graphite_tpu.analysis.cost import trace_record_bytes
+
+        probe = Simulator(self.config, job.trace,
+                          mailbox_depth=self.mailbox_depth,
+                          barrier_host=False)
+        # keep only the params and the byte counts: the probe's state
+        # pytree is real device memory, and retaining one per class
+        # forever would be exactly the residency this controller
+        # exists to police
+        self.params = probe.params
+        self.telemetry = None
+        if job.telemetry is not None:
+            self.telemetry = job.telemetry.resolve(self.params)
+        per_sim = {
+            "state": int(tree_bytes(probe.state)),
+            "trace": (self.params.n_tiles * self.pad_length
+                      * trace_record_bytes(job.trace)),
+        }
+        if self.telemetry is not None:
+            per_sim["telemetry"] = int(self.telemetry.ring_bytes())
+        self.per_sim_bytes = per_sim
+        self.per_sim_total = sum(per_sim.values())
+        if hbm_budget_bytes:
+            self.batch_cap = min(
+                int(batch_size),
+                int(hbm_budget_bytes) // max(self.per_sim_total, 1))
+        else:
+            self.batch_cap = int(batch_size)
+
+    @property
+    def n_tiles(self) -> int:
+        return int(self.params.n_tiles)
+
+    def breakdown(self, batch: int = 1) -> "dict[str, int]":
+        """The itemized residency bill for a `batch`-wide campaign of
+        this class — consumer-for-consumer the dict
+        `SweepRunner.residency_breakdown` computes for the real batch
+        (every consumer scales linearly in B)."""
+        out = {k: v * int(batch) for k, v in self.per_sim_bytes.items()}
+        out["total"] = sum(out.values())
+        return out
+
+
+class AdmissionController:
+    """Classify, budget-check, and queue jobs; form FIFO-fair batches."""
+
+    def __init__(self, *, hbm_budget_bytes: int = 0, batch_size: int = 4,
+                 max_pending: int = 1024):
+        if int(batch_size) < 1:
+            raise ValueError("batch_size must be >= 1")
+        self.hbm_budget_bytes = int(hbm_budget_bytes)
+        self.batch_size = int(batch_size)
+        self.max_pending = int(max_pending)
+        self.classes: "dict[tuple, JobClass]" = {}
+        # pre-formed batches (split/retry requeues) served before any
+        # new batch forms — without this, a split's halves would simply
+        # re-coalesce into the failing batch on the next pop
+        self._ready: "collections.deque[tuple]" = collections.deque()
+        self._seq = 0
+        self._depth = 0
+
+    @property
+    def queue_depth(self) -> int:
+        return self._depth
+
+    def class_key(self, job: Job) -> tuple:
+        """The program-class key: everything that changes the compiled
+        artifact and is knowable without tracing.  Traced knobs are
+        deliberately absent (they share the program — that is the whole
+        round-7 point); the cache's fingerprint check is the proof the
+        key was sufficient."""
+        from graphite_tpu.engine.simulator import auto_mailbox_depth
+
+        depth = _pow2_bucket(auto_mailbox_depth(job.trace), 2)
+        length = _pow2_bucket(job.trace.length, 16)
+        tel = job.telemetry
+        tel_key = None if tel is None else (
+            int(tel.sample_interval_ps), int(tel.n_samples), tel.series)
+        return (config_digest(job.resolved_config()), job.n_tiles,
+                job.has_mem_trace(), depth, length, tel_key)
+
+    def admit(self, job: Job) -> "tuple[JobClass, Pending]":
+        """Queue `job` (validated by the caller) or refuse it.
+
+        Raises `analysis.cost.ResidencyBudgetError` — with the itemized
+        per-consumer breakdown attached as `.breakdown` — when the job
+        can NEVER fit the per-device budget, and `QueueFullError` when
+        the pending queue is at `max_pending` (backpressure)."""
+        from graphite_tpu.analysis.cost import (
+            ResidencyBudgetError, format_breakdown,
+        )
+
+        if self._depth >= self.max_pending:
+            raise QueueFullError(
+                f"pending queue is full ({self._depth} >= max_pending="
+                f"{self.max_pending}) — drain results before submitting "
+                "more")
+        key = self.class_key(job)
+        cls = self.classes.get(key)
+        if cls is None:
+            cls = JobClass(key, job,
+                           mailbox_depth=key[3], pad_length=key[4],
+                           hbm_budget_bytes=self.hbm_budget_bytes,
+                           batch_size=self.batch_size)
+            self.classes[key] = cls
+        if self.hbm_budget_bytes and cls.batch_cap < 1:
+            bd = cls.breakdown(1)
+            err = ResidencyBudgetError(
+                f"job {job.job_id!r} can never fit hbm_budget_bytes="
+                f"{self.hbm_budget_bytes}: one sim alone costs "
+                + format_breakdown(bd)
+                + " — shrink the trace/telemetry ring or raise the "
+                "budget")
+            err.breakdown = bd
+            raise err
+        pending = Pending(job=job, seq=self._seq)
+        self._seq += 1
+        cls.fifo.append(pending)
+        self._depth += 1
+        return cls, pending
+
+    def requeue_batch(self, cls: JobClass,
+                      pendings: "list[Pending]") -> None:
+        """Requeue a split half (or a lone retry) as a PRE-FORMED batch
+        at the head of the ready line: it must re-run at its reduced
+        size — returning the jobs to the class FIFO would let the next
+        pop re-coalesce the exact batch that just failed.  The jobs
+        were admitted once, so max_pending does not apply again
+        (refusing here would drop accepted work)."""
+        self._ready.appendleft((cls, list(pendings)))
+        self._depth += len(pendings)
+
+    def next_batch(self) -> "tuple[JobClass, list[Pending]] | None":
+        """Pop the next batch: requeued (split/retry) batches first —
+        they hold the globally oldest jobs — then the class whose HEAD
+        job is globally oldest (no class starves), up to the class's
+        budget-derived batch capacity, strict FIFO within the class."""
+        if self._ready:
+            cls, batch = self._ready.popleft()
+            self._depth -= len(batch)
+            return cls, batch
+        waiting = [c for c in self.classes.values() if c.fifo]
+        if not waiting:
+            return None
+        cls = min(waiting, key=lambda c: c.fifo[0].seq)
+        batch = []
+        while cls.fifo and len(batch) < cls.batch_cap:
+            batch.append(cls.fifo.popleft())
+        self._depth -= len(batch)
+        return cls, batch

@@ -1,0 +1,432 @@
+"""The campaign service: admission-controlled job batching over a
+fingerprint-keyed compiled-program cache.
+
+This is the piece that *serves* every amortization primitive the repo
+already has: jobs (`serve/job.py`) are validated up front, bin-packed
+into same-program batches by the admission controller
+(`serve/admission.py` — `residency_breakdown` arithmetic against a
+per-device `hbm_budget_bytes`), executed as vmapped `SweepRunner`
+campaigns through the LRU compiled-program cache (`serve/cache.py` —
+keyed by program class, proven by `analysis/identity` fingerprints
+resolved through an `analysis/registry`-style record set), and demuxed
+back into per-job `SimResults` + telemetry envelopes as each batch
+completes.
+
+Graceful degradation is structural, not best-effort:
+
+ - a job that can never fit the budget is rejected at submit with the
+   itemized breakdown; a full queue raises backpressure;
+ - batches are padded to the class's FIXED capacity (replicating the
+   first job — semantically a re-run, so padding adds no new failure
+   modes) so every batch of a class reuses ONE compiled shape; the
+   padded tail is masked out of the result stream;
+ - a failed batch (deadlock, mailbox overflow, max_quanta timeout)
+   SPLITS in half and re-enqueues at the front of its class FIFO —
+   halving isolates the offending job in log2(B) steps instead of
+   poisoning the queue; a job that fails ALONE is retried up to
+   `max_attempts` and then reported as a failed envelope.  Every
+   failure increments each member's attempt counter, so the
+   split/retry recursion provably terminates.
+
+The bit-exact sequential path (`Simulator.run`) remains the equivalence
+oracle: `tools/regress.py --smoke`'s serve rung replays a mixed-
+geometry job set both ways and requires identical results + telemetry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from graphite_tpu.serve.admission import AdmissionController, JobClass, \
+    Pending, QueueFullError
+from graphite_tpu.serve.cache import CacheEntry, ProgramCache, \
+    ProgramCacheError
+from graphite_tpu.serve.job import (
+    Job, JobResult, STATUS_FAILED, STATUS_OK,
+)
+
+
+@dataclasses.dataclass
+class BatchReport:
+    """One executed (or failed) batch's bookkeeping row."""
+
+    batch_id: int
+    class_name: str
+    n_tiles: int
+    job_ids: "list[str]"
+    n_jobs: int                # real jobs (pre-padding)
+    batch_cap: int             # the padded B the program ran at
+    occupancy: float           # n_jobs / batch_cap
+    residency_total: int       # the admitted layout's residency bill
+    cache_hit: bool
+    ok: bool
+    wall_s: float
+    error: "str | None" = None
+
+
+class CampaignService:
+    """Persistent front end: submit jobs, drain result envelopes.
+
+    `hbm_budget_bytes`: per-device admission budget (0 = off);
+    `batch_size`: max sims per campaign batch (the class capacity is
+    `min(batch_size, budget // per_sim_bytes)`); `cache_bytes`: program
+    cache budget for byte-accounted LRU eviction (0 = unbounded);
+    `max_pending`: queue depth before submit raises backpressure;
+    `max_attempts`: per-job failure budget across splits/retries;
+    `max_quanta`: the batch programs' quantum bound (part of the
+    compiled program, hence of the cache key); `verify_hits`: re-lower
+    every cache hit and re-prove fingerprint equality (a retrace, never
+    a recompile — the belt-and-braces mode the regress rung runs);
+    `validate`: run `trace/validate.py` on every submitted trace;
+    `max_history`: newest result envelopes / batch reports retained on
+    the service (`results` / `batch_log`) — streaming consumers use
+    `drain()`; counters stay exact regardless.
+    """
+
+    def __init__(self, *, hbm_budget_bytes: int = 0, batch_size: int = 4,
+                 cache_bytes: int = 0, max_pending: int = 1024,
+                 max_attempts: int = 3, max_quanta: int = 1_000_000,
+                 verify_hits: bool = False, validate: bool = True,
+                 shard_batch: "bool | None" = False,
+                 max_history: int = 4096):
+        import collections
+
+        self.admission = AdmissionController(
+            hbm_budget_bytes=hbm_budget_bytes, batch_size=batch_size,
+            max_pending=max_pending)
+        self.cache = ProgramCache(cache_bytes)
+        self.registry: "dict[str, object]" = {}   # name -> ProgramRecord
+        self.hbm_budget_bytes = int(hbm_budget_bytes)
+        self.max_attempts = int(max_attempts)
+        self.max_quanta = int(max_quanta)
+        self.verify_hits = bool(verify_hits)
+        self.validate = bool(validate)
+        self.shard_batch = shard_batch
+        # retention is BOUNDED (`max_history` newest entries): envelopes
+        # stream out through drain(); keeping every SimResults +
+        # BatchReport forever would grow a persistent service without
+        # bound.  Counters stay exact over all time (running sums).
+        self.batch_log: "collections.deque[BatchReport]" = \
+            collections.deque(maxlen=int(max_history))
+        self._completed: "collections.deque[JobResult]" = \
+            collections.deque(maxlen=int(max_history))
+        self._occ_sum = 0.0
+        self._occ_batches = 0
+        self._next_batch_id = 0
+        self._last_residency = 0
+        self._last_cache_hit = False
+        self._counts = {
+            "submitted": 0, "completed": 0, "failed": 0, "rejected": 0,
+            "backpressure": 0, "batches": 0, "splits": 0, "retries": 0,
+            "cache_hits": 0, "compile_count": 0,
+        }
+        self._execute_wall_s = 0.0
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, job: Job) -> int:
+        """Validate and queue one job; returns its submission sequence
+        number.  Raises `TraceValidationError`/`ValueError` on a
+        malformed job, `analysis.cost.ResidencyBudgetError` (with
+        `.breakdown`) on a job that can never fit, `QueueFullError`
+        under backpressure."""
+        try:
+            job.validate(validate_trace=self.validate)
+            cls, pending = self.admission.admit(job)
+        except QueueFullError:
+            # backpressure is NOT a rejection: the job is fine, the
+            # queue is full — the caller drains and resubmits, and the
+            # later successful submit must keep the accounting identity
+            # submitted == completed + failed (+ rejected never counts
+            # a job that eventually ran)
+            self._counts["backpressure"] += 1
+            raise
+        except Exception:
+            self._counts["rejected"] += 1
+            raise
+        self._counts["submitted"] += 1
+        return pending.seq
+
+    @property
+    def queue_depth(self) -> int:
+        return self.admission.queue_depth
+
+    # -- scheduling ------------------------------------------------------
+
+    def step(self) -> "list[JobResult]":
+        """Form and run ONE batch (the oldest-head class); returns the
+        envelopes it completed (empty when a failed batch split and
+        re-enqueued, or when the queue is idle)."""
+        nxt = self.admission.next_batch()
+        if nxt is None:
+            return []
+        cls, pendings = nxt
+        return self._run_batch(cls, pendings)
+
+    def drain(self):
+        """Generator: run batches until the queue is idle, yielding
+        result envelopes as each batch completes (the streaming read
+        the CLI prints line-by-line)."""
+        while self.admission.queue_depth:
+            for res in self.step():
+                yield res
+
+    def run_all(self) -> "list[JobResult]":
+        return list(self.drain())
+
+    @property
+    def results(self) -> "list[JobResult]":
+        """Every envelope completed so far (streaming callers use
+        `drain()` instead)."""
+        return list(self._completed)
+
+    # -- batch execution -------------------------------------------------
+
+    def _run_batch(self, cls: JobClass,
+                   pendings: "list[Pending]") -> "list[JobResult]":
+        from graphite_tpu.engine.simulator import (
+            DeadlockError, MailboxOverflowError,
+        )
+
+        self._counts["batches"] += 1
+        batch_id = self._next_batch_id
+        self._next_batch_id += 1
+        t0 = time.perf_counter()
+        try:
+            results = self._execute(cls, pendings, batch_id)
+        except ProgramCacheError as e:
+            # identity failures are NOT load: retrying cannot make a
+            # mismatched artifact provable — surface them.  The popped
+            # jobs still get failed envelopes first, so the accounting
+            # (submitted == completed + failed + rejected) survives the
+            # raise and no admitted work silently vanishes
+            for p in pendings:
+                p.attempts += 1
+                self._completed.append(JobResult(
+                    job_id=p.job.job_id, status=STATUS_FAILED,
+                    error=f"ProgramCacheError: {e}", batch_id=batch_id,
+                    attempts=p.attempts, seed=p.job.seed))
+                self._counts["failed"] += 1
+            raise
+        except (DeadlockError, MailboxOverflowError, RuntimeError) as e:
+            wall = time.perf_counter() - t0
+            self._execute_wall_s += wall
+            return self._handle_failure(cls, pendings, batch_id, e, wall)
+        wall = time.perf_counter() - t0
+        self._execute_wall_s += wall
+        self.batch_log.append(BatchReport(
+            batch_id=batch_id, class_name=self._class_name(cls),
+            n_tiles=cls.n_tiles,
+            job_ids=[p.job.job_id for p in pendings],
+            n_jobs=len(pendings), batch_cap=cls.batch_cap,
+            occupancy=len(pendings) / cls.batch_cap,
+            residency_total=self._last_residency,
+            cache_hit=self._last_cache_hit, ok=True, wall_s=wall))
+        self._occ_sum += len(pendings) / cls.batch_cap
+        self._occ_batches += 1
+        self._completed.extend(results)
+        self._counts["completed"] += len(results)
+        return results
+
+    def _handle_failure(self, cls, pendings, batch_id, exc, wall
+                        ) -> "list[JobResult]":
+        """Split-and-requeue (n > 1) or retry/fail (n == 1); every
+        member's attempt counter moves, so the recursion terminates."""
+        msg = f"{type(exc).__name__}: {exc}"
+        self.batch_log.append(BatchReport(
+            batch_id=batch_id, class_name=self._class_name(cls),
+            n_tiles=cls.n_tiles,
+            job_ids=[p.job.job_id for p in pendings],
+            n_jobs=len(pendings), batch_cap=cls.batch_cap,
+            occupancy=len(pendings) / cls.batch_cap,
+            residency_total=self._last_residency,
+            cache_hit=self._last_cache_hit,
+            ok=False, wall_s=wall, error=msg))
+        for p in pendings:
+            p.attempts += 1
+        if len(pendings) > 1:
+            # halving isolates the offender in ~log2(B) steps; the
+            # halves requeue as PRE-FORMED batches (head of the ready
+            # line, first half first) so they re-run at their reduced
+            # size — and still pad to the class capacity, so every
+            # retry is a cache hit on the one compiled program
+            mid = (len(pendings) + 1) // 2
+            self.admission.requeue_batch(cls, pendings[mid:])
+            self.admission.requeue_batch(cls, pendings[:mid])
+            self._counts["splits"] += 1
+            self._counts["retries"] += 1
+            return []
+        p = pendings[0]
+        if p.attempts >= self.max_attempts:
+            res = JobResult(job_id=p.job.job_id, status=STATUS_FAILED,
+                            error=msg, batch_id=batch_id,
+                            attempts=p.attempts, seed=p.job.seed)
+            self._completed.append(res)
+            self._counts["failed"] += 1
+            return [res]
+        self.admission.requeue_batch(cls, [p])
+        self._counts["retries"] += 1
+        return []
+
+    def _class_name(self, cls: JobClass) -> str:
+        import hashlib
+
+        digest = cls.key[0][:8]
+        tel = "-tel" if cls.telemetry is not None else ""
+        # the key hash keeps the name INJECTIVE over class keys: the
+        # readable fields alone miss key components (mem-ness,
+        # telemetry spec details), and two distinct classes colliding
+        # on one registry name would read as an identity violation
+        khash = hashlib.sha256(repr(cls.key).encode()).hexdigest()[:8]
+        return (f"serve-{digest}-t{cls.n_tiles}-b{cls.batch_cap}"
+                f"-l{cls.pad_length}-d{cls.mailbox_depth}{tel}-k{khash}")
+
+    def _execute(self, cls: JobClass, pendings: "list[Pending]",
+                 batch_id: int) -> "list[JobResult]":
+        """Pack, cache-resolve, run, and demux one batch.  Raises the
+        engine's own failure types on a bad batch — `_run_batch` owns
+        the split/retry policy."""
+        from graphite_tpu.sweep.pack import pack_traces
+        from graphite_tpu.sweep.runner import SweepRunner
+
+        jobs = [p.job for p in pendings]
+        n, B = len(jobs), cls.batch_cap
+        # per-batch stats reset FIRST: a failure before they are
+        # recomputed must not report the previous batch's numbers
+        self._last_residency = 0
+        self._last_cache_hit = False
+        # pad to the class's FIXED capacity with replicas of job 0 so
+        # every batch of this class shares one [B, T, L] program shape;
+        # the replicas' rows are dropped below (the tail mask)
+        traces = [j.trace for j in jobs] + [jobs[0].trace] * (B - n)
+        points = [dict(j.knobs) for j in jobs] \
+            + [dict(jobs[0].knobs)] * (B - n)
+        pack = pack_traces(traces, validate=False,
+                           pad_length=cls.pad_length)
+        # the budget is passed as an INT always: 0 explicitly disables
+        # the runner's fail-fast (None would fall back to the config's
+        # own `[general] hbm_budget_bytes`, refusing batches the
+        # service-level admission never checked against)
+        runner = SweepRunner(
+            cls.config, pack, points,
+            mailbox_depth=cls.mailbox_depth,
+            shard_batch=self.shard_batch,
+            hbm_budget_bytes=self.hbm_budget_bytes,
+            telemetry=cls.telemetry)
+        self._last_residency = int(
+            runner.residency_breakdown()["total"])
+        if self.hbm_budget_bytes \
+                and self._last_residency > self.hbm_budget_bytes:
+            # unreachable by construction (admission sized batch_cap
+            # from the same arithmetic and the runner's own fail-fast
+            # already re-checked) — a trip here is a real bug, not load
+            raise AssertionError(
+                f"admitted batch residency {self._last_residency} "
+                f"exceeds hbm_budget_bytes={self.hbm_budget_bytes}")
+        entry = self._resolve_program(cls, runner, B)
+        out = runner.run(max_quanta=self.max_quanta)
+        results = []
+        for b in range(n):   # the padded tail [n:B] never leaves here
+            p = pendings[b]
+            tl = None if out.timelines is None else out.timelines[b]
+            results.append(JobResult(
+                job_id=p.job.job_id, status=STATUS_OK,
+                results=out.results[b], telemetry=tl,
+                batch_id=batch_id, attempts=p.attempts + 1,
+                seed=p.job.seed, knob_point=dict(p.job.knobs),
+                n_quanta=int(out.n_quanta[b]),
+                n_iterations=int(out.n_iterations[b])))
+        return results
+
+    # -- program cache ---------------------------------------------------
+
+    def _resolve_program(self, cls: JobClass, runner, B: int
+                         ) -> CacheEntry:
+        """Serve the batch through the compiled-program cache.
+
+        MISS: lower the campaign, fingerprint it
+        (`analysis/identity.fingerprint`), resolve the name through the
+        service registry (a registry-mismatched fingerprint at insert
+        time errors LOUDLY — `ProgramCacheError`), register + insert,
+        and hand the runner its own fresh jit (the one compile).
+        HIT: resolve the stored record through the registry, optionally
+        re-lower and re-prove fingerprint equality (`verify_hits` — a
+        retrace, never a recompile), and inject the cached jitted
+        callable into the fresh runner, so the batch executes the
+        PROVABLY-same compiled artifact with zero new compiles."""
+        from graphite_tpu.analysis.identity import fingerprint
+        from graphite_tpu.analysis.registry import ProgramRecord
+
+        name = self._class_name(cls)
+        key = cls.key + (B, self.max_quanta)
+        shape_sig = (B, cls.n_tiles, cls.pad_length)
+        entry = self.cache.get(key, shape_sig)
+        if entry is not None:
+            reg = self.registry.get(entry.name)
+            if reg is None or reg.fingerprint != entry.record.fingerprint:
+                raise ProgramCacheError(
+                    f"cache entry {entry.name!r} no longer resolves "
+                    "through the registry — refusing to serve an "
+                    "unprovable artifact")
+            if self.verify_hits:
+                closed, _ = runner.lower(self.max_quanta)
+                fp = fingerprint(closed)
+                if fp != entry.record.fingerprint:
+                    raise ProgramCacheError(
+                        f"cache hit verification failed for "
+                        f"{entry.name!r}: this batch lowers to "
+                        f"{fp[:24]}... but the cached program is "
+                        f"{entry.record.fingerprint[:24]}... — the "
+                        "class key admitted a different program")
+            runner._runner = entry.jitted
+            runner._runner_max_quanta = entry.max_quanta
+            self._counts["cache_hits"] += 1
+            self._last_cache_hit = True
+            return entry
+        self._last_cache_hit = False
+        closed, _ = runner.lower(self.max_quanta)
+        fp = fingerprint(closed)
+        record = ProgramRecord(name=name, fingerprint=fp,
+                               tiles=cls.n_tiles)
+        reg = self.registry.get(name)
+        if reg is not None and reg.fingerprint != fp:
+            raise ProgramCacheError(
+                f"program {name!r} lowered to fingerprint {fp[:24]}... "
+                f"but is registered as {reg.fingerprint[:24]}... — "
+                "refusing the insert: the same class key must not "
+                "silently serve two different artifacts")
+        self.registry[name] = record
+        jitted = runner._get_runner(self.max_quanta)
+        entry = CacheEntry(
+            name=name, record=record, jitted=jitted,
+            max_quanta=self.max_quanta,
+            nbytes=self._last_residency, shape_sig=shape_sig)
+        self.cache.put(key, entry, expect_fingerprint=fp)
+        self._counts["compile_count"] += 1
+        return entry
+
+    # -- observability ---------------------------------------------------
+
+    @property
+    def counters(self) -> dict:
+        """Service counters: queue depth, batch occupancy, cache hit
+        rate, compile count, jobs/s — the inference-stack dashboard."""
+        total_lookups = (self._counts["cache_hits"]
+                         + self._counts["compile_count"])
+        return {
+            **self._counts,
+            "queue_depth": self.admission.queue_depth,
+            "mean_batch_occupancy": (
+                self._occ_sum / self._occ_batches
+                if self._occ_batches else 0.0),
+            "cache_hit_rate": (
+                self._counts["cache_hits"] / total_lookups
+                if total_lookups else 0.0),
+            "cache_entries": len(self.cache),
+            "cache_bytes": self.cache.total_bytes,
+            "cache_evictions": self.cache.evictions,
+            "jobs_per_s": (
+                self._counts["completed"] / self._execute_wall_s
+                if self._execute_wall_s > 0 else 0.0),
+        }

@@ -82,7 +82,8 @@ class PackedTraces:
 
 def pack_traces(batches: "list[TraceBatch]",
                 seeds: "list[int] | None" = None, *,
-                validate: bool = True) -> PackedTraces:
+                validate: bool = True,
+                pad_length: "int | None" = None) -> PackedTraces:
     """Pad B same-geometry TraceBatches to a common [B, T, L] layout.
 
     Every sim is statically validated first (trace/validate.py:
@@ -91,7 +92,14 @@ def pack_traces(batches: "list[TraceBatch]",
     `TraceValidationError` instead of padding silently and deadlocking
     — or crashing the TPU worker — minutes into the compiled run.
     `validate=False` skips the pass (e.g. deliberately pathological
-    test traces)."""
+    test traces).
+
+    `pad_length` pads every sim to a FIXED record length (>= the
+    longest sim) instead of the batch maximum — the campaign service
+    buckets lengths this way so successive batches share one compiled
+    [B, T, L] shape (and therefore one cache entry) even when their
+    longest traces differ.  The extra tail is the same inert NOP
+    padding as ordinary length equalization."""
     if not batches:
         raise ValueError("pack_traces needs at least one trace")
     if validate:
@@ -118,6 +126,12 @@ def pack_traces(batches: "list[TraceBatch]",
     if seeds is not None and len(seeds) != len(batches):
         raise ValueError("seeds length != number of traces")
     L = max(b.length for b in batches)
+    if pad_length is not None:
+        if int(pad_length) < L:
+            raise ValueError(
+                f"pad_length={pad_length} is shorter than the longest "
+                f"trace ({L} records) — padding cannot truncate")
+        L = int(pad_length)
     B = len(batches)
     out = {}
     for f in PackedTraces._TRACE_FIELDS:

@@ -265,6 +265,64 @@ def smoke(tiles: int = 16) -> int:
                 print(f"    {f}")
             failures += 1
 
+    # 8) campaign service (round 13, serve/): a MIXED-GEOMETRY job set
+    #    through the admission-controlled service — batched, padded,
+    #    cache-served with hit verification on (every hit re-proves the
+    #    program fingerprint) — must be bit-identical (results + demuxed
+    #    telemetry) to sequential Simulator runs, and each program class
+    #    must pay exactly ONE compile.
+    from graphite_tpu.serve import CampaignService, Job
+
+    tel_sv = TelemetrySpec(sample_interval_ps=1_000_000, n_samples=32)
+    sc4 = SimConfig(ConfigFile.from_string(config_text(
+        4, shared_mem=True, clock_scheme="lax")))
+    sc8 = SimConfig(ConfigFile.from_string(config_text(
+        8, shared_mem=True, clock_scheme="lax")))
+
+    def _mkt(tiles, seed):
+        return synthetic.memory_stress_trace(
+            tiles, n_accesses=12, working_set_bytes=1 << 12,
+            write_fraction=0.4, shared_fraction=0.5, seed=seed)
+
+    svc = CampaignService(batch_size=2, max_quanta=200_000,
+                          verify_hits=True)
+    serve_jobs = []
+    for i, s in enumerate((1, 2, 3)):
+        serve_jobs.append(Job(f"t4-{i}", sc4, _mkt(4, s), seed=s))
+        serve_jobs.append(Job(f"t8-{i}", sc8, _mkt(8, s), seed=s,
+                              telemetry=tel_sv))
+    for job in serve_jobs:
+        svc.submit(job)
+    served = {r.job_id: r for r in svc.drain()}
+    for job in serve_jobs:
+        sc_j = sc4 if job.n_tiles == 4 else sc8
+        if job.telemetry is not None:
+            # the vmapped campaign runs gates-off (SweepRunner default),
+            # so the telemetry oracle's skip_* series must too
+            seq = Simulator(sc_j, job.trace, phase_gate=False,
+                            mem_gate_bytes=0, telemetry=tel_sv).run()
+        else:
+            seq = Simulator(sc_j, job.trace).run()
+        got = served[job.job_id]
+        failures += _compare(f"serve {job.job_id} vs sequential",
+                             got.results, seq)
+        if job.telemetry is not None:
+            ok = (got.telemetry.n_total == seq.telemetry.n_total
+                  and np.array_equal(got.telemetry.data,
+                                     seq.telemetry.data))
+            print(f"{f'serve {job.job_id} timeline vs sequential':44} "
+                  f"{'PASS' if ok else 'FAIL'}")
+            failures += 0 if ok else 1
+    c = svc.counters
+    ok = (c["compile_count"] == 2 and c["cache_hits"] == 2
+          and c["failed"] == 0
+          and len({b.n_tiles for b in svc.batch_log}) == 2)
+    print(f"{'serve 2 classes, 1 compile each':44} "
+          f"{'PASS' if ok else 'FAIL'}"
+          + ("" if ok else f"  (compiles={c['compile_count']} "
+             f"hits={c['cache_hits']} failed={c['failed']})"))
+    failures += 0 if ok else 1
+
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
     return 1 if failures else 0
 

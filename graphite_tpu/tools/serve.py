@@ -1,0 +1,201 @@
+"""Campaign-service CLI: JSON-lines jobs in -> JSON-lines results out.
+
+The front-end wrapper over `serve.CampaignService`: each input line is
+one job spec, each output line one result envelope (emitted as its
+batch completes — the stream a long-running caller tails), plus one
+trailing summary line with the service counters (queue depth, batch
+occupancy, cache hit rate, compile count, jobs/s).
+
+Job-spec line schema (all fields except `id` optional):
+
+  {"id": "j0",                     // job id echoed into the result
+   "workload": "memstress",        // memstress | a trace/benchmarks name
+   "tiles": 16, "seed": 7,
+   "accesses": 24,                 // memstress accesses per tile
+   "protocol": "pr_l1_pr_l2_dram_directory_msi",
+   "network": "emesh_hop_counter",
+   "knobs": {"dram_latency_ns": 120, ...},   // traced sweep knobs
+   "clock_scheme": "lax_barrier",  // lax_barrier | lax | lax_p2p
+   "telemetry": {"sample_interval_ps": 1000000, "n_samples": 64}}
+
+Usage:
+  python -m graphite_tpu.tools.serve --jobs jobs.jsonl --budget-bytes 2e9
+  cat jobs.jsonl | python -m graphite_tpu.tools.serve --batch-size 8
+  python -m graphite_tpu.tools.serve --dryrun    # tiny CPU smoke, no input
+
+`--dryrun` pins JAX to CPU and serves a built-in mixed-geometry,
+mixed-knob demo job set — the smoke shape `tools/regress.py --smoke`'s
+serve rung also exercises.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+DRYRUN_JOBS = [
+    {"id": "d0", "tiles": 4, "seed": 1, "accesses": 10},
+    {"id": "d1", "tiles": 4, "seed": 2, "accesses": 10,
+     "knobs": {"dram_latency_ns": 150}},
+    {"id": "d2", "tiles": 4, "seed": 3, "accesses": 10},
+    {"id": "d3", "tiles": 8, "seed": 4, "accesses": 10},
+    {"id": "d4", "tiles": 4, "seed": 5, "accesses": 10,
+     "knobs": {"hop_latency_cycles": 3}},
+]
+
+
+def build_job(spec: dict, config_cache: dict):
+    """One input line -> a serve.Job (config objects cached per
+    geometry/protocol/network so same-shaped jobs share a digest-equal
+    config and co-batch)."""
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.obs import TelemetrySpec
+    from graphite_tpu.serve import Job
+    from graphite_tpu.tools._template import config_text
+    from graphite_tpu.trace import synthetic
+
+    if "id" not in spec:
+        raise ValueError("job spec needs an \"id\" field")
+    tiles = int(spec.get("tiles", 16))
+    workload = spec.get("workload", "memstress")
+    seed = int(spec.get("seed", 7))
+    protocol = spec.get("protocol", "pr_l1_pr_l2_dram_directory_msi")
+    network = spec.get("network", "emesh_hop_counter")
+    shared = workload == "memstress"
+    ckey = (tiles, protocol, network, shared)
+    sc = config_cache.get(ckey)
+    if sc is None:
+        sc = SimConfig(ConfigFile.from_string(config_text(
+            tiles, shared_mem=shared, protocol=protocol,
+            network=network, clock_scheme="lax_barrier")))
+        config_cache[ckey] = sc
+    if workload == "memstress":
+        trace = synthetic.memory_stress_trace(
+            tiles, n_accesses=int(spec.get("accesses", 24)),
+            working_set_bytes=1 << 13, write_fraction=0.4,
+            shared_fraction=0.5, seed=seed)
+    else:
+        from graphite_tpu.trace.benchmarks import BENCHMARKS
+
+        if workload not in BENCHMARKS:
+            raise ValueError(
+                f"unknown workload {workload!r} (memstress or: "
+                f"{', '.join(sorted(BENCHMARKS))})")
+        trace = BENCHMARKS[workload](tiles)
+    telemetry = None
+    if spec.get("telemetry"):
+        t = spec["telemetry"]
+        telemetry = TelemetrySpec(
+            sample_interval_ps=int(t["sample_interval_ps"]),
+            n_samples=int(t.get("n_samples", 256)),
+            series=tuple(t["series"]) if t.get("series") else None)
+    return Job(job_id=str(spec["id"]), config=sc, trace=trace,
+               knobs=dict(spec.get("knobs", {})), telemetry=telemetry,
+               seed=seed, clock_scheme=spec.get("clock_scheme"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="campaign service: JSON-lines jobs in, JSON-lines "
+        "results out")
+    ap.add_argument("--jobs", help="job-spec JSON-lines file (default: "
+                    "stdin)")
+    ap.add_argument("--budget-bytes", type=float, default=0,
+                    help="per-device hbm_budget_bytes admission budget "
+                    "(0 = off)")
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--cache-bytes", type=float, default=0,
+                    help="program-cache eviction budget (0 = unbounded)")
+    ap.add_argument("--max-pending", type=int, default=1024)
+    ap.add_argument("--max-quanta", type=int, default=1_000_000)
+    ap.add_argument("--verify-hits", action="store_true",
+                    help="re-lower every cache hit and re-prove "
+                    "fingerprint equality (retrace, never recompile)")
+    ap.add_argument("--dryrun", action="store_true",
+                    help="CPU smoke: force JAX_PLATFORMS=cpu and serve "
+                    "a built-in mixed demo job set")
+    args = ap.parse_args(argv)
+
+    if args.dryrun:
+        # must land before jax initializes its backends
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import graphite_tpu  # noqa: F401  (x64)
+
+    from graphite_tpu.analysis.cost import ResidencyBudgetError
+    from graphite_tpu.serve import CampaignService, QueueFullError
+    from graphite_tpu.trace.validate import TraceValidationError
+
+    failures = 0
+    if args.dryrun:
+        specs = list(DRYRUN_JOBS)
+    else:
+        fh = open(args.jobs) if args.jobs else sys.stdin
+        specs = []
+        for lineno, line in enumerate(fh, 1):
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+            try:
+                specs.append(json.loads(line))
+            except ValueError as e:
+                # one bad line rejects that line, never the stream
+                failures += 1
+                print(json.dumps({"line": lineno, "status": "rejected",
+                                  "error": f"bad JSON: {e}"}))
+        if args.jobs:
+            fh.close()
+
+    service = CampaignService(
+        hbm_budget_bytes=int(args.budget_bytes),
+        batch_size=args.batch_size,
+        cache_bytes=int(args.cache_bytes),
+        max_pending=args.max_pending,
+        max_quanta=args.max_quanta,
+        verify_hits=args.verify_hits)
+
+    config_cache: dict = {}
+    t0 = time.perf_counter()
+    # submit with per-job drain on backpressure: a full queue runs a
+    # batch (streaming its results) instead of dropping the job
+    for spec in specs:
+        try:
+            job = build_job(spec, config_cache)
+        except (ValueError, KeyError) as e:
+            failures += 1
+            print(json.dumps({"job": spec.get("id"), "status": "rejected",
+                              "error": f"bad spec: {e}"}))
+            continue
+        while True:
+            try:
+                service.submit(job)
+                break
+            except QueueFullError:
+                for res in service.step():
+                    print(json.dumps(res.to_json()), flush=True)
+            except (ResidencyBudgetError, TraceValidationError,
+                    ValueError) as e:
+                failures += 1
+                print(json.dumps({"job": job.job_id,
+                                  "status": "rejected",
+                                  "error": str(e)}))
+                break
+    for res in service.drain():
+        print(json.dumps(res.to_json()), flush=True)
+    counters = service.counters
+    failures += counters["failed"]
+    print(json.dumps({
+        "summary": True,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        **{k: (round(v, 4) if isinstance(v, float) else v)
+           for k, v in counters.items()},
+        "dryrun": bool(args.dryrun),
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
