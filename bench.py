@@ -399,6 +399,31 @@ scheme = lax
             "sequential_jobs_per_s": round(sv_jobs / seq_wall, 3),
         })
 
+        # Observability overhead (round 14, obs/ host side): the SAME
+        # job stream through a service with span tracing + the metrics
+        # registry on — so the "observability is ~free" claim is
+        # measured, not asserted.  Both runs are compile-inclusive
+        # (each service pays its one compile), so the ratio compares
+        # like with like.  Skippable via BENCH_OBS=0.
+        if os.environ.get("BENCH_OBS", "1") != "0":
+            service_t = CampaignService(batch_size=sv_batch,
+                                        tracing=True)
+            t0 = time.perf_counter()
+            for job in jobs:
+                service_t.submit(job)
+            served_t = service_t.run_all()
+            traced_wall = time.perf_counter() - t0
+            assert len(served_t) == sv_jobs and all(r.ok for r in served_t)
+            dwell = service_t.metrics["queue_dwell_seconds"]
+            companions.update({
+                "serve_jobs_per_s_traced": round(
+                    sv_jobs / traced_wall, 3),
+                "obs_overhead_pct": round(
+                    100 * (traced_wall / serve_wall - 1), 2),
+                "obs_spans": len(service_t.tracer.spans),
+                "obs_queue_dwell_p90_s": dwell.quantile(0.9),
+            })
+
     # Static cost-model trajectory (round 12): the audited gated-MSI
     # program's per-iteration kernel/byte proxy and its per-phase/base
     # split (analysis/cost.py — the SAME numbers BUDGETS.json gates), so

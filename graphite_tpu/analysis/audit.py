@@ -68,6 +68,10 @@ class ProgramSpec:
     # scanned against the canonical dense spec's ring sig)
     expect_telemetry: bool = False
     telemetry_sig: "tuple | None" = None   # ((S, n_series), dtype)
+    # additional forbidden ring avals for telemetry-OFF programs
+    # (round 14: the dense-plus-energy ring, one series wider — the
+    # telemetry-off scan covers the energy series too)
+    telemetry_extra_sigs: "tuple" = ()
     # round 10: the engine's protocol-phase names in phase-cond program
     # order, so the cost model (analysis/cost.py) can attribute the
     # per-iteration kernel proxy phase-by-phase
@@ -127,15 +131,20 @@ def _telemetry_fields(sim):
     ring sig (default S, every available series) — the shape an
     accidentally-hard-coded internal recorder would materialize, so
     the telemetry-off aval scan stays a live check instead of only
-    policing carry invars."""
+    policing carry invars — plus (round 14) the dense-plus-energy
+    ring, one series wider, so the scan covers the opt-in `energy_pj`
+    series too."""
     tel = sim.telemetry_spec
     if tel is not None:
-        return (tel.buffer_sig(),), True, tel.buffer_sig()
-    from graphite_tpu.obs.telemetry import TelemetrySpec
+        return (tel.buffer_sig(),), True, tel.buffer_sig(), ()
+    from graphite_tpu.obs.telemetry import EnergyPrices, TelemetrySpec
 
     dense_sig = TelemetrySpec(sample_interval_ps=1).resolve(
         sim.params).buffer_sig()
-    return (), False, dense_sig
+    energy_sig = TelemetrySpec(
+        sample_interval_ps=1,
+        energy_prices=EnergyPrices()).resolve(sim.params).buffer_sig()
+    return (), False, dense_sig, (energy_sig,)
 
 
 def spec_from_simulator(name: str, sim,
@@ -149,7 +158,8 @@ def spec_from_simulator(name: str, sim,
     phase_names = (tuple(mem_phase_names(sim.params))
                    if sim.params.mem is not None else ())
     n_phases = len(phase_names) if phase_names else 6
-    tel_forbidden, expect_tel, tel_sig = _telemetry_fields(sim)
+    tel_forbidden, expect_tel, tel_sig, tel_extra = \
+        _telemetry_fields(sim)
     return ProgramSpec(
         name=name, closed=closed, invar_paths=paths,
         n_tiles=sim.params.n_tiles, expect_gated=expect_gated,
@@ -158,6 +168,7 @@ def spec_from_simulator(name: str, sim,
         clock_invars=clock_invar_indices(paths),
         expect_telemetry=expect_tel,
         telemetry_sig=tel_sig,
+        telemetry_extra_sigs=tel_extra,
         phase_names=phase_names)
 
 
@@ -197,7 +208,8 @@ def spec_from_sweep(name: str, runner,
     phase_names = (tuple(mem_phase_names(sim.params))
                    if sim.params.mem is not None else ())
     n_phases = len(phase_names) if phase_names else 6
-    tel_forbidden, expect_tel, tel_sig = _telemetry_fields(sim)
+    tel_forbidden, expect_tel, tel_sig, tel_extra = \
+        _telemetry_fields(sim)
     return ProgramSpec(
         name=name, closed=closed, invar_paths=paths,
         n_tiles=sim.params.n_tiles, expect_gated=expect_gated,
@@ -206,6 +218,7 @@ def spec_from_sweep(name: str, runner,
         clock_invars=clock_invar_indices(paths),
         expect_telemetry=expect_tel,
         telemetry_sig=tel_sig,
+        telemetry_extra_sigs=tel_extra,
         phase_names=phase_names,
         batched=not runner.shard_batch or runner._sims_per_dev > 1)
 
@@ -455,8 +468,9 @@ def audit_program(spec: ProgramSpec, *,
         # cond-payload forbidden set, added by spec_from_*)
         add("telemetry-off", rules.telemetry_off(
             spec.closed, spec.invar_paths,
-            ring_sigs=((spec.telemetry_sig,)
-                       if spec.telemetry_sig is not None else ())))
+            ring_sigs=(((spec.telemetry_sig,)
+                        if spec.telemetry_sig is not None else ())
+                       + tuple(spec.telemetry_extra_sigs))))
     return results
 
 

@@ -1,6 +1,9 @@
-"""Observability: device-resident telemetry timelines.
+"""Observability: device telemetry timelines + host spans and metrics.
+
+Device side (round 9 + 14):
 
   TelemetrySpec      — what to record (interval, ring depth S, series)
+  EnergyPrices       — per-event pJ prices enabling the energy_pj series
   TelemetryState     — the [S, n_series] ring riding SimState.telemetry
   telemetry_tick     — the outer quantum loop's per-quantum update
   Timeline           — one sim's demuxed chronological host rows
@@ -14,25 +17,66 @@
 `telemetry=None` (the default) lowers to a bit-identical program —
 jaxpr-asserted in tests/test_telemetry.py and enforced by the
 `telemetry-off` audit lint (`python -m graphite_tpu.tools.audit`).
+`energy_prices` is opt-in, so the dense default selection (and every
+locked program fingerprint) is unchanged by the energy series.
+
+Host side (round 14, consumed by serve/service.py):
+
+  MetricsRegistry    — counters / gauges / fixed-bucket histograms with
+                       deterministic p50/p90/p99, Prometheus text +
+                       JSON snapshot exporters, a sampled timeline
+  Tracer / Span      — job-lifecycle span tracing (submit → ... → emit)
+                       with JSON-lines export and terminal-completeness
+                       checking
+
+Both take an injectable monotonic clock, so tests pin exact latencies
+on a fake clock; neither ever touches a traced program (tracing on/off
+serve results are bit-equal, regress-pinned).
 """
 
+from graphite_tpu.obs.metrics import (  # noqa: F401
+    Counter, DEFAULT_COUNT_BUCKETS, DEFAULT_LATENCY_BUCKETS, Gauge,
+    Histogram, MetricsError, MetricsRegistry, RATIO_BUCKETS,
+    parse_exposition,
+)
 from graphite_tpu.obs.telemetry import (  # noqa: F401
-    CORE_SERIES, LEVEL_SERIES, MEM_SERIES, SKIP_PREFIX, Timeline,
-    TelemetrySpec, TelemetryState, available_series, demux_timelines,
-    init_telemetry, telemetry_tick, timeline_from_state,
+    CORE_SERIES, ENERGY_SERIES, EnergyPrices, LEVEL_SERIES, MEM_SERIES,
+    SKIP_PREFIX, Timeline, TelemetrySpec, TelemetryState,
+    available_series, demux_timelines, init_telemetry, telemetry_tick,
+    timeline_from_state,
+)
+from graphite_tpu.obs.trace import (  # noqa: F401
+    JOB_SPANS, Span, TERMINAL_SPANS, Tracer, job_breakdown, load_jsonl,
 )
 
 __all__ = [
     "CORE_SERIES",
+    "Counter",
+    "DEFAULT_COUNT_BUCKETS",
+    "DEFAULT_LATENCY_BUCKETS",
+    "ENERGY_SERIES",
+    "EnergyPrices",
+    "Gauge",
+    "Histogram",
+    "JOB_SPANS",
     "LEVEL_SERIES",
     "MEM_SERIES",
+    "MetricsError",
+    "MetricsRegistry",
+    "RATIO_BUCKETS",
     "SKIP_PREFIX",
+    "Span",
+    "TERMINAL_SPANS",
     "Timeline",
     "TelemetrySpec",
     "TelemetryState",
+    "Tracer",
     "available_series",
     "demux_timelines",
     "init_telemetry",
+    "job_breakdown",
+    "load_jsonl",
+    "parse_exposition",
     "telemetry_tick",
     "timeline_from_state",
 ]

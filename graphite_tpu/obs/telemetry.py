@@ -64,7 +64,99 @@ CORE_SERIES = (
 # own `mem_phase_names` (one source of truth — no parallel name list).
 MEM_SERIES = ("l2_misses", "invalidations", "evictions")
 
+# Energy series (round 14): cumulative picojoules priced from the event
+# counters already in the carry.  Opt-in via TelemetrySpec.energy_prices
+# — never part of the default dense selection, so every pre-round-14
+# program (and its locked fingerprint/budget) is untouched.
+ENERGY_SERIES = ("energy_pj",)
+
 SKIP_PREFIX = "skip_"
+
+
+@dataclasses.dataclass(frozen=True)
+class EnergyPrices:
+    """Per-event energy prices in integer picojoules — the static
+    constants the `energy_pj` series folds into the compiled step.
+
+    Each field prices one counter class the simulation carry already
+    holds (MemCounters + instruction/packet counts), so the cumulative
+    energy is a handful of multiply-adds over scalar reductions — a
+    masked add-a-delta ring row like every other series, never a cond
+    payload.  Integer pJ keeps the series int64-exact (hand-steppable
+    oracle, bit-stable across platforms); sub-pJ events round at price
+    construction, not per sample.
+
+    `from_power_model` derives the prices from the McPAT/DSENT native
+    energy library (`power/interface.py`) at a given technology node —
+    the same per-event model `TileEnergyMonitor` charges post-run, now
+    feeding a live device timeline.  Explicit field values keep tests
+    (and air-gapped runs) independent of the native build.
+    """
+
+    instruction_pj: int = 0   # core front-end+bypass per committed instr
+    l1i_access_pj: int = 0    # per L1-I lookup (hits + misses)
+    l1d_access_pj: int = 0    # per L1-D access (read/write, hit/miss)
+    l2_access_pj: int = 0     # per L2 lookup (hits + misses)
+    l2_miss_pj: int = 0       # additional per L2 miss (tag + refill)
+    invalidation_pj: int = 0  # per INV_REQ served with a valid line
+    eviction_pj: int = 0      # per L2 eviction writeback
+    dram_access_pj: int = 0   # per DRAM line read/write
+    packet_pj: int = 0        # per USER-net packet injected (router+link)
+
+    # fields that price MemCounters events — a memoryless program cannot
+    # record them, so resolve() rejects nonzero mem prices there
+    MEM_FIELDS = ("l1i_access_pj", "l1d_access_pj", "l2_access_pj",
+                  "l2_miss_pj", "invalidation_pj", "eviction_pj",
+                  "dram_access_pj")
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if int(v) != v or int(v) < 0:
+                raise ValueError(
+                    f"EnergyPrices.{f.name} must be a non-negative "
+                    f"integer picojoule price, got {v!r}")
+            object.__setattr__(self, f.name, int(v))
+
+    def needs_mem(self) -> bool:
+        return any(getattr(self, f) for f in self.MEM_FIELDS)
+
+    @classmethod
+    def from_power_model(cls, node_nm: int = 45, *, voltage: float = 1.0,
+                         line_bytes: int = 64,
+                         l1_bytes: int = 32 * 1024, l1_assoc: int = 4,
+                         l2_bytes: int = 512 * 1024, l2_assoc: int = 8
+                         ) -> "EnergyPrices":
+        """Price the events through the native McPAT/DSENT model
+        (builds `native/libgraphite_energy.so` on first use)."""
+        from graphite_tpu.power.interface import (
+            DSENTInterface, McPATCacheInterface, McPATCoreInterface,
+            load_native,
+        )
+
+        def pj(joules: float) -> int:
+            return int(round(joules * 1e12))
+
+        core = McPATCoreInterface(node_nm)
+        l1 = McPATCacheInterface(node_nm, l1_bytes, l1_assoc, line_bytes)
+        l2 = McPATCacheInterface(node_nm, l2_bytes, l2_assoc, line_bytes)
+        noc = DSENTInterface(node_nm)
+        l1o = l1.at_voltage(voltage)
+        l2o = l2.at_voltage(voltage)
+        return cls(
+            instruction_pj=pj(core.dynamic_energy_j(
+                voltage, instructions=1)),
+            l1i_access_pj=pj(l1o.read_energy_j),
+            l1d_access_pj=pj((l1o.read_energy_j + l1o.write_energy_j) / 2),
+            l2_access_pj=pj(l2o.read_energy_j),
+            l2_miss_pj=pj(l2o.tag_energy_j + l2o.write_energy_j),
+            invalidation_pj=pj(l2o.tag_energy_j),
+            eviction_pj=pj(l2o.write_energy_j),
+            dram_access_pj=pj(load_native().dram_access_energy_j(
+                node_nm, line_bytes)),
+            packet_pj=pj(noc.router_dynamic_energy_j(voltage, 1)
+                         + noc.link_dynamic_energy_j(voltage, 1)),
+        )
 
 
 def available_series(params) -> "tuple[str, ...]":
@@ -87,6 +179,12 @@ class TelemetrySpec:
     the program and returns a spec with a concrete ordered tuple —
     `time_ps` always first (the demux key) — which is what the engine
     and the demux consume.
+
+    `energy_prices` (an `EnergyPrices`) makes the `energy_pj` series
+    available: cumulative event energy priced from the carry's own
+    counters.  It is opt-in — with `energy_prices=None` (the default)
+    `energy_pj` is neither offered nor selected, so the dense spec (and
+    every locked pre-round-14 program) is unchanged.
     """
 
     sample_interval_ps: int
@@ -96,6 +194,8 @@ class TelemetrySpec:
     # vector order (`mem_phase_names` — the one source of truth), so a
     # SUBSET of skip_* series still indexes the right phase_skips slot
     phase_names: "tuple[str, ...]" = ()
+    # per-event pJ prices enabling the energy_pj series (round 14)
+    energy_prices: "EnergyPrices | None" = None
 
     def __post_init__(self):
         if int(self.sample_interval_ps) <= 0:
@@ -111,6 +211,20 @@ class TelemetrySpec:
 
     def resolve(self, params) -> "TelemetrySpec":
         avail = available_series(params)
+        if self.energy_prices is not None:
+            if params.mem is None and self.energy_prices.needs_mem():
+                raise ValueError(
+                    "energy_prices set nonzero memory-event prices but "
+                    "this program has no memory subsystem (only "
+                    "instruction_pj/packet_pj apply to memoryless "
+                    "traces)")
+            avail = avail + ENERGY_SERIES
+        elif self.series is not None \
+                and any(s in ENERGY_SERIES for s in self.series):
+            raise ValueError(
+                "the energy_pj series needs TelemetrySpec.energy_prices "
+                "(an obs.EnergyPrices — explicit pJ fields or "
+                "EnergyPrices.from_power_model)")
         if self.series is None:
             sel = avail
         else:
@@ -235,6 +349,42 @@ def _series_values(spec: TelemetrySpec, state, ts: TelemetryState,
             vals["invalidations"] = jnp.sum(mc.invalidations)
         if "evictions" in sel:
             vals["evictions"] = jnp.sum(mc.evictions)
+    if "energy_pj" in sel:
+        ep = spec.energy_prices
+        if ep is None:
+            raise ValueError("energy_pj selected without energy_prices")
+        # cumulative event energy: integer pJ prices fold as literals
+        # into a few multiply-adds over the same scalar reductions the
+        # other series pay; zero-priced terms add no ops at all
+        e = jnp.zeros((), I64)
+        if ep.instruction_pj:
+            e = e + jnp.sum(core.instruction_count) * ep.instruction_pj
+        if ep.packet_pj:
+            e = e + jnp.sum(state.net.packets_sent) * ep.packet_pj
+        if state.mem is not None:
+            mc = state.mem.counters
+            terms = (
+                (ep.l1i_access_pj, (mc.l1i_hits, mc.l1i_misses)),
+                (ep.l1d_access_pj, (mc.l1d_read_hits, mc.l1d_read_misses,
+                                    mc.l1d_write_hits,
+                                    mc.l1d_write_misses)),
+                (ep.l2_access_pj, (mc.l2_hits, mc.l2_misses)),
+                (ep.l2_miss_pj, (mc.l2_misses,)),
+                (ep.invalidation_pj, (mc.invalidations,)),
+                (ep.eviction_pj, (mc.evictions,)),
+                (ep.dram_access_pj, (mc.dram_reads, mc.dram_writes)),
+            )
+            for price, arrs in terms:
+                if price:
+                    n = arrs[0]
+                    for a in arrs[1:]:
+                        n = n + a
+                    e = e + jnp.sum(n) * price
+        elif ep.needs_mem():
+            raise ValueError(
+                "energy_prices price memory events but this program has "
+                "no memory subsystem")
+        vals["energy_pj"] = e
     skip_names = [s for s in spec.series if s.startswith(SKIP_PREFIX)]
     if skip_names:
         if state.mem is None:

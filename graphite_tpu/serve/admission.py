@@ -56,6 +56,12 @@ class Pending:
     job: Job
     seq: int           # global submission order (FIFO fairness key)
     attempts: int = 0  # failed executions so far (split/retry budget)
+    # observability (round 14): service-clock timestamp of the LAST
+    # enqueue (submit or requeue — the queue-dwell histogram's start;
+    # None until the service stamps it), and the dwell the most recent
+    # batch-form measured from it
+    enqueue_ts: "float | None" = None
+    dwell_s: float = 0.0
 
 
 class JobClass:
@@ -158,8 +164,12 @@ class AdmissionController:
         depth = _pow2_bucket(auto_mailbox_depth(job.trace), 2)
         length = _pow2_bucket(job.trace.length, 16)
         tel = job.telemetry
+        # energy_prices is part of the key: the pJ prices fold into the
+        # compiled step as literals, so two jobs differing only in
+        # prices lower different programs and must never co-batch
         tel_key = None if tel is None else (
-            int(tel.sample_interval_ps), int(tel.n_samples), tel.series)
+            int(tel.sample_interval_ps), int(tel.n_samples), tel.series,
+            tel.energy_prices)
         return (config_digest(job.resolved_config()), job.n_tiles,
                 job.has_mem_trace(), depth, length, tel_key)
 

@@ -46,6 +46,10 @@ class CacheEntry:
     nbytes: int               # residency bill of the layout it serves
     shape_sig: tuple          # (B, n_tiles, pad_length)
     hits: int = 0
+    # host seconds the miss paid to lower + fingerprint + set up the
+    # jit (round 14 observability — batch spans report it on hits too,
+    # so "what did this program cost to build" survives the miss)
+    compile_s: float = 0.0
 
 
 class ProgramCache:

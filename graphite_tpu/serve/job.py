@@ -189,6 +189,9 @@ class JobResult:
     knob_point: "dict | None" = None
     n_quanta: "int | None" = None
     n_iterations: "int | None" = None
+    # host latency breakdown (round 14) — populated when the service
+    # runs with tracing on: {"queue_dwell_s": ..., "batch_execute_s": ...}
+    timings: "dict | None" = None
 
     @property
     def ok(self) -> bool:
@@ -213,6 +216,8 @@ class JobResult:
             })
             if self.telemetry is not None:
                 row["telemetry_samples"] = len(self.telemetry)
+        if self.timings:
+            row.update({k: float(v) for k, v in self.timings.items()})
         if self.error is not None:
             row["error"] = self.error
         return row

@@ -31,13 +31,31 @@ Graceful degradation is structural, not best-effort:
 The bit-exact sequential path (`Simulator.run`) remains the equivalence
 oracle: `tools/regress.py --smoke`'s serve rung replays a mixed-
 geometry job set both ways and requires identical results + telemetry.
+
+Observability (round 14) is built in, not bolted on: every rate the
+service reports is ONE instrument in an `obs.MetricsRegistry` (queue
+dwell, admission/batch-form/execute latency, compile time, split depth
+and batch occupancy are fixed-bucket histograms; the accounting
+identities are counters), `counters` is a compatibility view over that
+registry, and — when constructed with `tracing=` — every job gets a
+lifecycle span trace (submit → validate → admit/reject → queue dwell →
+execute → emit/failed) and every batch an execution span carrying the
+class, capacity, occupancy, cache hit, compile time and residency.
+Both ride an injectable monotonic clock (`clock=`) so tests pin exact
+latencies; neither ever touches a traced program, so serve results are
+bit-equal with tracing on or off (regress rung 9).
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 
+from graphite_tpu.obs.metrics import (
+    DEFAULT_COUNT_BUCKETS, MetricsRegistry, RATIO_BUCKETS,
+)
+from graphite_tpu.obs.trace import Tracer
 from graphite_tpu.serve.admission import AdmissionController, JobClass, \
     Pending, QueueFullError
 from graphite_tpu.serve.cache import CacheEntry, ProgramCache, \
@@ -82,6 +100,14 @@ class CampaignService:
     `max_history`: newest result envelopes / batch reports retained on
     the service (`results` / `batch_log`) — streaming consumers use
     `drain()`; counters stay exact regardless.
+
+    Observability: `metrics` (an `obs.MetricsRegistry`) is always live
+    — it IS the service bookkeeping, not a copy of it; `tracing=True`
+    (or a caller-owned `obs.Tracer`) records job-lifecycle + batch
+    spans, exported via `export_spans()` / `tools/serve.py
+    --trace-out`; `clock` injects the monotonic time source both read
+    (default `time.monotonic` — tests pass a fake clock and get exact
+    dwell/latency histograms).
     """
 
     def __init__(self, *, hbm_budget_bytes: int = 0, batch_size: int = 4,
@@ -89,7 +115,9 @@ class CampaignService:
                  max_attempts: int = 3, max_quanta: int = 1_000_000,
                  verify_hits: bool = False, validate: bool = True,
                  shard_batch: "bool | None" = False,
-                 max_history: int = 4096):
+                 max_history: int = 4096,
+                 tracing: "bool | Tracer" = False,
+                 clock=None):
         import collections
 
         self.admission = AdmissionController(
@@ -103,25 +131,106 @@ class CampaignService:
         self.verify_hits = bool(verify_hits)
         self.validate = bool(validate)
         self.shard_batch = shard_batch
+        if isinstance(tracing, Tracer):
+            # ONE timebase: reconstructed spans (queue dwell, execute)
+            # are recorded with service-clock timestamps, so a caller-
+            # owned tracer must share it.  An explicit `clock=` is
+            # adopted by both; otherwise the service adopts the
+            # tracer's clock.
+            self.tracer: "Tracer | None" = tracing
+            if clock is not None:
+                self._clock = clock
+                tracing.clock = clock
+            else:
+                self._clock = tracing.clock
+        else:
+            self._clock = clock if clock is not None else time.monotonic
+            self.tracer = Tracer(clock=self._clock) if tracing else None
         # retention is BOUNDED (`max_history` newest entries): envelopes
         # stream out through drain(); keeping every SimResults +
         # BatchReport forever would grow a persistent service without
-        # bound.  Counters stay exact over all time (running sums).
+        # bound.  Counters stay exact over all time (the registry's
+        # instruments are running sums, and the metrics timeline /
+        # tracer spans are bounded deques of their own).
         self.batch_log: "collections.deque[BatchReport]" = \
             collections.deque(maxlen=int(max_history))
         self._completed: "collections.deque[JobResult]" = \
             collections.deque(maxlen=int(max_history))
-        self._occ_sum = 0.0
-        self._occ_batches = 0
         self._next_batch_id = 0
         self._last_residency = 0
         self._last_cache_hit = False
-        self._counts = {
-            "submitted": 0, "completed": 0, "failed": 0, "rejected": 0,
-            "backpressure": 0, "batches": 0, "splits": 0, "retries": 0,
-            "cache_hits": 0, "compile_count": 0,
+        self._last_compile_s = 0.0
+        self.metrics = MetricsRegistry(clock=self._clock,
+                                       max_timeline=int(max_history))
+        self._init_metrics()
+
+    def _init_metrics(self) -> None:
+        """Register every instrument up front (one definition of each
+        rate; the exposition shows zeros instead of omitting series)."""
+        m = self.metrics
+        self._m = {
+            "submitted": m.counter(
+                "jobs_submitted_total", "jobs accepted into the queue"),
+            "completed": m.counter(
+                "jobs_completed_total", "ok envelopes emitted"),
+            "failed": m.counter(
+                "jobs_failed_total", "failed envelopes emitted"),
+            "rejected": m.counter(
+                "jobs_rejected_total", "jobs refused at submit"),
+            "backpressure": m.counter(
+                "backpressure_total", "submits refused by a full queue"),
+            "batches": m.counter("batches_total", "batches executed"),
+            "splits": m.counter(
+                "splits_total", "failed batches split in half"),
+            "retries": m.counter(
+                "retries_total", "batch/job re-executions"),
+            "cache_hits": m.counter(
+                "cache_hits_total", "program-cache hits"),
+            "compiles": m.counter(
+                "compiles_total", "program-cache miss compiles"),
+            "execute_wall": m.counter(
+                "execute_wall_seconds", "wall seconds inside batch "
+                "execution (jobs_per_s denominator)"),
         }
-        self._execute_wall_s = 0.0
+        self._g = {
+            "queue_depth": m.gauge("queue_depth", "pending jobs"),
+            "cache_entries": m.gauge("cache_entries",
+                                     "compiled programs cached"),
+            "cache_bytes": m.gauge("cache_bytes",
+                                   "program-cache residency bytes"),
+        }
+        self._h = {
+            "admission": m.histogram(
+                "admission_seconds",
+                "submit latency (validate + classify + enqueue)"),
+            "dwell": m.histogram(
+                "queue_dwell_seconds",
+                "enqueue to batch-form wait per job"),
+            "batch_form": m.histogram(
+                "batch_form_seconds", "queue pop + batch assembly"),
+            "execute": m.histogram(
+                "execute_seconds", "batch execution wall time"),
+            "compile": m.histogram(
+                "compile_seconds", "program lower+compile on cache miss"),
+            "occupancy": m.histogram(
+                "batch_occupancy", "real jobs / batch capacity",
+                buckets=RATIO_BUCKETS),
+            "split_depth": m.histogram(
+                "split_depth", "attempts consumed per terminal job",
+                buckets=DEFAULT_COUNT_BUCKETS),
+        }
+
+    def _span(self, trace_id: str, name: str, **attrs):
+        if self.tracer is None:
+            return contextlib.nullcontext(None)
+        return self.tracer.span(trace_id, name, **attrs)
+
+    def export_spans(self, path_or_file) -> int:
+        """Write the retained spans as JSON-lines (the `--trace-out`
+        artifact); returns the span count, 0 when tracing is off."""
+        if self.tracer is None:
+            return 0
+        return self.tracer.export_jsonl(path_or_file)
 
     # -- submission ------------------------------------------------------
 
@@ -131,21 +240,36 @@ class CampaignService:
         malformed job, `analysis.cost.ResidencyBudgetError` (with
         `.breakdown`) on a job that can never fit, `QueueFullError`
         under backpressure."""
+        t0 = self._clock()
+        jid = job.job_id
         try:
-            job.validate(validate_trace=self.validate)
-            cls, pending = self.admission.admit(job)
+            with self._span(jid, "submit"):
+                with self._span(jid, "validate"):
+                    job.validate(validate_trace=self.validate)
+                with self._span(jid, "admit"):
+                    cls, pending = self.admission.admit(job)
         except QueueFullError:
             # backpressure is NOT a rejection: the job is fine, the
             # queue is full — the caller drains and resubmits, and the
             # later successful submit must keep the accounting identity
             # submitted == completed + failed (+ rejected never counts
             # a job that eventually ran)
-            self._counts["backpressure"] += 1
+            self._m["backpressure"].inc()
+            if self.tracer is not None:
+                self.tracer.event(jid, "backpressure")
             raise
-        except Exception:
-            self._counts["rejected"] += 1
+        except Exception as e:
+            self._m["rejected"].inc()
+            if self.tracer is not None:
+                # terminal span: a rejected job's lifecycle ends here
+                self.tracer.event(
+                    jid, "reject", error=f"{type(e).__name__}: {e}")
             raise
-        self._counts["submitted"] += 1
+        now = self._clock()
+        self._h["admission"].observe(now - t0)
+        pending.enqueue_ts = now
+        self._m["submitted"].inc()
+        self._g["queue_depth"].set(self.admission.queue_depth)
         return pending.seq
 
     @property
@@ -158,10 +282,12 @@ class CampaignService:
         """Form and run ONE batch (the oldest-head class); returns the
         envelopes it completed (empty when a failed batch split and
         re-enqueued, or when the queue is idle)."""
+        t0 = self._clock()
         nxt = self.admission.next_batch()
         if nxt is None:
             return []
         cls, pendings = nxt
+        self._h["batch_form"].observe(self._clock() - t0)
         return self._run_batch(cls, pendings)
 
     def drain(self):
@@ -189,10 +315,23 @@ class CampaignService:
             DeadlockError, MailboxOverflowError,
         )
 
-        self._counts["batches"] += 1
+        self._m["batches"].inc()
         batch_id = self._next_batch_id
         self._next_batch_id += 1
-        t0 = time.perf_counter()
+        btid = f"batch-{batch_id}"
+        t0 = self._clock()
+        # queue dwell ends when the batch forms: one histogram
+        # observation per member, one reconstructed `queue` span per
+        # job (requeued members' clocks restarted at requeue time, so
+        # a split's second wait is a second observation, not a longer
+        # first one)
+        for p in pendings:
+            if p.enqueue_ts is not None:
+                p.dwell_s = t0 - p.enqueue_ts
+                self._h["dwell"].observe(p.dwell_s)
+                if self.tracer is not None:
+                    self.tracer.record(p.job.job_id, "queue",
+                                       p.enqueue_ts, t0, batch=batch_id)
         try:
             results = self._execute(cls, pendings, batch_id)
         except ProgramCacheError as e:
@@ -207,29 +346,80 @@ class CampaignService:
                     job_id=p.job.job_id, status=STATUS_FAILED,
                     error=f"ProgramCacheError: {e}", batch_id=batch_id,
                     attempts=p.attempts, seed=p.job.seed))
-                self._counts["failed"] += 1
+                self._m["failed"].inc()
+                self._h["split_depth"].observe(p.attempts)
+                if self.tracer is not None:
+                    self.tracer.event(
+                        p.job.job_id, "failed", batch=batch_id,
+                        attempts=p.attempts,
+                        error=f"ProgramCacheError: {e}")
             raise
         except (DeadlockError, MailboxOverflowError, RuntimeError) as e:
-            wall = time.perf_counter() - t0
-            self._execute_wall_s += wall
-            return self._handle_failure(cls, pendings, batch_id, e, wall)
-        wall = time.perf_counter() - t0
-        self._execute_wall_s += wall
+            wall = self._clock() - t0
+            self._finish_batch_metrics(wall)
+            return self._handle_failure(cls, pendings, batch_id, e,
+                                        t0, wall)
+        wall = self._clock() - t0
+        self._finish_batch_metrics(wall)
+        occupancy = len(pendings) / cls.batch_cap
+        self._h["occupancy"].observe(occupancy)
         self.batch_log.append(BatchReport(
             batch_id=batch_id, class_name=self._class_name(cls),
             n_tiles=cls.n_tiles,
             job_ids=[p.job.job_id for p in pendings],
             n_jobs=len(pendings), batch_cap=cls.batch_cap,
-            occupancy=len(pendings) / cls.batch_cap,
+            occupancy=occupancy,
             residency_total=self._last_residency,
             cache_hit=self._last_cache_hit, ok=True, wall_s=wall))
-        self._occ_sum += len(pendings) / cls.batch_cap
-        self._occ_batches += 1
+        if self.tracer is not None:
+            self.tracer.record(
+                btid, "batch", t0, t0 + wall,
+                **self._batch_attrs(cls, pendings, ok=True))
+            for p, res in zip(pendings, results):
+                # terminal emit span; `telemetry_samples` references
+                # the demuxed device timeline riding the envelope
+                attrs = {"batch": batch_id, "attempts": res.attempts}
+                if res.telemetry is not None:
+                    attrs["telemetry_samples"] = len(res.telemetry)
+                self.tracer.event(p.job.job_id, "emit", **attrs)
+        for p, res in zip(pendings, results):
+            self._h["split_depth"].observe(res.attempts)
+            if self.tracer is not None:
+                res.timings = {"queue_dwell_s": round(p.dwell_s, 6),
+                               "batch_execute_s": round(wall, 6)}
         self._completed.extend(results)
-        self._counts["completed"] += len(results)
+        self._m["completed"].inc(len(results))
         return results
 
-    def _handle_failure(self, cls, pendings, batch_id, exc, wall
+    def _finish_batch_metrics(self, wall: float) -> None:
+        self._m["execute_wall"].inc(wall)
+        self._h["execute"].observe(wall)
+        self._g["queue_depth"].set(self.admission.queue_depth)
+        self._g["cache_entries"].set(len(self.cache))
+        self._g["cache_bytes"].set(self.cache.total_bytes)
+        # one periodic metrics-timeline row per executed batch — the
+        # time series tools/report.py --metrics renders
+        self.metrics.sample()
+
+    def _batch_attrs(self, cls: JobClass, pendings, *, ok: bool,
+                     error: "str | None" = None) -> dict:
+        attrs = {
+            "class": self._class_name(cls),
+            "n_tiles": cls.n_tiles,
+            "capacity": cls.batch_cap,
+            "n_jobs": len(pendings),
+            "occupancy": round(len(pendings) / cls.batch_cap, 6),
+            "cache_hit": self._last_cache_hit,
+            "compile_s": round(self._last_compile_s, 6),
+            "residency_bytes": self._last_residency,
+            "jobs": [p.job.job_id for p in pendings],
+            "ok": ok,
+        }
+        if error is not None:
+            attrs["error"] = error
+        return attrs
+
+    def _handle_failure(self, cls, pendings, batch_id, exc, t0, wall
                         ) -> "list[JobResult]":
         """Split-and-requeue (n > 1) or retry/fail (n == 1); every
         member's attempt counter moves, so the recursion terminates."""
@@ -243,8 +433,18 @@ class CampaignService:
             residency_total=self._last_residency,
             cache_hit=self._last_cache_hit,
             ok=False, wall_s=wall, error=msg))
+        if self.tracer is not None:
+            # the span covers the REAL execute window (t0, t0+wall) —
+            # clock reads after it (metrics sampling) must not shift it
+            self.tracer.record(
+                f"batch-{batch_id}", "batch", t0, t0 + wall,
+                **self._batch_attrs(cls, pendings, ok=False, error=msg))
+        now = self._clock()
         for p in pendings:
             p.attempts += 1
+            # a requeued member's dwell clock restarts: its second wait
+            # is a second histogram observation, not a longer first one
+            p.enqueue_ts = now
         if len(pendings) > 1:
             # halving isolates the offender in ~log2(B) steps; the
             # halves requeue as PRE-FORMED batches (head of the ready
@@ -254,8 +454,12 @@ class CampaignService:
             mid = (len(pendings) + 1) // 2
             self.admission.requeue_batch(cls, pendings[mid:])
             self.admission.requeue_batch(cls, pendings[:mid])
-            self._counts["splits"] += 1
-            self._counts["retries"] += 1
+            self._m["splits"].inc()
+            self._m["retries"].inc()
+            if self.tracer is not None:
+                for p in pendings:
+                    self.tracer.event(p.job.job_id, "split",
+                                      batch=batch_id, error=msg)
             return []
         p = pendings[0]
         if p.attempts >= self.max_attempts:
@@ -263,10 +467,18 @@ class CampaignService:
                             error=msg, batch_id=batch_id,
                             attempts=p.attempts, seed=p.job.seed)
             self._completed.append(res)
-            self._counts["failed"] += 1
+            self._m["failed"].inc()
+            self._h["split_depth"].observe(p.attempts)
+            if self.tracer is not None:
+                self.tracer.event(p.job.job_id, "failed",
+                                  batch=batch_id, attempts=p.attempts,
+                                  error=msg)
             return [res]
         self.admission.requeue_batch(cls, [p])
-        self._counts["retries"] += 1
+        self._m["retries"].inc()
+        if self.tracer is not None:
+            self.tracer.event(p.job.job_id, "retry", batch=batch_id,
+                              attempts=p.attempts, error=msg)
         return []
 
     def _class_name(self, cls: JobClass) -> str:
@@ -292,10 +504,12 @@ class CampaignService:
 
         jobs = [p.job for p in pendings]
         n, B = len(jobs), cls.batch_cap
+        btid = f"batch-{batch_id}"
         # per-batch stats reset FIRST: a failure before they are
         # recomputed must not report the previous batch's numbers
         self._last_residency = 0
         self._last_cache_hit = False
+        self._last_compile_s = 0.0
         # pad to the class's FIXED capacity with replicas of job 0 so
         # every batch of this class shares one [B, T, L] program shape;
         # the replicas' rows are dropped below (the tail mask)
@@ -324,19 +538,35 @@ class CampaignService:
             raise AssertionError(
                 f"admitted batch residency {self._last_residency} "
                 f"exceeds hbm_budget_bytes={self.hbm_budget_bytes}")
-        entry = self._resolve_program(cls, runner, B)
+        with self._span(btid, "cache") as cspan:
+            entry = self._resolve_program(cls, runner, B)
+            if cspan is not None:
+                cspan.attrs.update(hit=self._last_cache_hit,
+                                   compile_s=round(
+                                       self._last_compile_s, 6))
+        t_exec = self._clock()
         out = runner.run(max_quanta=self.max_quanta)
-        results = []
-        for b in range(n):   # the padded tail [n:B] never leaves here
-            p = pendings[b]
-            tl = None if out.timelines is None else out.timelines[b]
-            results.append(JobResult(
-                job_id=p.job.job_id, status=STATUS_OK,
-                results=out.results[b], telemetry=tl,
-                batch_id=batch_id, attempts=p.attempts + 1,
-                seed=p.job.seed, knob_point=dict(p.job.knobs),
-                n_quanta=int(out.n_quanta[b]),
-                n_iterations=int(out.n_iterations[b])))
+        t_done = self._clock()
+        if self.tracer is not None:
+            # one batch-trace execute span + one per member, so a job
+            # trace alone carries its full host timeline
+            self.tracer.record(btid, "execute", t_exec, t_done,
+                               cache_hit=self._last_cache_hit)
+            for p in pendings:
+                self.tracer.record(p.job.job_id, "execute",
+                                   t_exec, t_done, batch=batch_id)
+        with self._span(btid, "demux"):
+            results = []
+            for b in range(n):  # the padded tail [n:B] never leaves here
+                p = pendings[b]
+                tl = None if out.timelines is None else out.timelines[b]
+                results.append(JobResult(
+                    job_id=p.job.job_id, status=STATUS_OK,
+                    results=out.results[b], telemetry=tl,
+                    batch_id=batch_id, attempts=p.attempts + 1,
+                    seed=p.job.seed, knob_point=dict(p.job.knobs),
+                    n_quanta=int(out.n_quanta[b]),
+                    n_iterations=int(out.n_iterations[b])))
         return results
 
     # -- program cache ---------------------------------------------------
@@ -381,10 +611,13 @@ class CampaignService:
                         "class key admitted a different program")
             runner._runner = entry.jitted
             runner._runner_max_quanta = entry.max_quanta
-            self._counts["cache_hits"] += 1
+            self._m["cache_hits"].inc()
             self._last_cache_hit = True
+            # a hit still knows what its program cost to build
+            self._last_compile_s = entry.compile_s
             return entry
         self._last_cache_hit = False
+        t_compile = self._clock()
         closed, _ = runner.lower(self.max_quanta)
         fp = fingerprint(closed)
         record = ProgramRecord(name=name, fingerprint=fp,
@@ -398,12 +631,15 @@ class CampaignService:
                 "silently serve two different artifacts")
         self.registry[name] = record
         jitted = runner._get_runner(self.max_quanta)
+        self._last_compile_s = self._clock() - t_compile
+        self._h["compile"].observe(self._last_compile_s)
         entry = CacheEntry(
             name=name, record=record, jitted=jitted,
             max_quanta=self.max_quanta,
-            nbytes=self._last_residency, shape_sig=shape_sig)
+            nbytes=self._last_residency, shape_sig=shape_sig,
+            compile_s=self._last_compile_s)
         self.cache.put(key, entry, expect_fingerprint=fp)
-        self._counts["compile_count"] += 1
+        self._m["compiles"].inc()
         return entry
 
     # -- observability ---------------------------------------------------
@@ -411,22 +647,35 @@ class CampaignService:
     @property
     def counters(self) -> dict:
         """Service counters: queue depth, batch occupancy, cache hit
-        rate, compile count, jobs/s — the inference-stack dashboard."""
-        total_lookups = (self._counts["cache_hits"]
-                         + self._counts["compile_count"])
+        rate, compile count, jobs/s — the inference-stack dashboard.
+
+        This is a COMPATIBILITY VIEW over `self.metrics` (the one
+        definition of each rate lives in the registry): the round-13
+        dict keys are preserved for `tools/serve.py` summary output and
+        existing tests, each derived from exactly one instrument."""
+        m = self._m
+        hits = int(m["cache_hits"].value)
+        compiles = int(m["compiles"].value)
+        occ = self._h["occupancy"]
+        wall = m["execute_wall"].value
+        completed = int(m["completed"].value)
         return {
-            **self._counts,
+            "submitted": int(m["submitted"].value),
+            "completed": completed,
+            "failed": int(m["failed"].value),
+            "rejected": int(m["rejected"].value),
+            "backpressure": int(m["backpressure"].value),
+            "batches": int(m["batches"].value),
+            "splits": int(m["splits"].value),
+            "retries": int(m["retries"].value),
+            "cache_hits": hits,
+            "compile_count": compiles,
             "queue_depth": self.admission.queue_depth,
-            "mean_batch_occupancy": (
-                self._occ_sum / self._occ_batches
-                if self._occ_batches else 0.0),
-            "cache_hit_rate": (
-                self._counts["cache_hits"] / total_lookups
-                if total_lookups else 0.0),
+            "mean_batch_occupancy": occ.mean,
+            "cache_hit_rate": (hits / (hits + compiles)
+                               if hits + compiles else 0.0),
             "cache_entries": len(self.cache),
             "cache_bytes": self.cache.total_bytes,
             "cache_evictions": self.cache.evictions,
-            "jobs_per_s": (
-                self._counts["completed"] / self._execute_wall_s
-                if self._execute_wall_s > 0 else 0.0),
+            "jobs_per_s": completed / wall if wall > 0 else 0.0,
         }

@@ -323,6 +323,85 @@ def smoke(tiles: int = 16) -> int:
              f"hits={c['cache_hits']} failed={c['failed']})"))
     failures += 0 if ok else 1
 
+    # 9) observability (round 14): the SAME mixed-geometry job set with
+    #    span tracing + host metrics ON and the energy_pj telemetry
+    #    series priced onto the t8 jobs — SimResults bit-equal to the
+    #    rung-8 untraced run (tracing/metrics are host-side, energy is
+    #    pure observability on device), every submitted job's span
+    #    chain terminal-complete, the energy column equal to the
+    #    hand-priced sum of the run's own counters, and both exporters'
+    #    output parsing back.
+    import io as _io
+
+    from graphite_tpu.obs import EnergyPrices, parse_exposition
+    from graphite_tpu.obs.trace import job_breakdown, load_jsonl
+
+    prices = EnergyPrices(
+        instruction_pj=3, l1d_access_pj=2, l2_access_pj=9,
+        l2_miss_pj=120, invalidation_pj=15, eviction_pj=20,
+        dram_access_pj=500, packet_pj=7)
+    tel_e = TelemetrySpec(sample_interval_ps=1_000_000, n_samples=32,
+                          energy_prices=prices)
+    svc9 = CampaignService(batch_size=2, max_quanta=200_000,
+                           tracing=True)
+    jobs9 = []
+    for i, s in enumerate((1, 2, 3)):
+        jobs9.append(Job(f"t4-{i}", sc4, _mkt(4, s), seed=s))
+        jobs9.append(Job(f"t8-{i}", sc8, _mkt(8, s), seed=s,
+                         telemetry=tel_e))
+    for job in jobs9:
+        svc9.submit(job)
+    served9 = {r.job_id: r for r in svc9.drain()}
+    for job in jobs9:
+        failures += _compare(f"traced serve {job.job_id} vs untraced",
+                             served9[job.job_id].results,
+                             served[job.job_id].results)
+    for i in range(3):
+        r9 = served9[f"t8-{i}"]
+        res = r9.results
+        mc = res.mem_counters
+        exp = (3 * int(res.total_instructions)
+               + 7 * int(np.sum(res.packets_sent))
+               + 2 * int(sum(mc[k].sum() for k in (
+                   "l1d_read_hits", "l1d_read_misses",
+                   "l1d_write_hits", "l1d_write_misses")))
+               + 9 * int(mc["l2_hits"].sum() + mc["l2_misses"].sum())
+               + 120 * int(mc["l2_misses"].sum())
+               + 15 * int(mc["invalidations"].sum())
+               + 20 * int(mc["evictions"].sum())
+               + 500 * int(mc["dram_reads"].sum()
+                           + mc["dram_writes"].sum()))
+        got = int(r9.telemetry.col("energy_pj").sum())
+        ok = got == exp
+        print(f"{f'serve t8-{i} energy_pj vs hand-priced sum':44} "
+              f"{'PASS' if ok else 'FAIL'}"
+              + ("" if ok else f"  (got {got}, expected {exp})"))
+        failures += 0 if ok else 1
+    missing = svc9.tracer.missing_terminal([j.job_id for j in jobs9])
+    print(f"{'serve span set terminal-complete':44} "
+          f"{'PASS' if not missing else 'FAIL'}"
+          + ("" if not missing else f"  (missing: {missing})"))
+    failures += 1 if missing else 0
+    buf = _io.StringIO()
+    n_spans = svc9.export_spans(buf)
+    buf.seek(0)
+    rows = load_jsonl(buf)
+    bd = {r["job"] for r in job_breakdown(rows)}
+    ok = (len(rows) == n_spans and n_spans > 0
+          and bd == {j.job_id for j in jobs9})
+    print(f"{'serve span JSON-lines export round-trip':44} "
+          f"{'PASS' if ok else 'FAIL'}")
+    failures += 0 if ok else 1
+    snap = parse_exposition(svc9.metrics.exposition())
+    ok = (snap["queue_dwell_seconds"]["type"] == "histogram"
+          and snap["queue_dwell_seconds"]["count"] == len(jobs9)
+          and snap["jobs_completed_total"]["value"] == len(jobs9)
+          and snap["compiles_total"]["value"] == 2)
+    print(f"{'serve metrics exposition parses':44} "
+          f"{'PASS' if ok else 'FAIL'}"
+          + ("" if ok else f"  ({snap.get('queue_dwell_seconds')})"))
+    failures += 0 if ok else 1
+
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
     return 1 if failures else 0
 

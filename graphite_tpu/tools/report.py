@@ -1,35 +1,43 @@
-"""Telemetry timeline reporter: render recorded timelines for CI/bench.
+"""Observability reporter: timelines, span traces, metrics dumps.
 
-Input: one or more `.npz` files saved via `obs.Timeline.save` — a solo
-run's timeline, or several (a campaign's demuxed `SweepOutcome.timelines`
-saved one file per sim).  Output (stdout):
+Three input kinds, one renderer:
 
-  --format json   one JSON line per sample (keys: sim, sample, time_ns,
-                  then one key per recorded series), then one summary
-                  line per timeline — the shape bench.py and the CI
-                  artifacts consume;
-  --format text   an aligned-text table per timeline (one row per
-                  sample) followed by its summary;
-  --summary       summaries only (either format).
+  positional .npz   device telemetry timelines (`obs.Timeline.save`) —
+                    a solo run, or several files as one campaign;
+  --spans FILE      job/batch lifecycle spans saved as JSON-lines by
+                    `tools/serve.py --trace-out` — renders one aligned
+                    latency-breakdown row per job (submit, queue dwell,
+                    execute, ... in microseconds) plus the batch
+                    execution table (class, occupancy, cache hit,
+                    compile time);
+  --metrics FILE    a Prometheus text exposition written by
+                    `tools/serve.py --metrics-out` — renders counters/
+                    gauges and histogram summaries (count, sum,
+                    p50/p90/p99 from the cumulative buckets).
+
+Output (stdout):
+
+  --format json   machine rows (one JSON line per sample / job / metric)
+                  — the shape bench.py and the CI artifacts consume;
+  --format text   aligned-text tables;
+  --summary       summaries only (timeline mode).
 
 Usage:
   python -m graphite_tpu.tools.report run.npz [sim0.npz sim1.npz ...]
                                       [--format json|text] [--summary]
+  python -m graphite_tpu.tools.report --spans spans.jsonl --format text
+  python -m graphite_tpu.tools.report --metrics metrics.prom
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 
 
-def _text_table(tl) -> "list[str]":
-    """Aligned rows: sample index + time_ns + every non-time series."""
-    cols = ["sample", "time_ns"] + [s for s in tl.series
-                                    if s != "time_ps"]
-    rows = [[str(r["sample"]), str(r["time_ns"])]
-            + [str(r[s]) for s in cols[2:]] for r in tl.json_rows()]
+def _align(cols: "list[str]", rows: "list[list[str]]") -> "list[str]":
     widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
               for i, c in enumerate(cols)]
     lines = ["  ".join(c.rjust(w) for c, w in zip(cols, widths))]
@@ -38,23 +46,141 @@ def _text_table(tl) -> "list[str]":
     return lines
 
 
+def _text_table(tl) -> "list[str]":
+    """Aligned rows: sample index + time_ns + every non-time series."""
+    cols = ["sample", "time_ns"] + [s for s in tl.series
+                                    if s != "time_ps"]
+    rows = [[str(r["sample"]), str(r["time_ns"])]
+            + [str(r[s]) for s in cols[2:]] for r in tl.json_rows()]
+    return _align(cols, rows)
+
+
+def render_spans(path: str, fmt: str) -> "list[str]":
+    """Span JSON-lines -> per-job latency breakdown + batch table."""
+    from graphite_tpu.obs.trace import (
+        BATCH_TRACE_PREFIX, JOB_SPANS, job_breakdown, load_jsonl,
+    )
+
+    rows = load_jsonl(path)
+    jobs = sorted(job_breakdown(rows), key=lambda r: r["job"])
+    if fmt == "json":
+        out = [json.dumps(r) for r in jobs]
+        for r in rows:
+            if r["trace"].startswith(BATCH_TRACE_PREFIX) \
+                    and r["span"] == "batch":
+                out.append(json.dumps(r))
+        return out
+    # aligned per-job table: lifecycle spans in canonical order, then
+    # any extra recorded spans (split/retry/...), then status/total
+    span_cols = [s + "_us" for s in JOB_SPANS]
+    extra = sorted({k for r in jobs for k in r
+                    if k.endswith("_us") and k != "total_us"
+                    and k not in span_cols})
+    span_cols = [c for c in span_cols if any(c in r for r in jobs)]
+    span_cols += [c for c in extra if c not in span_cols]
+    cols = ["job"] + span_cols + ["total_us", "status"]
+    body = [[str(r.get(c, "-")) for c in cols] for r in jobs]
+    lines = _align(cols, body)
+    batches = [r for r in rows
+               if r["trace"].startswith(BATCH_TRACE_PREFIX)
+               and r["span"] == "batch"]
+    if batches:
+        bcols = ["batch", "class", "n_jobs", "capacity", "occupancy",
+                 "cache_hit", "compile_s", "dur_us", "ok"]
+        brows = [[str(r["trace"]), str(r.get("class", "-")),
+                  str(r.get("n_jobs", "-")), str(r.get("capacity", "-")),
+                  str(r.get("occupancy", "-")),
+                  str(r.get("cache_hit", "-")),
+                  str(r.get("compile_s", "-")), str(r["dur_us"]),
+                  str(r.get("ok", "-"))] for r in batches]
+        lines.append("")
+        lines.extend(_align(bcols, brows))
+    return lines
+
+
+def _hist_quantile(buckets: "dict[str, int]", count: int,
+                   q: float) -> str:
+    """Quantile from cumulative `le -> count` buckets (the same
+    first-bucket-reaching-rank rule obs.metrics.Histogram uses; the
+    +Inf tail renders as '>LAST' since the text format cannot carry
+    the true max)."""
+    if count == 0:
+        return "0"
+    rank = math.ceil(q * count)
+    finite = [(le, c) for le, c in buckets.items() if le != "+Inf"]
+    for le, cum in finite:
+        if cum >= rank:
+            return le
+    return f">{finite[-1][0]}" if finite else "inf"
+
+
+def render_metrics(path: str, fmt: str) -> "list[str]":
+    """Prometheus text dump -> aligned metric summaries."""
+    from graphite_tpu.obs.metrics import parse_exposition
+
+    with open(path) as fh:
+        parsed = parse_exposition(fh.read())
+    if fmt == "json":
+        return [json.dumps({"metric": name, **m})
+                for name, m in parsed.items()]
+    cols = ["metric", "type", "value", "count", "sum", "p50", "p90",
+            "p99"]
+    rows = []
+    for name, m in parsed.items():
+        if m["type"] == "histogram":
+            n = m["count"]
+            rows.append([name, "histogram", "-", str(n),
+                         str(round(m["sum"], 6))]
+                        + [_hist_quantile(m["buckets"], n, q)
+                           for q in (0.5, 0.9, 0.99)])
+        else:
+            v = m.get("value", 0)
+            v = int(v) if float(v).is_integer() else round(v, 6)
+            rows.append([name, m["type"], str(v), "-", "-", "-", "-",
+                         "-"])
+    return _align(cols, rows)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="render device-recorded telemetry timelines")
-    ap.add_argument("files", nargs="+",
+        description="render telemetry timelines, span traces, and "
+        "metrics dumps")
+    ap.add_argument("files", nargs="*",
                     help=".npz timeline file(s) (obs.Timeline.save); "
                     "several files render as one campaign, sim-indexed "
                     "in argument order")
+    ap.add_argument("--spans", metavar="FILE",
+                    help="render a span JSON-lines file "
+                    "(tools/serve.py --trace-out) as a per-job latency "
+                    "breakdown + batch table")
+    ap.add_argument("--metrics", metavar="FILE",
+                    help="render a Prometheus text exposition "
+                    "(tools/serve.py --metrics-out) as metric "
+                    "summaries")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--summary", action="store_true",
                     help="emit per-timeline summaries only (peak "
                     "injection rate, clock spread, stall quanta, ...)")
     args = ap.parse_args(argv)
 
+    modes = sum((bool(args.files), bool(args.spans), bool(args.metrics)))
+    if modes != 1:
+        ap.error("give exactly one input: timeline .npz file(s), "
+                 "--spans FILE, or --metrics FILE")
+
     # pure host-side post-processing — never touch a chip
     import os
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.spans:
+        for line in render_spans(args.spans, args.format):
+            print(line)
+        return 0
+    if args.metrics:
+        for line in render_metrics(args.metrics, args.format):
+            print(line)
+        return 0
 
     from graphite_tpu.obs import Timeline
 

@@ -16,7 +16,10 @@ Job-spec line schema (all fields except `id` optional):
    "network": "emesh_hop_counter",
    "knobs": {"dram_latency_ns": 120, ...},   // traced sweep knobs
    "clock_scheme": "lax_barrier",  // lax_barrier | lax | lax_p2p
-   "telemetry": {"sample_interval_ps": 1000000, "n_samples": 64}}
+   "telemetry": {"sample_interval_ps": 1000000, "n_samples": 64,
+                 // optional energy_pj series: explicit pJ prices, or
+                 // {"node_nm": 45} to price via the native power model
+                 "energy": {"instruction_pj": 2, "l2_miss_pj": 120}}}
 
 Usage:
   python -m graphite_tpu.tools.serve --jobs jobs.jsonl --budget-bytes 2e9
@@ -26,6 +29,15 @@ Usage:
 `--dryrun` pins JAX to CPU and serves a built-in mixed-geometry,
 mixed-knob demo job set — the smoke shape `tools/regress.py --smoke`'s
 serve rung also exercises.
+
+Observability (round 14): `--trace-out spans.jsonl` records every
+job's lifecycle spans (submit → validate → admit → queue dwell →
+execute → emit) plus per-batch execution spans and writes them as
+JSON-lines (`tools/report.py --spans` renders the per-job latency
+breakdown); `--metrics-out metrics.prom` dumps the service's metrics
+registry in Prometheus text format (`tools/report.py --metrics`
+renders it); the trailing summary line always embeds the JSON metrics
+snapshot under "metrics" alongside the round-13 counter keys.
 """
 
 from __future__ import annotations
@@ -45,6 +57,10 @@ DRYRUN_JOBS = [
     {"id": "d3", "tiles": 8, "seed": 4, "accesses": 10},
     {"id": "d4", "tiles": 4, "seed": 5, "accesses": 10,
      "knobs": {"hop_latency_cycles": 3}},
+    {"id": "d5", "tiles": 4, "seed": 6, "accesses": 10,
+     "telemetry": {"sample_interval_ps": 1_000_000, "n_samples": 16,
+                   "energy": {"instruction_pj": 2, "l2_miss_pj": 120,
+                              "dram_access_pj": 500}}},
 ]
 
 
@@ -88,11 +104,27 @@ def build_job(spec: dict, config_cache: dict):
         trace = BENCHMARKS[workload](tiles)
     telemetry = None
     if spec.get("telemetry"):
+        from graphite_tpu.obs import EnergyPrices
+
         t = spec["telemetry"]
+        prices = None
+        if t.get("energy"):
+            e = t["energy"]
+            if not isinstance(e, dict):
+                raise ValueError(
+                    "telemetry.energy must be a dict of pJ prices or "
+                    '{"node_nm": N} for the native power model')
+            if "node_nm" in e:
+                prices = EnergyPrices.from_power_model(
+                    int(e["node_nm"]),
+                    voltage=float(e.get("voltage", 1.0)))
+            else:
+                prices = EnergyPrices(**e)
         telemetry = TelemetrySpec(
             sample_interval_ps=int(t["sample_interval_ps"]),
             n_samples=int(t.get("n_samples", 256)),
-            series=tuple(t["series"]) if t.get("series") else None)
+            series=tuple(t["series"]) if t.get("series") else None,
+            energy_prices=prices)
     return Job(job_id=str(spec["id"]), config=sc, trace=trace,
                knobs=dict(spec.get("knobs", {})), telemetry=telemetry,
                seed=seed, clock_scheme=spec.get("clock_scheme"))
@@ -115,6 +147,14 @@ def main(argv=None) -> int:
     ap.add_argument("--verify-hits", action="store_true",
                     help="re-lower every cache hit and re-prove "
                     "fingerprint equality (retrace, never recompile)")
+    ap.add_argument("--trace-out", metavar="FILE",
+                    help="enable span tracing and write job/batch "
+                    "lifecycle spans as JSON-lines on exit "
+                    "(render: tools/report.py --spans FILE)")
+    ap.add_argument("--metrics-out", metavar="FILE",
+                    help="write the metrics registry as Prometheus "
+                    "text exposition on exit "
+                    "(render: tools/report.py --metrics FILE)")
     ap.add_argument("--dryrun", action="store_true",
                     help="CPU smoke: force JAX_PLATFORMS=cpu and serve "
                     "a built-in mixed demo job set")
@@ -155,7 +195,8 @@ def main(argv=None) -> int:
         cache_bytes=int(args.cache_bytes),
         max_pending=args.max_pending,
         max_quanta=args.max_quanta,
-        verify_hits=args.verify_hits)
+        verify_hits=args.verify_hits,
+        tracing=bool(args.trace_out))
 
     config_cache: dict = {}
     t0 = time.perf_counter()
@@ -187,11 +228,30 @@ def main(argv=None) -> int:
         print(json.dumps(res.to_json()), flush=True)
     counters = service.counters
     failures += counters["failed"]
+    if args.trace_out:
+        n_spans = service.export_spans(args.trace_out)
+        print(json.dumps({"trace_out": args.trace_out,
+                          "spans": n_spans}), flush=True)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as fh:
+            fh.write(service.metrics.exposition())
+
+    def _round(v):
+        if isinstance(v, float):
+            return round(v, 6)
+        if isinstance(v, dict):
+            return {k: _round(x) for k, x in v.items()}
+        return v
+
     print(json.dumps({
         "summary": True,
         "wall_s": round(time.perf_counter() - t0, 3),
         **{k: (round(v, 4) if isinstance(v, float) else v)
            for k, v in counters.items()},
+        # the registry's JSON snapshot rides the summary line — one
+        # artifact holds both the compatibility counters and the
+        # histogram summaries (count/sum/p50/p90/p99)
+        "metrics": _round(service.metrics.snapshot()),
         "dryrun": bool(args.dryrun),
     }))
     return 1 if failures else 0

@@ -323,6 +323,128 @@ class TestRecording:
         assert "mean_clock_spread_ps" in capsys.readouterr().out
 
 
+class TestEnergySeries:
+    """The round-14 `energy_pj` series: cumulative event energy priced
+    from the carry's own counters (opt-in via EnergyPrices — the dense
+    default selection, and every locked program, is unchanged)."""
+
+    PRICES = None   # built lazily (EnergyPrices import at class scope)
+
+    def _prices(self):
+        from graphite_tpu.obs import EnergyPrices
+
+        return EnergyPrices(
+            instruction_pj=3, l1i_access_pj=1, l1d_access_pj=2,
+            l2_access_pj=9, l2_miss_pj=120, invalidation_pj=15,
+            eviction_pj=20, dram_access_pj=500, packet_pj=7)
+
+    def _energy_of(self, instr, sent, mc):
+        """The hand-stepped power-model sum: every counter priced by
+        the same pJ table the device row folds in."""
+        return (3 * int(instr.sum()) + 7 * int(sent.sum())
+                + 1 * int(mc.l1i_hits.sum() + mc.l1i_misses.sum())
+                + 2 * int(mc.l1d_read_hits.sum()
+                          + mc.l1d_read_misses.sum()
+                          + mc.l1d_write_hits.sum()
+                          + mc.l1d_write_misses.sum())
+                + 9 * int(mc.l2_hits.sum() + mc.l2_misses.sum())
+                + 120 * int(mc.l2_misses.sum())
+                + 15 * int(mc.invalidations.sum())
+                + 20 * int(mc.evictions.sum())
+                + 500 * int(mc.dram_reads.sum()
+                            + mc.dram_writes.sum()))
+
+    def test_energy_rows_match_hand_stepped_power_sum(self):
+        """Oracle: step the same sim quantum by quantum from the host,
+        price the fetched counters by hand, difference, and require the
+        device energy column to match exactly — and the telemetry run's
+        SimResults to stay bit-equal to the plain run's."""
+        batch = _trace()
+        spec = _spec(series=("instructions", "energy_pj"))
+        spec = TelemetrySpec(
+            sample_interval_ps=spec.sample_interval_ps,
+            n_samples=spec.n_samples, series=spec.series,
+            energy_prices=self._prices())
+        simt = Simulator(_config(), batch, telemetry=spec)
+        res = simt.run()
+        tl = res.telemetry
+        assert tl.series == ("time_ps", "instructions", "energy_pj")
+
+        ref = Simulator(_config(), batch)
+        prev_e = 0
+        rows = []
+        interval = QUANTUM_PS
+        next_ps = interval
+        for _ in range(10_000):
+            done, _ = ref.run_chunk(1)
+            st = ref.state
+            clocks, done_mask, instr, sent = jax.device_get(
+                (st.core.clock_ps, st.done, st.core.instruction_count,
+                 st.net.packets_sent))
+            mc = jax.device_get(st.mem.counters)
+            pending = clocks[~done_mask]
+            sim_time = int(pending.min() if pending.size
+                           else clocks.max())
+            cur_e = self._energy_of(instr, sent, mc)
+            if sim_time >= next_ps or done:
+                rows.append(cur_e - prev_e)
+                prev_e = cur_e
+                next_ps = (sim_time // interval + 1) * interval
+            if done:
+                break
+        assert done
+        np.testing.assert_array_equal(tl.col("energy_pj"),
+                                      np.array(rows, np.int64))
+        # pure observability: the priced run's results are bit-equal
+        r_off = Simulator(_config(), batch).run()
+        np.testing.assert_array_equal(res.clock_ps, r_off.clock_ps)
+        for k in r_off.mem_counters:
+            np.testing.assert_array_equal(
+                res.mem_counters[k], r_off.mem_counters[k], err_msg=k)
+
+    def test_telemetry_off_lint_covers_energy_ring(self):
+        """Telemetry-OFF specs carry the dense-plus-energy ring sig
+        (one series wider), and the aval scan fires on a program that
+        materializes it."""
+        from graphite_tpu.analysis.audit import spec_from_simulator
+
+        sim = Simulator(_config(), _trace())
+        spec = spec_from_simulator("off", sim, max_quanta=512)
+        assert spec.telemetry_extra_sigs
+        (S, n), dt = spec.telemetry_sig
+        assert spec.telemetry_extra_sigs[0] == ((S, n + 1), dt)
+
+        def bad(x):
+            buf = jnp.zeros((S, n + 1), jnp.int64)
+            return buf.at[0, 0].set(x)
+
+        closed = jax.make_jaxpr(bad)(jnp.asarray(1, jnp.int64))
+        fs = rules.telemetry_off(closed, ["x"],
+                                 ring_sigs=spec.telemetry_extra_sigs)
+        assert fs and fs[0].data["shape"] == [S, n + 1]
+        # ... and the real telemetry-off program still passes with the
+        # widened sig set (no false positive from the extra aval)
+        assert not rules.telemetry_off(
+            spec.closed, spec.invar_paths,
+            ring_sigs=(spec.telemetry_sig,) + spec.telemetry_extra_sigs)
+
+    def test_energy_program_passes_audit(self):
+        """An energy-recording program clears every lint: the widened
+        ring rides no cond, no host sync, gates intact."""
+        from graphite_tpu.analysis.audit import audit, \
+            spec_from_simulator
+
+        spec_tel = TelemetrySpec(sample_interval_ps=QUANTUM_PS,
+                                 n_samples=32,
+                                 energy_prices=self._prices())
+        simt = Simulator(_config(), _trace(), phase_gate=True,
+                         mem_gate_bytes=0, telemetry=spec_tel)
+        spec = spec_from_simulator("tel-energy", simt, max_quanta=512)
+        assert spec.expect_telemetry
+        report = audit([spec])
+        assert report.ok, [str(f) for f in report.errors]
+
+
 class TestSweepDemux:
     def test_vmap_campaign_demuxes_per_sim_timelines(self):
         from graphite_tpu.sweep import SweepRunner
