@@ -342,6 +342,75 @@ scheme = lax
                 "mean_clock_spread_ps"),
         })
 
+    # Spatial-profiler overhead (round 16, obs/profile.py): warm
+    # per-iteration cost of recording the DENSE per-tile [S, T, m] ring
+    # (every available tile series, S=256, sampled every quantum — the
+    # worst case) vs the scalar-telemetry-only ring vs recording
+    # nothing, on the same 16-tile coherence program.  MEDIANS of
+    # BENCH_PROFILE_REPS warm runs (per-run wall on CPU is noisy at
+    # this size), plus the ring's residency bill and the straggler
+    # summary CI tracks.  Skippable via BENCH_PROFILE=0.
+    if os.environ.get("BENCH_PROFILE", "1") != "0":
+        import statistics as _stats
+
+        from graphite_tpu.obs import ProfileSpec, TelemetrySpec
+        from graphite_tpu.tools._template import config_text
+
+        pf_tiles = int(os.environ.get("BENCH_PROFILE_TILES", "16"))
+        reps = max(1, int(os.environ.get("BENCH_PROFILE_REPS", "3")))
+        sc_pf = SimConfig(ConfigFile.from_string(config_text(
+            pf_tiles, shared_mem=True, clock_scheme="lax_barrier")))
+        pf_trace = synthetic.memory_stress_trace(
+            pf_tiles, n_accesses=24, working_set_bytes=1 << 13,
+            write_fraction=0.4, shared_fraction=0.5, seed=7)
+
+        def _median_ms_iter(mk):
+            # run() consumes self.state (a finished sim re-runs as a
+            # no-op), so each rep gets a FRESH instance adopting the
+            # warmed donor's compiled runner — every sample times a
+            # full run, none times a retrace
+            donor = mk()
+            donor.warmup()
+            samples = []
+            res2 = sim2 = None
+            for _ in range(reps):
+                sim2 = mk()
+                sim2.adopt_runner(donor)
+                t0 = time.perf_counter()
+                res2 = sim2.run()
+                wall = time.perf_counter() - t0
+                assert int(sim2.last_n_iterations) > 0
+                samples.append(
+                    1000 * wall / int(sim2.last_n_iterations))
+            return _stats.median(samples), res2, sim2
+
+        probe = Simulator(sc_pf, pf_trace)
+        qps_pf = int(probe.quantum_ps)
+        tel_spec = TelemetrySpec(sample_interval_ps=qps_pf,
+                                 n_samples=256)
+        prof_spec = ProfileSpec(sample_interval_ps=qps_pf,
+                                n_samples=256)
+        ms_pf_off, _, _ = _median_ms_iter(
+            lambda: Simulator(sc_pf, pf_trace))
+        ms_pf_tel, _, _ = _median_ms_iter(
+            lambda: Simulator(sc_pf, pf_trace, telemetry=tel_spec))
+        ms_pf_on, pf_res, pf_sim = _median_ms_iter(
+            lambda: Simulator(sc_pf, pf_trace, telemetry=tel_spec,
+                              profile=prof_spec))
+        pf_summary = pf_res.profile.summary()
+        companions.update({
+            "ms_per_iter_profile_off": round(ms_pf_off, 4),
+            "ms_per_iter_telemetry_only": round(ms_pf_tel, 4),
+            "ms_per_iter_profile": round(ms_pf_on, 4),
+            "profile_overhead_pct": round(
+                100 * (ms_pf_on / ms_pf_tel - 1), 2),
+            "profile_ring_bytes": int(
+                pf_sim.residency_breakdown()["profile"]),
+            "profile_max_skew_ps": pf_summary.get("max_skew_ps"),
+            "profile_straggler_tile": pf_summary.get("straggler_tile"),
+            "profile_traffic_gini": pf_summary.get("traffic_gini"),
+        })
+
     # Campaign-service throughput (round 13, serve/ subsystem): N
     # same-class jobs submitted through the admission-controlled
     # service, batched and served off the fingerprint-keyed compiled-

@@ -72,6 +72,13 @@ class ProgramSpec:
     # (round 14: the dense-plus-energy ring, one series wider — the
     # telemetry-off scan covers the energy series too)
     telemetry_extra_sigs: "tuple" = ()
+    # round 16: the spatial profiler's [S, T, m] per-tile ring, policed
+    # by the same machinery — profile-ON programs forbid the ring as a
+    # cond payload; profile-OFF programs run the profile-off rule over
+    # the canonical dense (and dense-plus-energy) per-tile ring sigs
+    expect_profile: bool = False
+    profile_sig: "tuple | None" = None     # ((S, T, m), dtype)
+    profile_extra_sigs: "tuple" = ()
     # round 10: the engine's protocol-phase names in phase-cond program
     # order, so the cost model (analysis/cost.py) can attribute the
     # per-iteration kernel proxy phase-by-phase
@@ -147,6 +154,29 @@ def _telemetry_fields(sim):
     return (), False, dense_sig, (energy_sig,)
 
 
+def _profile_fields(sim):
+    """The spatial-profiler policing shared by both spec builders:
+    (extra forbidden cond avals, expect_profile, profile_sig,
+    profile_extra_sigs) — the round-16 twin of `_telemetry_fields`.
+    Profile-ON programs forbid the attached spec's actual [S, T, m]
+    ring as a cond payload; profile-OFF programs get the canonical
+    dense per-tile ring sig (default S, every available tile series)
+    plus the dense-plus-energy variant, so the profile-off aval scan
+    stays a live check."""
+    prof = getattr(sim, "profile_spec", None)
+    if prof is not None:
+        return (prof.buffer_sig(),), True, prof.buffer_sig(), ()
+    from graphite_tpu.obs.profile import ProfileSpec
+    from graphite_tpu.obs.telemetry import EnergyPrices
+
+    dense_sig = ProfileSpec(sample_interval_ps=1).resolve(
+        sim.params).buffer_sig()
+    energy_sig = ProfileSpec(
+        sample_interval_ps=1,
+        energy_prices=EnergyPrices()).resolve(sim.params).buffer_sig()
+    return (), False, dense_sig, (energy_sig,)
+
+
 def spec_from_simulator(name: str, sim,
                         max_quanta: int = 4096) -> ProgramSpec:
     """Lower a Simulator's single-device resident program into a spec."""
@@ -160,15 +190,21 @@ def spec_from_simulator(name: str, sim,
     n_phases = len(phase_names) if phase_names else 6
     tel_forbidden, expect_tel, tel_sig, tel_extra = \
         _telemetry_fields(sim)
+    prof_forbidden, expect_prof, prof_sig, prof_extra = \
+        _profile_fields(sim)
     return ProgramSpec(
         name=name, closed=closed, invar_paths=paths,
         n_tiles=sim.params.n_tiles, expect_gated=expect_gated,
         n_phases=n_phases,
-        forbidden_cond_avals=_mem_forbidden_avals(sim) + tel_forbidden,
+        forbidden_cond_avals=(_mem_forbidden_avals(sim) + tel_forbidden
+                              + prof_forbidden),
         clock_invars=clock_invar_indices(paths),
         expect_telemetry=expect_tel,
         telemetry_sig=tel_sig,
         telemetry_extra_sigs=tel_extra,
+        expect_profile=expect_prof,
+        profile_sig=prof_sig,
+        profile_extra_sigs=prof_extra,
         phase_names=phase_names)
 
 
@@ -210,15 +246,21 @@ def spec_from_sweep(name: str, runner,
     n_phases = len(phase_names) if phase_names else 6
     tel_forbidden, expect_tel, tel_sig, tel_extra = \
         _telemetry_fields(sim)
+    prof_forbidden, expect_prof, prof_sig, prof_extra = \
+        _profile_fields(sim)
     return ProgramSpec(
         name=name, closed=closed, invar_paths=paths,
         n_tiles=sim.params.n_tiles, expect_gated=expect_gated,
         n_phases=n_phases, knob_invars=knob_invars,
-        forbidden_cond_avals=_mem_forbidden_avals(sim) + tel_forbidden,
+        forbidden_cond_avals=(_mem_forbidden_avals(sim) + tel_forbidden
+                              + prof_forbidden),
         clock_invars=clock_invar_indices(paths),
         expect_telemetry=expect_tel,
         telemetry_sig=tel_sig,
         telemetry_extra_sigs=tel_extra,
+        expect_profile=expect_prof,
+        profile_sig=prof_sig,
+        profile_extra_sigs=prof_extra,
         phase_names=phase_names,
         batched=not runner.shard_batch or runner._sims_per_dev > 1)
 
@@ -378,7 +420,8 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
 # ---------------------------------------------------------------------------
 
 RULE_NAMES = ("cond-payload", "knob-fold", "time-dtype", "vmap-gate",
-              "host-sync", "scatter-determinism", "telemetry-off")
+              "host-sync", "scatter-determinism", "telemetry-off",
+              "profile-off")
 
 
 @dataclasses.dataclass
@@ -471,6 +514,15 @@ def audit_program(spec: ProgramSpec, *,
             ring_sigs=(((spec.telemetry_sig,)
                         if spec.telemetry_sig is not None else ())
                        + tuple(spec.telemetry_extra_sigs))))
+    if not spec.expect_profile:
+        # profile-OFF programs must carry no trace of the spatial
+        # profiler — same rule, profile state key + [S, T, m] ring sigs
+        add("profile-off", rules.telemetry_off(
+            spec.closed, spec.invar_paths,
+            ring_sigs=(((spec.profile_sig,)
+                        if spec.profile_sig is not None else ())
+                       + tuple(spec.profile_extra_sigs)),
+            state_key="profile", rule="profile-off"))
     return results
 
 

@@ -93,7 +93,7 @@ def trace_record_bytes(trace) -> int:
 
 
 def residency_breakdown(*, state=None, trace=None, batch: int = 1,
-                        telemetry_spec=None,
+                        telemetry_spec=None, profile_spec=None,
                         stream_window_bytes: "int | None" = None,
                         ) -> "dict[str, int]":
     """Itemized HBM residency estimate, bytes per consumer.
@@ -102,7 +102,9 @@ def residency_breakdown(*, state=None, trace=None, batch: int = 1,
     broadcasts B copies).  `trace`: the RESIDENT trace pytree — for a
     campaign pass the packed [B, T, L] arrays (already batch-shaped, so
     NOT multiplied).  `telemetry_spec`: a resolved obs.TelemetrySpec
-    whose ring rides each sim's carry (x batch).  `stream_window_bytes`:
+    whose ring rides each sim's carry (x batch).  `profile_spec`: a
+    resolved obs.ProfileSpec whose [S, T, m] per-tile ring rides each
+    sim's carry (x batch).  `stream_window_bytes`:
     the host->HBM window bound of a streaming run.  Returns consumer ->
     bytes plus a "total" key.  The while-carry double-buffer is NOT
     applied here (it is program-dependent); `CostReport.peak_bytes` is
@@ -116,6 +118,9 @@ def residency_breakdown(*, state=None, trace=None, batch: int = 1,
     if telemetry_spec is not None:
         out["telemetry"] = int(telemetry_ring_bytes(telemetry_spec)) \
             * int(batch)
+    if profile_spec is not None:
+        out["profile"] = int(profile_ring_bytes(profile_spec)) \
+            * int(batch)
     if stream_window_bytes is not None:
         out["stream_window"] = int(stream_window_bytes)
     out["total"] = sum(out.values())
@@ -127,6 +132,14 @@ def telemetry_ring_bytes(spec) -> int:
     prev snapshot + cursors) — delegates to the spec's own accounting
     (obs.TelemetrySpec.ring_bytes) so the ONE size model feeds both the
     residency budget and the refusal messages."""
+    return int(spec.ring_bytes())
+
+
+def profile_ring_bytes(spec) -> int:
+    """Per-sim bytes of a per-tile profile spec's device-resident state
+    (the [S, T, m] ring + prev snapshot + times + cursors) — delegates
+    to obs.ProfileSpec.ring_bytes, the ONE size model the admission
+    bill and the refusal messages share."""
     return int(spec.ring_bytes())
 
 

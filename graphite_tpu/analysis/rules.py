@@ -42,6 +42,12 @@ a Python-level abstraction:
                 the pre-telemetry one).  Telemetry-ON programs instead
                 add the ring's aval to the cond-payload forbidden set:
                 no phase cond may ever carry the buffer.
+  profile-off   the same rule over the round-16 spatial profiler
+                (telemetry_off with state_key="profile"): a
+                profile=None program carries no profile-state invar and
+                no [S, T, m] per-tile ring equation; profile-ON
+                programs add that ring's aval to the cond-payload
+                forbidden set instead.
 
 Rules return `Finding` lists; `analysis/audit.py` assembles them into
 per-program reports and the `tools/audit.py` CLI emits them as JSON
@@ -446,26 +452,30 @@ def scatter_determinism(jaxpr, *, batched: bool = False,
 # ---------------------------------------------------------------------------
 
 
-def telemetry_off(jaxpr, invar_paths=None,
-                  ring_sigs=()) -> "list[Finding]":
-    """A telemetry=None program must record nothing.
+def telemetry_off(jaxpr, invar_paths=None, ring_sigs=(), *,
+                  state_key: str = "telemetry",
+                  rule: str = "telemetry-off") -> "list[Finding]":
+    """A telemetry=None (or profile=None) program must record nothing.
 
-    Two checks: (a) no invar path names a telemetry-state leaf — the
-    None spec must contribute ZERO pytree leaves to the carry (the
-    SimState.telemetry=None contract), and (b) no equation anywhere in
-    the program produces a ring-buffer aval from `ring_sigs` (matched
-    modulo leading batch axes, like cond-payload's forbidden set) — a
-    ring materialized internally would mean the recording survived
-    constant folding.  Either finding breaks the round-7-style
-    "telemetry=None lowers the historical program bit-identically"
-    guarantee every overhead claim rests on.
+    Two checks: (a) no invar path names a `state_key` recording-state
+    leaf — the None spec must contribute ZERO pytree leaves to the
+    carry (the SimState.telemetry=None / SimState.profile=None
+    contract), and (b) no equation anywhere in the program produces a
+    ring-buffer aval from `ring_sigs` (matched modulo leading batch
+    axes, like cond-payload's forbidden set) — a ring materialized
+    internally would mean the recording survived constant folding.
+    Either finding breaks the round-7-style "None lowers the
+    historical program bit-identically" guarantee every overhead claim
+    rests on.  The round-16 spatial profiler runs the same rule with
+    `state_key="profile"` / `rule="profile-off"` over the [S, T, m]
+    ring signatures.
     """
     out = []
     for i, p in enumerate(invar_paths or ()):
-        if "telemetry" in p:
+        if state_key in p:
             out.append(Finding(
-                "telemetry-off", SEV_ERROR, "jaxpr.invars",
-                f"telemetry-off program carries a telemetry-state "
+                rule, SEV_ERROR, "jaxpr.invars",
+                f"{rule} program carries a {state_key}-state "
                 f"invar {p!r} (index {i}) — the None spec must add no "
                 f"leaves to the carry",
                 data={"invar": i, "path": p}))
@@ -477,9 +487,9 @@ def telemetry_off(jaxpr, invar_paths=None,
                 for fs in ring_sigs:
                     if _sig_matches(sig, fs):
                         out.append(Finding(
-                            "telemetry-off", SEV_ERROR, site,
-                            f"telemetry-off program contains a "
-                            f"timeline-store equation "
+                            rule, SEV_ERROR, site,
+                            f"{rule} program contains a "
+                            f"ring-store equation "
                             f"({eqn.primitive.name} output {k}, "
                             f"{sig[0]} {sig[1]}) — the recording was "
                             f"not constant-folded away",

@@ -66,6 +66,10 @@ class SimResults:
     # telemetry-enabled run's other fields are bit-equal to its
     # telemetry=None twin (pinned in tests/test_telemetry.py)
     telemetry: "object | None" = None
+    # device-recorded per-tile profile (obs.TileProfile) when the run
+    # was built with a ProfileSpec, else None.  Same pure-observability
+    # contract as telemetry (pinned in tests/test_profile.py)
+    profile: "object | None" = None
 
     @property
     def total_instructions(self) -> int:
@@ -352,6 +356,7 @@ class Simulator:
         mem_gate_bytes: int | None = None,
         barrier_batch: int | None = None,
         telemetry=None,
+        profile=None,
         base_consolidate: bool | None = None,
     ):
         """`dir_stage`: force the directory write-staging path on/off
@@ -391,6 +396,12 @@ class Simulator:
         read back post-run via `Simulator.telemetry` /
         `SimResults.telemetry`).  None — the default — lowers a
         bit-identical program (the knobs=None contract).
+
+        `profile`: an `obs.ProfileSpec` to record the device-resident
+        PER-TILE profile ring ([S, T, m], sampled on the same
+        simulated-time boundaries as telemetry; read back via
+        `Simulator.profile` / `SimResults.profile`).  Same None
+        bit-identity contract, enforced by the `profile-off` lint.
 
         `donate=True` gives the input state's device buffers to XLA each
         run (halves big-state HBM residency — required for the 1024-tile
@@ -789,8 +800,13 @@ class Simulator:
         # into the state carry; None records nothing and lowers the
         # historical program bit-identically
         self.telemetry_spec = None
+        # device-resident per-tile profile ring (graphite_tpu/obs/
+        # profile.py): same attach/resolve/None-contract as telemetry
+        self.profile_spec = None
         if telemetry is not None:
             self.attach_telemetry(telemetry)
+        if profile is not None:
+            self.attach_profile(profile)
 
     def attach_telemetry(self, spec) -> None:
         """Attach (or replace) a telemetry spec on a not-yet-run
@@ -826,22 +842,62 @@ class Simulator:
         self._lowered = {}   # the spec is baked into the lowering too
         self.lower_gen += 1
 
-    def residency_breakdown(self, telemetry_spec=None) -> dict:
+    def attach_profile(self, spec) -> None:
+        """Attach (or replace) a per-tile profile spec on a not-yet-run
+        instance: resolves the series selection against this program,
+        seeds the [S, T, m] ring into the state carry, and invalidates
+        any compiled runner (the spec is baked into the lowering) —
+        the spatial-profiler twin of `attach_telemetry`."""
+        from graphite_tpu.obs.profile import ProfileSpec, init_profile
+
+        if not isinstance(spec, ProfileSpec):
+            raise TypeError("profile must be an obs.ProfileSpec")
+        spec = spec.resolve(self.params)
+        if self.mesh is not None or self.stream:
+            from graphite_tpu.analysis.cost import (
+                ResidencyBudgetError, format_breakdown,
+            )
+
+            raise ResidencyBudgetError(
+                "per-tile profile rings support single-device resident "
+                "runs and batched sweeps only (the ring is not threaded "
+                "through the multi-chip exchange or the streaming "
+                "window loop); refused residency: "
+                + format_breakdown(
+                    self.residency_breakdown(profile_spec=spec)))
+        self.profile_spec = spec
+        self.state = self.state.replace(profile=init_profile(spec))
+        self._runner = None
+        self._runner_max_quanta = None
+        self._hb_runner = None
+        self._lowered = {}   # the spec is baked into the lowering too
+        self.lower_gen += 1
+
+    def residency_breakdown(self, telemetry_spec=None,
+                            profile_spec=None) -> dict:
         """Per-consumer HBM residency estimate of THIS sim's layout
         (analysis/cost.residency_breakdown): state pytree, resident
-        device trace (or one streaming window bound), telemetry ring.
-        `telemetry_spec` overrides the attached spec — attach_telemetry
-        prices the spec it is refusing before it is attached."""
+        device trace (or one streaming window bound), telemetry ring,
+        per-tile profile ring.  `telemetry_spec`/`profile_spec`
+        override the attached specs — the attach_* refusal paths price
+        the spec they are refusing before it is attached."""
         from graphite_tpu.analysis.cost import residency_breakdown
 
         spec = telemetry_spec if telemetry_spec is not None \
             else self.telemetry_spec
         if spec is not None and not spec.resolved:
             spec = spec.resolve(self.params)
-        # the ring is itemized as its own consumer — strip it from the
-        # state pytree so an attached spec is not counted twice
-        state = self.state.replace(telemetry=None) \
-            if self.state.telemetry is not None else self.state
+        pspec = profile_spec if profile_spec is not None \
+            else self.profile_spec
+        if pspec is not None and not pspec.resolved:
+            pspec = pspec.resolve(self.params)
+        # the rings are itemized as their own consumers — strip them
+        # from the state pytree so an attached spec is not counted twice
+        state = self.state
+        if state.telemetry is not None:
+            state = state.replace(telemetry=None)
+        if state.profile is not None:
+            state = state.replace(profile=None)
         stream_bytes = None
         if self.stream:
             # run_streamed's default [T, W] window, double-buffered by
@@ -855,7 +911,18 @@ class Simulator:
                             * trace_record_bytes(self.trace_batch))
         return residency_breakdown(
             state=state, trace=self.device_trace,
-            telemetry_spec=spec, stream_window_bytes=stream_bytes)
+            telemetry_spec=spec, profile_spec=pspec,
+            stream_window_bytes=stream_bytes)
+
+    @property
+    def profile(self):
+        """The recorded per-tile profile (obs.TileProfile) of
+        everything run so far, or None when the sim records none."""
+        if self.profile_spec is None:
+            return None
+        from graphite_tpu.obs.profile import profile_from_state
+
+        return profile_from_state(self.profile_spec, self.state.profile)
 
     @property
     def telemetry(self):
@@ -927,7 +994,8 @@ class Simulator:
                 self._runner = make_simulation_runner(
                     self.params, self.device_trace, self.quantum_ps,
                     max_quanta, donate=self.donate,
-                    telemetry=self.telemetry_spec)
+                    telemetry=self.telemetry_spec,
+                    profile=self.profile_spec)
             self._runner_max_quanta = max_quanta
         return self._runner
 
@@ -972,6 +1040,7 @@ class Simulator:
                 "(the auditable artifact is the one-region jaxpr)")
         params = self.params
         tel = self.telemetry_spec
+        prof = self.profile_spec
         if self.barrier_host:
             from graphite_tpu.engine.step import barrier_host_batch
 
@@ -979,7 +1048,8 @@ class Simulator:
 
             def fn(st, tr, prev_qend, budget):
                 return barrier_host_batch(params, tr, st, prev_qend,
-                                          qps, budget, telemetry=tel)
+                                          qps, budget, telemetry=tel,
+                                          profile=prof)
 
             args = (self.state, self.device_trace,
                     jnp.asarray(0, jnp.int64),
@@ -991,7 +1061,7 @@ class Simulator:
 
             def fn(st, tr):
                 return run_simulation(params, tr, st, qps, max_quanta,
-                                      telemetry=tel)
+                                      telemetry=tel, profile=prof)
 
             args = (self.state, self.device_trace)
         return fn, args
@@ -1047,10 +1117,12 @@ class Simulator:
             params, trace = self.params, self.device_trace
             qps = int(self.quantum_ps)
             tel = self.telemetry_spec
+            prof = self.profile_spec
 
             def qrun(st, prev_qend, budget):
                 return barrier_host_batch(params, trace, st, prev_qend,
-                                          qps, budget, telemetry=tel)
+                                          qps, budget, telemetry=tel,
+                                          profile=prof)
 
             self._hb_runner = jax.jit(
                 qrun, donate_argnums=(0,) if self.donate else ())
@@ -1126,7 +1198,11 @@ class Simulator:
             (state.telemetry.buf, state.telemetry.count)
             if state.telemetry is not None else None
         )
-        return net_part, mem_part, ioc_part, tel_part
+        prof_part = (
+            (state.profile.buf, state.profile.times, state.profile.count)
+            if state.profile is not None else None
+        )
+        return net_part, mem_part, ioc_part, tel_part, prof_part
 
     def _timeline_host(self, tel_h):
         """Demux an already-fetched (buf, count) pair into a Timeline —
@@ -1140,15 +1216,31 @@ class Simulator:
         return Timeline.from_host_state(self.telemetry_spec,
                                         np.asarray(buf), int(count))
 
+    def _profile_host(self, prof_h):
+        """Demux an already-fetched (buf, times, count) triple into a
+        TileProfile — rides run()'s ONE batched device→host fetch like
+        the telemetry ring."""
+        if prof_h is None or self.profile_spec is None:
+            return None
+        from graphite_tpu.obs.profile import TileProfile
+
+        buf, times, count = prof_h
+        return TileProfile.from_host_state(
+            self.profile_spec, np.asarray(buf), np.asarray(times),
+            int(count))
+
     def _results_from_state(self, n_quanta: int) -> SimResults:
         """SimResults from the CURRENT state (after run_chunk loops)."""
         state = self.state
-        net_part, mem_part, ioc_part, tel_part = self._result_parts(state)
-        core_h, net_h, mem_h, ioc_h, tel_h = jax.device_get((
+        (net_part, mem_part, ioc_part, tel_part,
+         prof_part) = self._result_parts(state)
+        core_h, net_h, mem_h, ioc_h, tel_h, prof_h = jax.device_get((
             state.core, net_part, mem_part, ioc_part, tel_part,
+            prof_part,
         ))
         return self._results_host(core_h, net_h, mem_h, n_quanta, ioc_h,
-                                  telemetry=self._timeline_host(tel_h))
+                                  telemetry=self._timeline_host(tel_h),
+                                  profile=self._profile_host(prof_h))
 
     def write_output(self, results: SimResults,
                      output_dir: str = "results") -> str:
@@ -1323,10 +1415,15 @@ class Simulator:
                 or other.donate != self.donate
                 or other.barrier_host != self.barrier_host
                 or other.barrier_batch != self.barrier_batch
+                # the recording specs are baked into the lowering: an
+                # adopted runner with different specs would silently
+                # record nothing (or retrace) instead of refusing
+                or other.telemetry_spec != self.telemetry_spec
+                or other.profile_spec != self.profile_spec
                 or other.trace_batch is not self.trace_batch):
             raise ValueError(
                 "adopt_runner needs the same trace batch and identical "
-                "config/program/quantum/mesh/donation")
+                "config/program/quantum/mesh/donation/recording specs")
         # the adopted runner closes over the donor's device trace — drop
         # this instance's duplicate upload (matters at 1024-tile scale)
         self.device_trace = other.device_trace
@@ -1361,13 +1458,15 @@ class Simulator:
         # ONE batched device→host fetch for control flags + all summary
         # counters + the telemetry ring (each separate read over a
         # tunneled chip costs ~100 ms).
-        net_part, mem_part, ioc_part, tel_part = self._result_parts(state)
+        (net_part, mem_part, ioc_part, tel_part,
+         prof_part) = self._result_parts(state)
         host = jax.device_get((
             n_quanta_dev, deadlock_dev, state.net.overflow, state.done,
-            state.core, net_part, mem_part, ioc_part, tel_part, n_iters,
+            state.core, net_part, mem_part, ioc_part, tel_part,
+            prof_part, n_iters,
         ))
         (n_quanta, deadlock, overflow, done, core_h, net_h, mem_h,
-         ioc_h, tel_h, self.last_n_iterations) = host
+         ioc_h, tel_h, prof_h, self.last_n_iterations) = host
         if bool(overflow):
             raise MailboxOverflowError(
                 "a (dst,src) mailbox ring overflowed; re-run with a "
@@ -1383,10 +1482,12 @@ class Simulator:
             raise RuntimeError(f"exceeded max_quanta={max_quanta}")
         self.state = state
         return self._results_host(core_h, net_h, mem_h, int(n_quanta), ioc_h,
-                                  telemetry=self._timeline_host(tel_h))
+                                  telemetry=self._timeline_host(tel_h),
+                                  profile=self._profile_host(prof_h))
 
     def _results_host(self, core, net_h, mem_h, n_quanta: int,
-                      ioc_h=None, telemetry=None) -> SimResults:
+                      ioc_h=None, telemetry=None,
+                      profile=None) -> SimResults:
         """Assemble SimResults from already-fetched host arrays."""
         clock = np.asarray(core.clock_ps)
         mem_counters = None
@@ -1424,5 +1525,6 @@ class Simulator:
                 {k: np.asarray(v) for k, v in ioc_h.items()}
                 if ioc_h is not None else None),
             telemetry=telemetry,
+            profile=profile,
         )
 

@@ -151,6 +151,10 @@ class SimState:
     # the run records a timeline; None (no pytree leaves — the program
     # lowers bit-identically to one with no telemetry at all) otherwise
     telemetry: "object" = None
+    # device-resident per-tile profile ring (obs/profile.ProfileState)
+    # when the run records the spatial profiler; None (no pytree leaves
+    # — same bit-identity contract as telemetry) otherwise
+    profile: "object" = None
 
 
 @struct.dataclass

@@ -1116,9 +1116,11 @@ def subquantum_iteration(
         ioc=new_ioc,
         dvfs=new_dvfs,
         p2p_round=p2p_round,
-        # telemetry rides the carry untouched here; the OUTER quantum
-        # loop appends rows (obs.telemetry_tick) — None adds no leaves
+        # telemetry + profile rings ride the carry untouched here; the
+        # OUTER quantum loop appends rows (obs.telemetry_tick /
+        # obs.profile_tick) — None adds no leaves
         telemetry=state.telemetry,
+        profile=state.profile,
     )
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 
@@ -1210,6 +1212,7 @@ def run_simulation(
     px: ParallelCtx = IDENT,
     knobs=None,
     telemetry=None,
+    profile=None,
 ):
     """The whole simulation as ONE compiled region: an outer while_loop over
     lax-barrier quanta (the MCP barrier loop, `lax_barrier_sync_server.h`)
@@ -1237,9 +1240,17 @@ def run_simulation(
     points, recorded with zero host sync.  None (the default) lowers a
     bit-identical program (the round-7 knobs=None contract; enforced by
     the telemetry-off audit lint).
+
+    `profile` (a RESOLVED obs.ProfileSpec; state.profile must hold the
+    matching ProfileState) appends one [T, m] per-tile row to the
+    spatial profile ring on the SAME simulated-time boundaries — the
+    second ring of the round-16 spatial profiler.  None (the default)
+    lowers a bit-identical program (the `profile-off` audit lint).
     """
     if telemetry is not None:
         from graphite_tpu.obs.telemetry import telemetry_tick
+    if profile is not None:
+        from graphite_tpu.obs.profile import profile_tick
     INF_QEND = jnp.asarray(2**61, I64)
     if quantum_ps is None:
         qps = None
@@ -1276,6 +1287,11 @@ def run_simulation(
         if telemetry is not None:
             st2 = st2.replace(telemetry=telemetry_tick(
                 telemetry, st2, progress=progress, blk_iters=blk_iters))
+        if profile is not None:
+            # same boundary arithmetic as the telemetry tick — with
+            # equal intervals XLA CSEs the shared scalar reductions, so
+            # the two rings cost one boundary test per quantum
+            st2 = st2.replace(profile=profile_tick(profile, st2))
         # Zero progress: if some non-done tile sits beyond qend (it crossed
         # the boundary executing one long record), jump the window up to it
         # — blocked peers may wait on its future sends.  Only when every
@@ -1320,6 +1336,7 @@ def barrier_host_batch(
     quantum_ps: int,
     max_quanta: jax.Array,    # int32[] quanta budget for THIS dispatch
     telemetry=None,
+    profile=None,
 ):
     """Up to `max_quanta` lax_barrier quanta as ONE compiled region — the
     batched form of the host-driven barrier loop (Simulator.barrier_host).
@@ -1339,12 +1356,14 @@ def barrier_host_batch(
     host threads prev_qend into the next dispatch so boundary progression
     is seamless across batches.
 
-    `telemetry` samples the device-resident timeline exactly as in
-    `run_simulation`; the ring's sampling cursor rides state.telemetry,
-    so recording is seamless across dispatches too.
+    `telemetry` / `profile` sample the device-resident rings exactly as
+    in `run_simulation`; the sampling cursors ride the state carry, so
+    recording is seamless across dispatches too.
     """
     if telemetry is not None:
         from graphite_tpu.obs.telemetry import telemetry_tick
+    if profile is not None:
+        from graphite_tpu.obs.profile import profile_tick
     qps = int(quantum_ps)
 
     def next_boundary(clock):
@@ -1369,6 +1388,8 @@ def barrier_host_batch(
         if telemetry is not None:
             st2 = st2.replace(telemetry=telemetry_tick(
                 telemetry, st2, progress=progress, blk_iters=blk_iters))
+        if profile is not None:
+            st2 = st2.replace(profile=profile_tick(profile, st2))
         zero = (progress == 0) & jnp.any(~st2.done)
         ahead_clock = jnp.min(jnp.where(
             ~st2.done & (st2.core.clock_ps >= qend),
@@ -1390,13 +1411,14 @@ def barrier_host_batch(
 
 def make_simulation_runner(params: EngineParams, trace: DeviceTrace,
                            quantum_ps: int | None, max_quanta: int,
-                           donate: bool = False, telemetry=None):
+                           donate: bool = False, telemetry=None,
+                           profile=None):
     """`donate=True` hands the input state's buffers to XLA (halves the
     protocol state's HBM residency — the 1024-tile directory is 2.4 GB,
     and without donation input + output + scatter staging exceeds the
     chip; see PERF.md).  The caller's old state object is consumed."""
     def run(state: SimState):
         return run_simulation(params, trace, state, quantum_ps, max_quanta,
-                              telemetry=telemetry)
+                              telemetry=telemetry, profile=profile)
 
     return jax.jit(run, donate_argnums=(0,) if donate else ())

@@ -20,6 +20,23 @@ jaxpr-asserted in tests/test_telemetry.py and enforced by the
 `energy_prices` is opt-in, so the dense default selection (and every
 locked program fingerprint) is unchanged by the energy series.
 
+Spatial profiler (round 16):
+
+  ProfileSpec        — what to record PER TILE (interval, S, series)
+  ProfileState       — the [S, T, m] ring riding SimState.profile
+  profile_tick       — the outer quantum loop's per-tile row append
+  TileProfile        — one sim's demuxed per-tile host rows (heatmap
+                       input; `tools/report.py --heatmap`)
+  demux_profiles     — [B, ...] campaign state -> B TileProfiles
+
+    prof = ProfileSpec(sample_interval_ps=10_000_000)
+    sim = Simulator(cfg, batch, profile=prof)
+    res = sim.run()
+    res.profile.summary()   # max/mean skew, straggler tile, Gini
+
+`profile=None` (the default) lowers the same bit-identical program —
+enforced by the `profile-off` audit lint.
+
 Host side (round 14, consumed by serve/service.py):
 
   MetricsRegistry    — counters / gauges / fixed-bucket histograms with
@@ -45,6 +62,12 @@ from graphite_tpu.obs.telemetry import (  # noqa: F401
     available_series, demux_timelines, init_telemetry, telemetry_tick,
     timeline_from_state,
 )
+from graphite_tpu.obs.profile import (  # noqa: F401
+    PROFILE_CORE_SERIES, PROFILE_ENERGY_SERIES, PROFILE_LEVEL_SERIES,
+    PROFILE_MEM_SERIES, ProfileSpec, ProfileState, TileProfile,
+    available_tile_series, demux_profiles, gini, grid_shape,
+    init_profile, profile_from_state, profile_tick,
+)
 from graphite_tpu.obs.trace import (  # noqa: F401
     JOB_SPANS, Span, TERMINAL_SPANS, Tracer, job_breakdown, load_jsonl,
 )
@@ -63,6 +86,12 @@ __all__ = [
     "MEM_SERIES",
     "MetricsError",
     "MetricsRegistry",
+    "PROFILE_CORE_SERIES",
+    "PROFILE_ENERGY_SERIES",
+    "PROFILE_LEVEL_SERIES",
+    "PROFILE_MEM_SERIES",
+    "ProfileSpec",
+    "ProfileState",
     "RATIO_BUCKETS",
     "SKIP_PREFIX",
     "Span",
@@ -70,13 +99,21 @@ __all__ = [
     "Timeline",
     "TelemetrySpec",
     "TelemetryState",
+    "TileProfile",
     "Tracer",
     "available_series",
+    "available_tile_series",
+    "demux_profiles",
     "demux_timelines",
+    "gini",
+    "grid_shape",
+    "init_profile",
     "init_telemetry",
     "job_breakdown",
     "load_jsonl",
     "parse_exposition",
+    "profile_from_state",
+    "profile_tick",
     "telemetry_tick",
     "timeline_from_state",
 ]

@@ -159,6 +159,46 @@ class EnergyPrices:
         )
 
 
+def tile_energy_pj(ep: EnergyPrices, state) -> jax.Array:
+    """Cumulative per-tile event energy int64[T] — THE definition of
+    the energy ladder, shared by the scalar `energy_pj` series (which
+    reduces it with jnp.sum) and the round-16 per-tile profile series
+    (which records it as-is), so the per-tile column sums over T to
+    the scalar column exactly and a new price term cannot land in one
+    ring but not the other.  Integer pJ prices fold as literals into a
+    few multiply-adds; zero-priced terms add no ops at all."""
+    core = state.core
+    T = core.clock_ps.shape[0]
+    e = jnp.zeros((T,), I64)
+    if ep.instruction_pj:
+        e = e + core.instruction_count * ep.instruction_pj
+    if ep.packet_pj:
+        e = e + state.net.packets_sent * ep.packet_pj
+    if state.mem is not None:
+        mc = state.mem.counters
+        terms = (
+            (ep.l1i_access_pj, (mc.l1i_hits, mc.l1i_misses)),
+            (ep.l1d_access_pj, (mc.l1d_read_hits, mc.l1d_read_misses,
+                                mc.l1d_write_hits, mc.l1d_write_misses)),
+            (ep.l2_access_pj, (mc.l2_hits, mc.l2_misses)),
+            (ep.l2_miss_pj, (mc.l2_misses,)),
+            (ep.invalidation_pj, (mc.invalidations,)),
+            (ep.eviction_pj, (mc.evictions,)),
+            (ep.dram_access_pj, (mc.dram_reads, mc.dram_writes)),
+        )
+        for price, arrs in terms:
+            if price:
+                n = arrs[0]
+                for a in arrs[1:]:
+                    n = n + a
+                e = e + n * price
+    elif ep.needs_mem():
+        raise ValueError(
+            "energy_prices price memory events but this program has no "
+            "memory subsystem")
+    return e
+
+
 def available_series(params) -> "tuple[str, ...]":
     """Every series the given EngineParams can record."""
     out = CORE_SERIES
@@ -353,38 +393,7 @@ def _series_values(spec: TelemetrySpec, state, ts: TelemetryState,
         ep = spec.energy_prices
         if ep is None:
             raise ValueError("energy_pj selected without energy_prices")
-        # cumulative event energy: integer pJ prices fold as literals
-        # into a few multiply-adds over the same scalar reductions the
-        # other series pay; zero-priced terms add no ops at all
-        e = jnp.zeros((), I64)
-        if ep.instruction_pj:
-            e = e + jnp.sum(core.instruction_count) * ep.instruction_pj
-        if ep.packet_pj:
-            e = e + jnp.sum(state.net.packets_sent) * ep.packet_pj
-        if state.mem is not None:
-            mc = state.mem.counters
-            terms = (
-                (ep.l1i_access_pj, (mc.l1i_hits, mc.l1i_misses)),
-                (ep.l1d_access_pj, (mc.l1d_read_hits, mc.l1d_read_misses,
-                                    mc.l1d_write_hits,
-                                    mc.l1d_write_misses)),
-                (ep.l2_access_pj, (mc.l2_hits, mc.l2_misses)),
-                (ep.l2_miss_pj, (mc.l2_misses,)),
-                (ep.invalidation_pj, (mc.invalidations,)),
-                (ep.eviction_pj, (mc.evictions,)),
-                (ep.dram_access_pj, (mc.dram_reads, mc.dram_writes)),
-            )
-            for price, arrs in terms:
-                if price:
-                    n = arrs[0]
-                    for a in arrs[1:]:
-                        n = n + a
-                    e = e + jnp.sum(n) * price
-        elif ep.needs_mem():
-            raise ValueError(
-                "energy_prices price memory events but this program has "
-                "no memory subsystem")
-        vals["energy_pj"] = e
+        vals["energy_pj"] = jnp.sum(tile_energy_pj(ep, state))
     skip_names = [s for s in spec.series if s.startswith(SKIP_PREFIX)]
     if skip_names:
         if state.mem is None:
@@ -527,6 +536,35 @@ class Timeline:
             out["max_clock_spread_ps"] = int(spread.max())
         if "stall_quanta" in self.series:
             out["stall_quanta_total"] = int(self.col("stall_quanta").sum())
+        out["peaks"] = self.peaks()
+        return out
+
+    def peaks(self) -> dict:
+        """Per-series maximum with its SAMPLE INDEX and time — so a
+        spike is nameable ("l2_misses peaked at sample 17, t=42us")
+        instead of only sized.  Clock levels are reported as their
+        spread's peak (the raw max of a monotone clock is always the
+        last sample, which names nothing)."""
+        out = {}
+        if len(self) == 0:
+            return out
+        t_ns = self.time_ns
+        base = self.n_total - len(self)
+
+        def peak(name, values):
+            i = int(np.argmax(values))
+            out[name] = {"max": int(values[i]),
+                         "sample": int(base + i),
+                         "time_ns": int(t_ns[i])}
+
+        for s in self.series:
+            if s == "time_ps" or s in LEVEL_SERIES:
+                continue
+            peak(s, self.col(s))
+        if ("clock_max_ps" in self.series
+                and "clock_min_ps" in self.series):
+            peak("clock_spread_ps",
+                 self.col("clock_max_ps") - self.col("clock_min_ps"))
         return out
 
     def json_rows(self) -> "list[dict]":

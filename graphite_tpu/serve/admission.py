@@ -5,7 +5,8 @@ entirely from host-side arithmetic (no tracing, no compile):
 
  - *which program class* does it belong to?  Jobs co-batch only when
    they provably share one compiled program: same config digest, same
-   tile count, same memory-ness, same telemetry spec, and the same
+   tile count, same memory-ness, same telemetry spec, same per-tile
+   profile spec, and the same
    bucketed mailbox depth / trace length (lengths and depths round up
    to powers of two so successive batches share one [B, T, L] shape —
    and therefore one program-cache entry);
@@ -101,6 +102,13 @@ class JobClass:
         self.telemetry = None
         if job.telemetry is not None:
             self.telemetry = job.telemetry.resolve(self.params)
+        # the per-tile profile ring joins the admission bill the same
+        # way (obs.ProfileSpec.ring_bytes — the one size model); its T
+        # factor is what makes a dense big-tile profile pay its way
+        # through the budget instead of OOMing a compiled batch
+        self.profile = None
+        if job.profile is not None:
+            self.profile = job.profile.resolve(self.params)
         per_sim = {
             "state": int(tree_bytes(probe.state)),
             "trace": (self.params.n_tiles * self.pad_length
@@ -108,6 +116,8 @@ class JobClass:
         }
         if self.telemetry is not None:
             per_sim["telemetry"] = int(self.telemetry.ring_bytes())
+        if self.profile is not None:
+            per_sim["profile"] = int(self.profile.ring_bytes())
         self.per_sim_bytes = per_sim
         self.per_sim_total = sum(per_sim.values())
         if hbm_budget_bytes:
@@ -170,8 +180,15 @@ class AdmissionController:
         tel_key = None if tel is None else (
             int(tel.sample_interval_ps), int(tel.n_samples), tel.series,
             tel.energy_prices)
+        prof = job.profile
+        # the profile spec is part of the key for the same reason: the
+        # [S, T, m] ring (and its series selection / prices) is baked
+        # into the lowering, so differing specs never co-batch
+        prof_key = None if prof is None else (
+            int(prof.sample_interval_ps), int(prof.n_samples),
+            prof.series, prof.energy_prices)
         return (config_digest(job.resolved_config()), job.n_tiles,
-                job.has_mem_trace(), depth, length, tel_key)
+                job.has_mem_trace(), depth, length, tel_key, prof_key)
 
     def admit(self, job: Job) -> "tuple[JobClass, Pending]":
         """Queue `job` (validated by the caller) or refuse it.

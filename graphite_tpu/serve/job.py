@@ -81,9 +81,12 @@ class Job:
     KNOB_FIELDS) — same compiled program, different point.
     `telemetry`: an `obs.TelemetrySpec` to record a device timeline for
     this job (jobs with different specs never co-batch — the ring is
-    baked into the program).  `clock_scheme`: override the config's
-    clock-skew management scheme (CLOCK_SCHEMES); None keeps the
-    config's own.  `seed`: metadata echoed into the result envelope.
+    baked into the program).  `profile`: an `obs.ProfileSpec` to record
+    the per-tile spatial profile ring (same never-co-batch rule — the
+    [S, T, m] ring is baked in too).  `clock_scheme`: override the
+    config's clock-skew management scheme (CLOCK_SCHEMES); None keeps
+    the config's own.  `seed`: metadata echoed into the result
+    envelope.
     """
 
     job_id: str
@@ -91,6 +94,7 @@ class Job:
     trace: object                # TraceBatch
     knobs: dict = dataclasses.field(default_factory=dict)
     telemetry: object = None     # obs.TelemetrySpec | None
+    profile: object = None       # obs.ProfileSpec | None
     seed: "int | None" = None
     clock_scheme: "str | None" = None
 
@@ -155,6 +159,13 @@ class Job:
                 raise ValueError(
                     f"job {self.job_id!r}: telemetry must be an "
                     f"obs.TelemetrySpec")
+        if self.profile is not None:
+            from graphite_tpu.obs.profile import ProfileSpec
+
+            if not isinstance(self.profile, ProfileSpec):
+                raise ValueError(
+                    f"job {self.job_id!r}: profile must be an "
+                    f"obs.ProfileSpec")
         if validate_trace:
             from graphite_tpu.trace.validate import validate_batch
 
@@ -182,6 +193,7 @@ class JobResult:
     status: str                    # STATUS_OK | STATUS_FAILED
     results: object = None         # SimResults (ok only)
     telemetry: object = None       # obs.Timeline | None
+    profile: object = None         # obs.TileProfile | None
     error: "str | None" = None     # failure message (failed only)
     batch_id: "int | None" = None
     attempts: int = 1
@@ -216,6 +228,8 @@ class JobResult:
             })
             if self.telemetry is not None:
                 row["telemetry_samples"] = len(self.telemetry)
+            if self.profile is not None:
+                row["profile_samples"] = len(self.profile)
         if self.timings:
             row.update({k: float(v) for k, v in self.timings.items()})
         if self.error is not None:

@@ -381,6 +381,10 @@ class CampaignService:
                 attrs = {"batch": batch_id, "attempts": res.attempts}
                 if res.telemetry is not None:
                     attrs["telemetry_samples"] = len(res.telemetry)
+                if res.profile is not None:
+                    # the emit span links to the per-tile profile the
+                    # way it links to the scalar timeline
+                    attrs["profile_samples"] = len(res.profile)
                 self.tracer.event(p.job.job_id, "emit", **attrs)
         for p, res in zip(pendings, results):
             self._h["split_depth"].observe(res.attempts)
@@ -486,6 +490,7 @@ class CampaignService:
 
         digest = cls.key[0][:8]
         tel = "-tel" if cls.telemetry is not None else ""
+        tel += "-prof" if cls.profile is not None else ""
         # the key hash keeps the name INJECTIVE over class keys: the
         # readable fields alone miss key components (mem-ness,
         # telemetry spec details), and two distinct classes colliding
@@ -527,7 +532,8 @@ class CampaignService:
             mailbox_depth=cls.mailbox_depth,
             shard_batch=self.shard_batch,
             hbm_budget_bytes=self.hbm_budget_bytes,
-            telemetry=cls.telemetry)
+            telemetry=cls.telemetry,
+            profile=cls.profile)
         self._last_residency = int(
             runner.residency_breakdown()["total"])
         if self.hbm_budget_bytes \
@@ -560,9 +566,10 @@ class CampaignService:
             for b in range(n):  # the padded tail [n:B] never leaves here
                 p = pendings[b]
                 tl = None if out.timelines is None else out.timelines[b]
+                pf = None if out.profiles is None else out.profiles[b]
                 results.append(JobResult(
                     job_id=p.job.job_id, status=STATUS_OK,
-                    results=out.results[b], telemetry=tl,
+                    results=out.results[b], telemetry=tl, profile=pf,
                     batch_id=batch_id, attempts=p.attempts + 1,
                     seed=p.job.seed, knob_point=dict(p.job.knobs),
                     n_quanta=int(out.n_quanta[b]),

@@ -52,6 +52,10 @@ class SweepOutcome:
     # ran with a TelemetrySpec: the batched [B, S, n_series] ring demuxed
     # sim-by-sim (each also rides its SimResults.telemetry)
     timelines: "list | None" = None
+    # per-sim per-tile profiles (obs.TileProfile) when the campaign ran
+    # with a ProfileSpec: the [B, S, T, m] ring demuxed sim-by-sim
+    # (each also rides its SimResults.profile)
+    profiles: "list | None" = None
     # False for unbounded clock schemes (lax/lax_p2p): there is no
     # quantum in the program, so reporting the knob would claim a value
     # that never entered it
@@ -85,11 +89,14 @@ class SweepRunner:
     override dicts (sweep/knobs.py KNOB_FIELDS); with one trace and K > 1
     points the trace is replicated across the grid.  Remaining kwargs
     reach the underlying Simulator construction (mailbox_depth,
-    inner_block, phase_gate, telemetry, ...); multi-chip tile sharding,
-    streaming and host-barrier modes are out of scope for the batched
-    program.  `telemetry=obs.TelemetrySpec(...)` records one device
-    timeline PER SIM ([B, S, n_series] total), demuxed post-run into
-    `SweepOutcome.timelines` / each result's `.telemetry`.
+    inner_block, phase_gate, telemetry, profile, ...); multi-chip tile
+    sharding, streaming and host-barrier modes are out of scope for the
+    batched program.  `telemetry=obs.TelemetrySpec(...)` records one
+    device timeline PER SIM ([B, S, n_series] total), demuxed post-run
+    into `SweepOutcome.timelines` / each result's `.telemetry`;
+    `profile=obs.ProfileSpec(...)` likewise records one per-tile ring
+    PER SIM ([B, S, T, m] total), demuxed into `SweepOutcome.profiles`
+    / each result's `.profile` — under both vmap and batch shard_map.
 
     Two batching programs, chosen by `shard_batch`:
      - `vmap` over the sim axis (the default on one device): one
@@ -247,14 +254,18 @@ class SweepRunner:
 
         trace_arrays = {f: getattr(self.pack, f)
                         for f in PackedTraces._TRACE_FIELDS}
-        # the ring is itemized as its own consumer — strip it from the
-        # per-sim state so an attached spec is not counted twice
-        state = self.sim.state.replace(telemetry=None) \
-            if self.sim.state.telemetry is not None else self.sim.state
+        # the rings are itemized as their own consumers — strip them
+        # from the per-sim state so an attached spec is not counted twice
+        state = self.sim.state
+        if state.telemetry is not None:
+            state = state.replace(telemetry=None)
+        if state.profile is not None:
+            state = state.replace(profile=None)
         return residency_breakdown(
             state=state, trace=trace_arrays,
             batch=self.pack.n_sims,
-            telemetry_spec=self.sim.telemetry_spec)
+            telemetry_spec=self.sim.telemetry_spec,
+            profile_spec=self.sim.profile_spec)
 
     @property
     def n_sims(self) -> int:
@@ -269,11 +280,12 @@ class SweepRunner:
         params = self.sim.params
         unbounded = self.sim.quantum_ps is None
         tel = self.sim.telemetry_spec
+        prof = self.sim.profile_spec
 
         def one(state, trace, kn):
             q = None if unbounded else kn.quantum_ps
             return run_simulation(params, trace, state, q, max_quanta,
-                                  knobs=kn, telemetry=tel)
+                                  knobs=kn, telemetry=tel, profile=prof)
 
         if not self.shard_batch:
             return jax.vmap(one)
@@ -381,12 +393,12 @@ class SweepRunner:
         states0, dtr = self._batched_inputs()
         state, nq_d, deadlock_d, iters_d = self._get_runner(max_quanta)(
             states0, dtr, self.knobs)
-        net_part, mem_part, ioc_part, tel_part = \
+        net_part, mem_part, ioc_part, tel_part, prof_part = \
             Simulator._result_parts(state)
         (nq, deadlock, overflow, done, core_h, net_h, mem_h, ioc_h,
-         tel_h, iters) = jax.device_get((
+         tel_h, prof_h, iters) = jax.device_get((
             nq_d, deadlock_d, state.net.overflow, state.done, state.core,
-            net_part, mem_part, ioc_part, tel_part, iters_d))
+            net_part, mem_part, ioc_part, tel_part, prof_part, iters_d))
         if overflow.any():
             raise MailboxOverflowError(
                 f"mailbox ring overflow in sim(s) "
@@ -422,13 +434,21 @@ class SweepRunner:
                                          buf_h[b], int(count_h[b]))
                 for b in range(B)
             ]
+        profiles = None
+        if self.sim.profile_spec is not None and prof_h is not None:
+            from graphite_tpu.obs.profile import demux_profiles
+
+            # the [B, S, T, m] ring rode the same ONE batched fetch;
+            # the demux serves vmap and batch-shard_map campaigns alike
+            profiles = demux_profiles(self.sim.profile_spec, prof_h)
         results = [
             self.sim._results_host(
                 row(core_h, b), row(net_h, b),
                 None if mem_h is None else row(mem_h, b),
                 int(nq[b]),
                 None if ioc_h is None else row(ioc_h, b),
-                telemetry=None if timelines is None else timelines[b])
+                telemetry=None if timelines is None else timelines[b],
+                profile=None if profiles is None else profiles[b])
             for b in range(B)
         ]
         phase_skips = None
@@ -447,4 +467,5 @@ class SweepRunner:
                             phase_skips=phase_skips,
                             seeds=self.pack.seeds,
                             quantum_valid=self.sim.quantum_ps is not None,
-                            timelines=timelines)
+                            timelines=timelines,
+                            profiles=profiles)

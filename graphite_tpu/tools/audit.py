@@ -4,9 +4,9 @@ Lowers the default config set — the per-phase-GATED private-L2 engine,
 the UNGATED one, the shared-L2 engine, the B=4 vmapped sweep campaign,
 the telemetry-recording gated engine, and the combined sweep+telemetry
 campaign — and runs every jaxpr invariant lint (analysis/rules.py) over
-each: cond-payload (with the telemetry ring's aval in the forbidden set
-for telemetry-on programs), knob-fold, time-dtype, vmap-gate, host-sync,
-telemetry-off.  Each program's STATIC COST report (analysis/cost.py —
+each: cond-payload (with the telemetry/profile ring avals in the
+forbidden set for recording programs), knob-fold, time-dtype,
+vmap-gate, host-sync, telemetry-off, profile-off.  Each program's STATIC COST report (analysis/cost.py —
 per-iteration kernel proxy with per-phase attribution, bytes moved,
 peak-live residency) is emitted as a JSON line alongside the lint rows.
 Pure static analysis over `jax.make_jaxpr` output: no compile, no

@@ -17,7 +17,9 @@ ungated engine pair must be bit-identical, the batched host-barrier
 dispatch (barrier_batch > 1) must reproduce the per-quantum dispatch
 exactly, the B=4 sweep must match sequential runs, telemetry recording
 must leave SimResults bit-identical (solo, gated + ungated) and the
-B=4 campaign's demuxed timelines must equal sequential telemetry runs,
+B=4 campaign's demuxed timelines must equal sequential telemetry runs
+(the per-tile profile ring repeats all three checks in rung 10, plus
+the cross-ring per-tile-sums-equal-scalar-series invariant),
 the program auditor's jaxpr invariant lints (graphite_tpu/analysis)
 must pass on the lowered default programs, every default program's
 static cost report must sit within the checked-in BUDGETS.json
@@ -400,6 +402,60 @@ def smoke(tiles: int = 16) -> int:
     print(f"{'serve metrics exposition parses':44} "
           f"{'PASS' if ok else 'FAIL'}"
           + ("" if ok else f"  ({snap.get('queue_dwell_seconds')})"))
+    failures += 0 if ok else 1
+
+    # 10) spatial profiler (round 16, obs/profile.py): recording the
+    #     per-tile [S, T, m] ring must leave SimResults bit-identical
+    #     (gated + ungated), the B=4 campaign must demux per-sim
+    #     per-tile rows equal to sequential profile runs, and — the
+    #     free cross-ring invariant — a run carrying BOTH rings on one
+    #     sampling cursor must have every shared delta series sum over
+    #     T to exactly the round-9 scalar column, with
+    #     max(clock_skew) + clock_min == clock_max sample for sample.
+    from graphite_tpu.obs import ProfileSpec
+
+    prof = ProfileSpec(sample_interval_ps=1_000_000, n_samples=64)
+    for gate, label in ((True, "gated"), (False, "ungated")):
+        r_prof = Simulator(sc_b, batch, phase_gate=gate,
+                           mem_gate_bytes=0, profile=prof).run()
+        r_off = Simulator(sc_b, batch, phase_gate=gate,
+                          mem_gate_bytes=0).run()
+        failures += _compare(f"profile on vs off ({label} MSI, 16t)",
+                             r_prof, r_off)
+    sweep_prof = SweepRunner(sc_b, sweep_traces, profile=prof)
+    out_prof = sweep_prof.run()
+    for b, s in enumerate(seeds):
+        solo = Simulator(sc_b, sweep_traces[b],
+                         mailbox_depth=sweep_prof.mailbox_depth,
+                         phase_gate=False, mem_gate_bytes=0,
+                         profile=prof).run().profile
+        pf = out_prof.profiles[b]
+        ok = (pf.n_total == solo.n_total
+              and np.array_equal(pf.data, solo.data)
+              and np.array_equal(pf.times_ps, solo.times_ps))
+        print(f"{f'sweep B=4 sim {b} profile vs sequential':44} "
+              f"{'PASS' if ok else 'FAIL'}")
+        failures += 0 if ok else 1
+    # both rings on one cursor, energy priced on BOTH (one shared
+    # ladder — obs/telemetry.tile_energy_pj — so energy_pj is part of
+    # the cross-ring sum invariant, not just the unit test)
+    tel_x = TelemetrySpec(sample_interval_ps=1_000_000, n_samples=64,
+                          energy_prices=prices)
+    prof_x = ProfileSpec(sample_interval_ps=1_000_000, n_samples=64,
+                         energy_prices=prices)
+    r_both = Simulator(sc_b, batch, phase_gate=False, mem_gate_bytes=0,
+                       telemetry=tel_x, profile=prof_x).run()
+    pf, tl = r_both.profile, r_both.telemetry
+    ok = pf.n_total == tl.n_total \
+        and np.array_equal(pf.times_ps, tl.col("time_ps"))
+    for s in ("instructions", "packets_sent", "sync_stall_ps",
+              "l2_misses", "invalidations", "evictions", "energy_pj"):
+        ok = ok and np.array_equal(pf.col(s).sum(axis=1), tl.col(s))
+    ok = ok and np.array_equal(
+        pf.col("clock_skew_ps").max(axis=1) + tl.col("clock_min_ps"),
+        tl.col("clock_max_ps"))
+    print(f"{'cross-ring: per-tile sums == scalar series':44} "
+          f"{'PASS' if ok else 'FAIL'}")
     failures += 0 if ok else 1
 
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")

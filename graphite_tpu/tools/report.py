@@ -1,15 +1,29 @@
-"""Observability reporter: timelines, span traces, metrics dumps.
+"""Observability reporter: timelines, profiles, span traces, metrics.
 
-Three input kinds, one renderer:
+Input kinds, one renderer:
 
   positional .npz   device telemetry timelines (`obs.Timeline.save`) —
                     a solo run, or several files as one campaign;
+  --heatmap         positional .npz files are per-tile PROFILES
+                    (`obs.TileProfile.save`): renders each selected
+                    series as a tile-grid heatmap (aligned ASCII shade
+                    digits for the terminal; JSON rows carrying the
+                    full [T] vector) plus the straggler/imbalance
+                    summary (max/mean skew, leader/straggler tile,
+                    traffic Gini).  `--slice total|last|<idx>` picks
+                    the time slice; `--series a,b` restricts series;
   --spans FILE      job/batch lifecycle spans saved as JSON-lines by
                     `tools/serve.py --trace-out` — renders one aligned
                     latency-breakdown row per job (submit, queue dwell,
                     execute, ... in microseconds) plus the batch
                     execution table (class, occupancy, cache hit,
                     compile time);
+  --trade-curve FILE
+                    the same span JSON-lines rendered as the latency/
+                    occupancy trade curve: one scatter row per job
+                    (queue_dwell_us vs its batch's occupancy) plus
+                    occupancy-bucketed dwell aggregates — the
+                    measurement half of latency-aware batching;
   --metrics FILE    a Prometheus text exposition written by
                     `tools/serve.py --metrics-out` — renders counters/
                     gauges and histogram summaries (count, sum,
@@ -20,12 +34,17 @@ Output (stdout):
   --format json   machine rows (one JSON line per sample / job / metric)
                   — the shape bench.py and the CI artifacts consume;
   --format text   aligned-text tables;
-  --summary       summaries only (timeline mode).
+  --summary       summaries only (timeline/heatmap modes).  Timeline
+                  summaries carry per-series `peaks` (max + argmax
+                  sample/time), so stragglers and spikes are nameable
+                  from scalar timelines too.
 
 Usage:
   python -m graphite_tpu.tools.report run.npz [sim0.npz sim1.npz ...]
                                       [--format json|text] [--summary]
+  python -m graphite_tpu.tools.report --heatmap prof.npz --slice total
   python -m graphite_tpu.tools.report --spans spans.jsonl --format text
+  python -m graphite_tpu.tools.report --trade-curve spans.jsonl
   python -m graphite_tpu.tools.report --metrics metrics.prom
 """
 
@@ -98,6 +117,153 @@ def render_spans(path: str, fmt: str) -> "list[str]":
     return lines
 
 
+_SHADES = "0123456789"
+
+
+def heatmap_lines(prof, *, series=None,
+                  sample: "int | str" = "total") -> "list[str]":
+    """ASCII tile-grid heatmaps of one TileProfile: per selected
+    series, the near-square emesh grid with each tile's value scaled
+    to a shade digit 0-9 (0 = the slice minimum, 9 = the maximum; a
+    flat slice renders all zeros), plus the min/max legend.  Aligned,
+    deterministic — the golden-render shape the tests pin."""
+    from graphite_tpu.obs.profile import grid_shape
+
+    names = tuple(series) if series else prof.series
+    rows_n, cols_n = grid_shape(prof.n_tiles)
+    out = []
+    for s in names:
+        vec = prof.tile_slice(s, sample)
+        lo, hi = int(vec.min()), int(vec.max())
+        span = hi - lo
+        out.append(f"-- {s} [slice {sample}] min {lo} max {hi} "
+                   f"(0='{_SHADES[0]}' .. 9='{_SHADES[-1]}')")
+        for r in range(rows_n):
+            cells = []
+            for c in range(cols_n):
+                t = r * cols_n + c
+                if t >= prof.n_tiles:
+                    cells.append(" ")
+                    continue
+                v = int(vec[t])
+                shade = 0 if span == 0 else (9 * (v - lo)) // span
+                cells.append(_SHADES[shade])
+            out.append(" ".join(cells).rstrip())
+    return out
+
+
+def render_heatmap(paths, fmt: str, *, series=None,
+                   sample: "int | str" = "total",
+                   summary_only: bool = False) -> "list[str]":
+    """Per-tile profile .npz file(s) -> heatmaps + straggler summary."""
+    from graphite_tpu.obs.profile import TileProfile
+
+    lines = []
+    for b, path in enumerate(paths):
+        prof = TileProfile.load(path)
+        names = tuple(series) if series else prof.series
+        unknown = [s for s in names if s not in prof.series]
+        if unknown:
+            raise SystemExit(
+                f"{path}: unknown series {unknown} "
+                f"(recorded: {', '.join(prof.series)})")
+        if len(prof) == 0:
+            raise SystemExit(f"{path}: profile holds no recorded "
+                             "samples — nothing to render")
+        if isinstance(sample, int) \
+                and not -len(prof) <= sample < len(prof):
+            raise SystemExit(
+                f"{path}: --slice {sample} out of range (profile "
+                f"holds {len(prof)} recorded sample(s))")
+        summary = {"sim": b, "file": path,
+                   "sample_interval_ps": prof.sample_interval_ps,
+                   **prof.summary()}
+        if fmt == "json":
+            if not summary_only:
+                lines.extend(json.dumps({"sim": b, **row})
+                             for row in prof.json_rows(
+                                 series=names, sample=sample))
+            lines.append(json.dumps(summary))
+            continue
+        lines.append(
+            f"== sim {b}: {path} ({prof.n_tiles} tiles, "
+            f"{len(prof)} of {prof.n_total} samples"
+            + (", ring WRAPPED" if prof.wrapped else "") + ")")
+        if not summary_only:
+            lines.extend(heatmap_lines(prof, series=names,
+                                       sample=sample))
+        for k, v in summary.items():
+            if k not in ("sim", "file"):
+                lines.append(f"  {k:22} {v}")
+    return lines
+
+
+def trade_curve_rows(rows: "list[dict]") -> "tuple[list, list]":
+    """Span rows -> (per-job scatter rows, occupancy-bucket aggregate
+    rows) of the latency/occupancy trade: each job's queue dwell
+    against the occupancy of the batch that ran it — the measurement
+    the round-14 `queue_dwell_seconds` histogram and `batch_occupancy`
+    series exist to feed (the scale-out item's dwell-knob evidence)."""
+    from graphite_tpu.obs.trace import BATCH_TRACE_PREFIX
+
+    occ_by_batch = {}
+    for r in rows:
+        if r["trace"].startswith(BATCH_TRACE_PREFIX) \
+                and r["span"] == "batch" and "occupancy" in r:
+            occ_by_batch[r["trace"]] = r
+    scatter = []
+    for r in rows:
+        if r["span"] != "queue" or "batch" not in r:
+            continue
+        b = occ_by_batch.get(f"batch-{r['batch']}")
+        if b is None:
+            continue
+        scatter.append({
+            "job": r["trace"], "batch": int(r["batch"]),
+            "queue_dwell_us": int(r["dur_us"]),
+            "occupancy": float(b["occupancy"]),
+            "n_jobs": b.get("n_jobs"),
+            "capacity": b.get("capacity"),
+            "execute_us": int(b["dur_us"]),
+        })
+    buckets: "dict[float, list]" = {}
+    for s in scatter:
+        # bucket occupancy to one decimal: the curve's x grid
+        buckets.setdefault(round(s["occupancy"], 1), []).append(s)
+    curve = []
+    for occ in sorted(buckets):
+        group = buckets[occ]
+        dwells = sorted(g["queue_dwell_us"] for g in group)
+        curve.append({
+            "curve": True, "occupancy_bucket": occ,
+            "jobs": len(group),
+            "mean_dwell_us": int(sum(dwells) / len(dwells)),
+            "max_dwell_us": int(dwells[-1]),
+            "mean_execute_us": int(sum(g["execute_us"] for g in group)
+                                   / len(group)),
+        })
+    return scatter, curve
+
+
+def render_trade_curve(path: str, fmt: str) -> "list[str]":
+    from graphite_tpu.obs.trace import load_jsonl
+
+    scatter, curve = trade_curve_rows(load_jsonl(path))
+    if fmt == "json":
+        return [json.dumps(r) for r in scatter + curve]
+    cols = ["job", "batch", "queue_dwell_us", "occupancy", "n_jobs",
+            "capacity", "execute_us"]
+    lines = _align(cols, [[str(r.get(c, "-")) for c in cols]
+                          for r in scatter])
+    if curve:
+        ccols = ["occupancy_bucket", "jobs", "mean_dwell_us",
+                 "max_dwell_us", "mean_execute_us"]
+        lines.append("")
+        lines.extend(_align(ccols, [[str(r[c]) for c in ccols]
+                                    for r in curve]))
+    return lines
+
+
 def _hist_quantile(buckets: "dict[str, int]", count: int,
                    q: float) -> str:
     """Quantile from cumulative `le -> count` buckets (the same
@@ -146,27 +312,50 @@ def main(argv=None) -> int:
         description="render telemetry timelines, span traces, and "
         "metrics dumps")
     ap.add_argument("files", nargs="*",
-                    help=".npz timeline file(s) (obs.Timeline.save); "
-                    "several files render as one campaign, sim-indexed "
-                    "in argument order")
+                    help=".npz timeline file(s) (obs.Timeline.save) — "
+                    "or, with --heatmap, per-tile profile file(s) "
+                    "(obs.TileProfile.save); several files render as "
+                    "one campaign, sim-indexed in argument order")
+    ap.add_argument("--heatmap", action="store_true",
+                    help="treat the positional .npz files as per-tile "
+                    "profiles and render tile-grid heatmaps + the "
+                    "straggler/imbalance summary")
+    ap.add_argument("--slice", default=None, metavar="WHICH",
+                    help="heatmap time slice: 'total' (the default; "
+                    "delta series sum over samples, levels take the "
+                    "last), 'last', or a sample index (negative from "
+                    "the end)")
+    ap.add_argument("--series", metavar="A,B,...",
+                    help="restrict heatmaps to these series")
     ap.add_argument("--spans", metavar="FILE",
                     help="render a span JSON-lines file "
                     "(tools/serve.py --trace-out) as a per-job latency "
                     "breakdown + batch table")
+    ap.add_argument("--trade-curve", metavar="FILE",
+                    help="render a span JSON-lines file as the "
+                    "latency/occupancy trade curve (per-job queue "
+                    "dwell vs batch occupancy + bucketed aggregates)")
     ap.add_argument("--metrics", metavar="FILE",
                     help="render a Prometheus text exposition "
                     "(tools/serve.py --metrics-out) as metric "
                     "summaries")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--summary", action="store_true",
-                    help="emit per-timeline summaries only (peak "
-                    "injection rate, clock spread, stall quanta, ...)")
+                    help="emit per-timeline/profile summaries only "
+                    "(peak injection rate, clock spread + per-series "
+                    "peaks, skew/Gini stragglers, ...)")
     args = ap.parse_args(argv)
 
-    modes = sum((bool(args.files), bool(args.spans), bool(args.metrics)))
+    modes = sum((bool(args.files), bool(args.spans), bool(args.metrics),
+                 bool(args.trade_curve)))
     if modes != 1:
-        ap.error("give exactly one input: timeline .npz file(s), "
-                 "--spans FILE, or --metrics FILE")
+        ap.error("give exactly one input: timeline/profile .npz "
+                 "file(s), --spans FILE, --trade-curve FILE, or "
+                 "--metrics FILE")
+    if args.heatmap and not args.files:
+        ap.error("--heatmap needs positional profile .npz file(s)")
+    if not args.heatmap and (args.slice is not None or args.series):
+        ap.error("--slice/--series apply to --heatmap mode only")
 
     # pure host-side post-processing — never touch a chip
     import os
@@ -177,8 +366,27 @@ def main(argv=None) -> int:
         for line in render_spans(args.spans, args.format):
             print(line)
         return 0
+    if args.trade_curve:
+        for line in render_trade_curve(args.trade_curve, args.format):
+            print(line)
+        return 0
     if args.metrics:
         for line in render_metrics(args.metrics, args.format):
+            print(line)
+        return 0
+    if args.heatmap:
+        sl = args.slice if args.slice is not None else "total"
+        if sl not in ("total", "last"):
+            try:
+                sl = int(sl)
+            except ValueError:
+                ap.error("--slice must be 'total', 'last', or an "
+                         "integer sample index")
+        names = (tuple(s.strip() for s in args.series.split(",")
+                       if s.strip()) if args.series else None)
+        for line in render_heatmap(args.files, args.format,
+                                   series=names, sample=sl,
+                                   summary_only=args.summary):
             print(line)
         return 0
 
@@ -203,8 +411,16 @@ def main(argv=None) -> int:
                 for line in _text_table(tl):
                     print(line)
             for k, v in summary.items():
-                if k not in ("sim", "file"):
-                    print(f"  {k:28} {v}")
+                if k in ("sim", "file"):
+                    continue
+                if k == "peaks":
+                    # per-series argmax rows: spikes are nameable by
+                    # sample/time, not only sized
+                    for s, p in v.items():
+                        print(f"  peak {s:22} {p['max']} at sample "
+                              f"{p['sample']} (t={p['time_ns']} ns)")
+                    continue
+                print(f"  {k:28} {v}")
     return 0
 
 

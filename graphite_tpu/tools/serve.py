@@ -19,7 +19,12 @@ Job-spec line schema (all fields except `id` optional):
    "telemetry": {"sample_interval_ps": 1000000, "n_samples": 64,
                  // optional energy_pj series: explicit pJ prices, or
                  // {"node_nm": 45} to price via the native power model
-                 "energy": {"instruction_pj": 2, "l2_miss_pj": 120}}}
+                 "energy": {"instruction_pj": 2, "l2_miss_pj": 120}},
+   "profile": {"sample_interval_ps": 1000000, "n_samples": 64,
+               // optional "series": [...], "energy": {...} — the
+               // per-tile spatial profiler ring (obs.ProfileSpec);
+               // render results with tools/report.py --heatmap
+               "series": ["clock_skew_ps", "l2_misses"]}}
 
 Usage:
   python -m graphite_tpu.tools.serve --jobs jobs.jsonl --budget-bytes 2e9
@@ -61,6 +66,8 @@ DRYRUN_JOBS = [
      "telemetry": {"sample_interval_ps": 1_000_000, "n_samples": 16,
                    "energy": {"instruction_pj": 2, "l2_miss_pj": 120,
                               "dram_access_pj": 500}}},
+    {"id": "d6", "tiles": 4, "seed": 7, "accesses": 10,
+     "profile": {"sample_interval_ps": 1_000_000, "n_samples": 16}},
 ]
 
 
@@ -102,32 +109,43 @@ def build_job(spec: dict, config_cache: dict):
                 f"unknown workload {workload!r} (memstress or: "
                 f"{', '.join(sorted(BENCHMARKS))})")
         trace = BENCHMARKS[workload](tiles)
-    telemetry = None
-    if spec.get("telemetry"):
+    def _prices(t, what):
+        if not t.get("energy"):
+            return None
         from graphite_tpu.obs import EnergyPrices
 
+        e = t["energy"]
+        if not isinstance(e, dict):
+            raise ValueError(
+                f"{what}.energy must be a dict of pJ prices or "
+                '{"node_nm": N} for the native power model')
+        if "node_nm" in e:
+            return EnergyPrices.from_power_model(
+                int(e["node_nm"]), voltage=float(e.get("voltage", 1.0)))
+        return EnergyPrices(**e)
+
+    telemetry = None
+    if spec.get("telemetry"):
         t = spec["telemetry"]
-        prices = None
-        if t.get("energy"):
-            e = t["energy"]
-            if not isinstance(e, dict):
-                raise ValueError(
-                    "telemetry.energy must be a dict of pJ prices or "
-                    '{"node_nm": N} for the native power model')
-            if "node_nm" in e:
-                prices = EnergyPrices.from_power_model(
-                    int(e["node_nm"]),
-                    voltage=float(e.get("voltage", 1.0)))
-            else:
-                prices = EnergyPrices(**e)
         telemetry = TelemetrySpec(
             sample_interval_ps=int(t["sample_interval_ps"]),
             n_samples=int(t.get("n_samples", 256)),
             series=tuple(t["series"]) if t.get("series") else None,
-            energy_prices=prices)
+            energy_prices=_prices(t, "telemetry"))
+    profile = None
+    if spec.get("profile"):
+        from graphite_tpu.obs import ProfileSpec
+
+        p = spec["profile"]
+        profile = ProfileSpec(
+            sample_interval_ps=int(p["sample_interval_ps"]),
+            n_samples=int(p.get("n_samples", 256)),
+            series=tuple(p["series"]) if p.get("series") else None,
+            energy_prices=_prices(p, "profile"))
     return Job(job_id=str(spec["id"]), config=sc, trace=trace,
                knobs=dict(spec.get("knobs", {})), telemetry=telemetry,
-               seed=seed, clock_scheme=spec.get("clock_scheme"))
+               profile=profile, seed=seed,
+               clock_scheme=spec.get("clock_scheme"))
 
 
 def main(argv=None) -> int:
@@ -151,6 +169,11 @@ def main(argv=None) -> int:
                     help="enable span tracing and write job/batch "
                     "lifecycle spans as JSON-lines on exit "
                     "(render: tools/report.py --spans FILE)")
+    ap.add_argument("--profile-out", metavar="DIR",
+                    help="save each job's per-tile profile as "
+                    "DIR/<job_id>.npz (obs.TileProfile.save; the "
+                    "result line gains \"profile_file\"; render: "
+                    "tools/report.py --heatmap DIR/*.npz)")
     ap.add_argument("--metrics-out", metavar="FILE",
                     help="write the metrics registry as Prometheus "
                     "text exposition on exit "
@@ -200,6 +223,19 @@ def main(argv=None) -> int:
 
     config_cache: dict = {}
     t0 = time.perf_counter()
+
+    def emit(res):
+        """One result line; --profile-out persists the per-tile ring
+        (the envelope only carries a sample count) and names the file
+        in the line so the heatmap render is one copy-paste away."""
+        row = res.to_json()
+        if args.profile_out and res.profile is not None:
+            os.makedirs(args.profile_out, exist_ok=True)
+            path = os.path.join(args.profile_out, f"{res.job_id}.npz")
+            res.profile.save(path)
+            row["profile_file"] = path
+        print(json.dumps(row), flush=True)
+
     # submit with per-job drain on backpressure: a full queue runs a
     # batch (streaming its results) instead of dropping the job
     for spec in specs:
@@ -216,7 +252,7 @@ def main(argv=None) -> int:
                 break
             except QueueFullError:
                 for res in service.step():
-                    print(json.dumps(res.to_json()), flush=True)
+                    emit(res)
             except (ResidencyBudgetError, TraceValidationError,
                     ValueError) as e:
                 failures += 1
@@ -225,7 +261,7 @@ def main(argv=None) -> int:
                                   "error": str(e)}))
                 break
     for res in service.drain():
-        print(json.dumps(res.to_json()), flush=True)
+        emit(res)
     counters = service.counters
     failures += counters["failed"]
     if args.trace_out:
