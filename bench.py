@@ -493,6 +493,69 @@ scheme = lax
                 "obs_queue_dwell_p90_s": dwell.quantile(0.9),
             })
 
+        # Persistent AOT program store (round 17, store/ subsystem):
+        # the SAME job stream through (a) a cold store-backed service —
+        # pays the one compile AND the serialize/fill — then (b) a
+        # warm-started second service over the same store, which
+        # deserializes instead of compiling.  The warm jobs/s vs the
+        # round-13 in-memory serve_jobs_per_s is the fleet cold-start
+        # win the store sells; per-class compile vs deserialize wall
+        # is the microscopic view.  Skippable via BENCH_STORE=0; rides
+        # INSIDE the serve section (it reuses its job set and its
+        # serve_wall baseline), so BENCH_SERVE=0 disables it too.
+        if os.environ.get("BENCH_STORE", "1") != "0":
+            import shutil as _sh
+            import tempfile as _tf
+
+            sdir = _tf.mkdtemp(prefix="graphite-bench-store-")
+            try:
+                service_c = CampaignService(batch_size=sv_batch,
+                                            store=sdir)
+                t0 = time.perf_counter()
+                for job in jobs:
+                    service_c.submit(job)
+                served_c = service_c.run_all()
+                cold_wall = time.perf_counter() - t0
+                assert len(served_c) == sv_jobs \
+                    and all(r.ok for r in served_c)
+
+                service_w = CampaignService(batch_size=sv_batch,
+                                            store=sdir)
+                t0 = time.perf_counter()
+                n_warm = service_w.warm_start()
+                for job in jobs:
+                    service_w.submit(job)
+                served_w = service_w.run_all()
+                warm_wall = time.perf_counter() - t0
+                assert len(served_w) == sv_jobs \
+                    and all(r.ok for r in served_w)
+                c_cold = service_c.counters
+                c_warm = service_w.counters
+                des = service_w.metrics["store_deserialize_seconds"]
+                comp = service_c.metrics["compile_seconds"]
+                companions.update({
+                    "store_cold_jobs_per_s": round(
+                        sv_jobs / cold_wall, 3),
+                    "store_warm_jobs_per_s": round(
+                        sv_jobs / warm_wall, 3),
+                    # warm fleet member vs the round-13 in-memory serve
+                    # (both compile-inclusive from THEIR perspective:
+                    # the warm one simply has no compiles left to pay)
+                    "store_warm_vs_inmem_serve": round(
+                        (sv_jobs / warm_wall)
+                        / (sv_jobs / serve_wall), 3),
+                    "store_compile_s_per_class": round(comp.mean, 3),
+                    "store_deserialize_s_per_class": round(
+                        des.mean, 3),
+                    "store_warm_start_classes": n_warm,
+                    "store_cold_compiles": c_cold["compile_count"],
+                    "store_warm_compiles": c_warm["compile_count"],
+                    "store_fills": c_cold["store_fills"],
+                    "store_warm_hits": c_warm["store_hits"],
+                })
+            finally:
+                _sh.rmtree(sdir, ignore_errors=True)
+
     # Static cost-model trajectory (round 12): the audited gated-MSI
     # program's per-iteration kernel/byte proxy and its per-phase/base
     # split (analysis/cost.py — the SAME numbers BUDGETS.json gates), so

@@ -71,10 +71,20 @@ class ProgramRecord:
 
     @classmethod
     def from_json(cls, name: str, d: dict) -> "ProgramRecord":
-        return cls(name=name, fingerprint=d["fingerprint"],
-                   tiles=int(d["tiles"]),
-                   knobs=(tuple(d["knobs"]) if "knobs" in d else None),
-                   budget_key=d.get("budget_key", name))
+        """Inverse of `to_json`.  Raises a clean ValueError on a
+        malformed dict — callers deserializing records from artifacts
+        they do not control (the program store's entry manifests) need
+        a named refusal, not a KeyError deep in a load path."""
+        try:
+            return cls(name=name, fingerprint=str(d["fingerprint"]),
+                       tiles=int(d["tiles"]),
+                       knobs=(tuple(d["knobs"]) if "knobs" in d
+                              else None),
+                       budget_key=str(d.get("budget_key", name)))
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(
+                f"malformed ProgramRecord for {name!r}: "
+                f"{type(e).__name__}: {e}") from e
 
 
 def record_from_spec(spec) -> ProgramRecord:

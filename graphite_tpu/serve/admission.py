@@ -241,21 +241,62 @@ class AdmissionController:
         self._ready.appendleft((cls, list(pendings)))
         self._depth += len(pendings)
 
-    def next_batch(self) -> "tuple[JobClass, list[Pending]] | None":
+    def _oldest_waiting(self) -> "JobClass | None":
+        """The class whose HEAD job is globally oldest (no class
+        starves) — the ONE selector `peek_batch` reports and
+        `next_batch` pops, so the two can never drift apart."""
+        waiting = [c for c in self.classes.values() if c.fifo]
+        if not waiting:
+            return None
+        return min(waiting, key=lambda c: c.fifo[0].seq)
+
+    def peek_batch(self) -> "tuple[JobClass, int, Pending, bool] | None":
+        """What `next_batch` WOULD pop, without popping: (class, batch
+        size, head job, preformed) or None on an idle queue.  The
+        service's latency-aware dwell policy reads this to decide
+        whether an under-full batch should wait for more arrivals;
+        `preformed` marks a requeued split/retry batch, which must
+        never wait (its jobs are the globally oldest)."""
+        if self._ready:
+            cls, batch = self._ready[0]
+            return cls, len(batch), batch[0], True
+        cls = self._oldest_waiting()
+        if cls is None:
+            return None
+        return (cls, min(len(cls.fifo), cls.batch_cap), cls.fifo[0],
+                False)
+
+    def full_class(self) -> "JobClass | None":
+        """A class whose queue can ALREADY fill a batch (oldest head
+        among them), or None.  The dwell policy runs a full class
+        while the globally-oldest under-full head keeps aging — a full
+        batch gains nothing by waiting."""
+        full = [c for c in self.classes.values()
+                if len(c.fifo) >= c.batch_cap]
+        if not full:
+            return None
+        return min(full, key=lambda c: c.fifo[0].seq)
+
+    def next_batch(self, from_cls: "JobClass | None" = None
+                   ) -> "tuple[JobClass, list[Pending]] | None":
         """Pop the next batch: requeued (split/retry) batches first —
         they hold the globally oldest jobs — then the class whose HEAD
         job is globally oldest (no class starves), up to the class's
-        budget-derived batch capacity, strict FIFO within the class."""
+        budget-derived batch capacity, strict FIFO within the class.
+        `from_cls` pops from a specific class instead (the dwell
+        policy's run-the-full-class-now path); requeued batches still
+        outrank it."""
         if self._ready:
             cls, batch = self._ready.popleft()
             self._depth -= len(batch)
             return cls, batch
-        waiting = [c for c in self.classes.values() if c.fifo]
-        if not waiting:
+        cls = from_cls if from_cls is not None else self._oldest_waiting()
+        if cls is None:
             return None
-        cls = min(waiting, key=lambda c: c.fifo[0].seq)
         batch = []
         while cls.fifo and len(batch) < cls.batch_cap:
             batch.append(cls.fifo.popleft())
+        if not batch:
+            return None
         self._depth -= len(batch)
         return cls, batch

@@ -16,6 +16,13 @@ The service resolves every insert and hit through its registry, so
    batch and re-proves fingerprint equality — a retrace, never a
    recompile, so the round-7 compile-count probe still reads 1.
 
+Since round 17 the in-memory cache can sit over a persistent
+fingerprint-keyed store of serialized executables (`store/`): a miss
+consults the store before compiling, a fresh compile fills it, and
+`CacheEntry.source` records which path materialized the entry — the
+service (`serve/service.py _resolve_program`) owns that layering, this
+module stays pure host-side bookkeeping.
+
 Eviction is byte-accounted LRU: each entry carries the residency bill
 of the campaign layout it serves (the same
 `analysis/cost.residency_breakdown` total the admission controller
@@ -50,6 +57,14 @@ class CacheEntry:
     # jit (round 14 observability — batch spans report it on hits too,
     # so "what did this program cost to build" survives the miss)
     compile_s: float = 0.0
+    # round 17: how this entry materialized — "compile" (lowered and
+    # compiled in this process) or "store" (deserialized from the
+    # persistent AOT program store) — and the host seconds the store
+    # hit paid to deserialize the payload (0.0 for in-process compiles;
+    # for store entries compile_s reports what the ORIGINAL fleet miss
+    # paid, read from the entry manifest)
+    source: str = "compile"
+    deserialize_s: float = 0.0
 
 
 class ProgramCache:

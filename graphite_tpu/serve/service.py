@@ -81,6 +81,9 @@ class BatchReport:
     ok: bool
     wall_s: float
     error: "str | None" = None
+    # round 17: the in-memory miss was served by the persistent AOT
+    # program store (deserialize, not compile)
+    store_hit: bool = False
 
 
 class CampaignService:
@@ -101,6 +104,19 @@ class CampaignService:
     the service (`results` / `batch_log`) — streaming consumers use
     `drain()`; counters stay exact regardless.
 
+    `store` (round 17): a `store.ProgramStore` (or a directory path)
+    layered UNDER the in-memory cache as its miss/fill backend — an
+    in-memory miss deserializes the fingerprint-keyed on-disk
+    executable instead of compiling (store hit: retrace + deserialize,
+    zero compiles), and a fresh compile is serialized back (store
+    fill), so a fleet of processes sharing one store dir compiles each
+    program class once per FLEET.  `warm_start()` pre-deserializes
+    compatible entries at startup.  `max_dwell_s` (round 17): let an
+    under-full batch wait up to this long for its class to fill before
+    forming — the latency/occupancy dial the round-14
+    `queue_dwell_seconds` histogram measures; 0 (default) keeps the
+    wait-for-nothing scheduler bit-identically.
+
     Observability: `metrics` (an `obs.MetricsRegistry`) is always live
     — it IS the service bookkeeping, not a copy of it; `tracing=True`
     (or a caller-owned `obs.Tracer`) records job-lifecycle + batch
@@ -117,7 +133,9 @@ class CampaignService:
                  shard_batch: "bool | None" = False,
                  max_history: int = 4096,
                  tracing: "bool | Tracer" = False,
-                 clock=None):
+                 clock=None,
+                 store: "object | str | None" = None,
+                 max_dwell_s: float = 0.0):
         import collections
 
         self.admission = AdmissionController(
@@ -160,6 +178,26 @@ class CampaignService:
         self._last_residency = 0
         self._last_cache_hit = False
         self._last_compile_s = 0.0
+        # persistent AOT program store (round 17): the in-memory
+        # cache's miss/fill backend — a fleet of service processes
+        # sharing one store dir compiles each class once per FLEET
+        if isinstance(store, str):
+            from graphite_tpu.store import ProgramStore
+
+            store = ProgramStore(store)
+        self.store = store
+        # fingerprint-keyed staging area `warm_start()` fills from
+        # disk: (fingerprint, B) -> (executable, manifest, deserialize_s)
+        self._warm: dict = {}
+        self._last_store_hit = False
+        self._last_deserialize_s = 0.0
+        # latency-aware batching: an under-full batch may wait up to
+        # `max_dwell_s` for the class to fill before forming (0 = the
+        # round-13 wait-for-nothing scheduler, bit-identically);
+        # `_dwell_wait_s` reports the remaining wait after a step that
+        # chose to hold
+        self.max_dwell_s = float(max_dwell_s)
+        self._dwell_wait_s = 0.0
         self.metrics = MetricsRegistry(clock=self._clock,
                                        max_timeline=int(max_history))
         self._init_metrics()
@@ -191,6 +229,22 @@ class CampaignService:
             "execute_wall": m.counter(
                 "execute_wall_seconds", "wall seconds inside batch "
                 "execution (jobs_per_s denominator)"),
+            "store_hits": m.counter(
+                "store_hits_total", "program-store hits (executable "
+                "deserialized instead of compiled)"),
+            "store_misses": m.counter(
+                "store_misses_total", "program-store misses (store "
+                "attached, fresh compile paid)"),
+            "store_fills": m.counter(
+                "store_fills_total", "executables serialized into the "
+                "program store"),
+            "store_fill_errors": m.counter(
+                "store_fill_errors_total", "store writes that failed "
+                "(disk/serialization; the batch still served)"),
+            "store_integrity": m.counter(
+                "store_integrity_total", "store entries quarantined at "
+                "load (checksum/truncation/version/fingerprint/"
+                "deserialize)"),
         }
         self._g = {
             "queue_depth": m.gauge("queue_depth", "pending jobs"),
@@ -218,6 +272,12 @@ class CampaignService:
             "split_depth": m.histogram(
                 "split_depth", "attempts consumed per terminal job",
                 buckets=DEFAULT_COUNT_BUCKETS),
+            "store_deserialize": m.histogram(
+                "store_deserialize_seconds",
+                "store-hit payload load+deserialize time"),
+            "store_fill": m.histogram(
+                "store_fill_seconds",
+                "store-miss serialize+write time"),
         }
 
     def _span(self, trace_id: str, name: str, **attrs):
@@ -278,28 +338,79 @@ class CampaignService:
 
     # -- scheduling ------------------------------------------------------
 
-    def step(self) -> "list[JobResult]":
+    def step(self, *, force: bool = False) -> "list[JobResult]":
         """Form and run ONE batch (the oldest-head class); returns the
         envelopes it completed (empty when a failed batch split and
-        re-enqueued, or when the queue is idle)."""
+        re-enqueued, when the queue is idle, or when the dwell policy
+        chose to wait).
+
+        With `max_dwell_s > 0` an UNDER-FULL batch holds until its
+        head job has dwelled `max_dwell_s` (trading latency for
+        occupancy the way inference servers do — the trade the
+        round-14 `queue_dwell_seconds` x `batch_occupancy` instruments
+        measure); a full batch, or a requeued split/retry batch, never
+        waits.  `force=True` overrides the hold (the drain-to-idle
+        paths use it so a waiting scheduler cannot spin)."""
         t0 = self._clock()
-        nxt = self.admission.next_batch()
+        self._dwell_wait_s = 0.0
+        from_cls = None
+        if self.max_dwell_s > 0 and not force:
+            peek = self.admission.peek_batch()
+            if peek is not None:
+                cls, n, head, preformed = peek
+                if (not preformed and n < cls.batch_cap
+                        and head.enqueue_ts is not None):
+                    dwelled = t0 - head.enqueue_ts
+                    if dwelled < self.max_dwell_s:
+                        # the oldest head is held — but a FULL batch of
+                        # another class never waits: run it now, the
+                        # held head keeps aging for free
+                        from_cls = self.admission.full_class()
+                        if from_cls is None:
+                            self._dwell_wait_s = \
+                                self.max_dwell_s - dwelled
+                            return []
+        nxt = self.admission.next_batch(from_cls)
         if nxt is None:
             return []
         cls, pendings = nxt
         self._h["batch_form"].observe(self._clock() - t0)
         return self._run_batch(cls, pendings)
 
-    def drain(self):
+    def drain(self, *, force: bool = False):
         """Generator: run batches until the queue is idle, yielding
         result envelopes as each batch completes (the streaming read
-        the CLI prints line-by-line)."""
+        the CLI prints line-by-line).  Dwell-aware: a held under-full
+        batch sleeps out its window on the real clock; under an
+        injected clock that does not advance on its own, the batch is
+        forced instead — drain always terminates.  `force=True` skips
+        every dwell hold outright: when the caller KNOWS no new job
+        can arrive (input exhausted, shutdown), waiting buys nothing
+        but latency."""
         while self.admission.queue_depth:
-            for res in self.step():
+            got = False
+            for res in self.step(force=force):
+                got = True
                 yield res
+            if got or not self._dwell_wait_s:
+                continue
+            # sleep a slice of the window (never a busy spin), then
+            # check whether the clock moved: any real clock
+            # (monotonic/time/perf_counter) or auto-advancing test
+            # clock ages the held head on its own and the loop simply
+            # re-steps; a FROZEN injected clock can never age it past
+            # the dwell window, so the batch is forced instead of
+            # spinning forever
+            before = self._clock()
+            time.sleep(min(self._dwell_wait_s, 0.02))
+            if self._clock() == before:
+                for res in self.step(force=True):
+                    yield res
 
     def run_all(self) -> "list[JobResult]":
-        return list(self.drain())
+        # synchronous: nothing can arrive while we run, so a dwell
+        # hold could only add latency — force past it
+        return list(self.drain(force=True))
 
     @property
     def results(self) -> "list[JobResult]":
@@ -370,7 +481,8 @@ class CampaignService:
             n_jobs=len(pendings), batch_cap=cls.batch_cap,
             occupancy=occupancy,
             residency_total=self._last_residency,
-            cache_hit=self._last_cache_hit, ok=True, wall_s=wall))
+            cache_hit=self._last_cache_hit,
+            store_hit=self._last_store_hit, ok=True, wall_s=wall))
         if self.tracer is not None:
             self.tracer.record(
                 btid, "batch", t0, t0 + wall,
@@ -414,7 +526,9 @@ class CampaignService:
             "n_jobs": len(pendings),
             "occupancy": round(len(pendings) / cls.batch_cap, 6),
             "cache_hit": self._last_cache_hit,
+            "store_hit": self._last_store_hit,
             "compile_s": round(self._last_compile_s, 6),
+            "deserialize_s": round(self._last_deserialize_s, 6),
             "residency_bytes": self._last_residency,
             "jobs": [p.job.job_id for p in pendings],
             "ok": ok,
@@ -436,6 +550,7 @@ class CampaignService:
             occupancy=len(pendings) / cls.batch_cap,
             residency_total=self._last_residency,
             cache_hit=self._last_cache_hit,
+            store_hit=self._last_store_hit,
             ok=False, wall_s=wall, error=msg))
         if self.tracer is not None:
             # the span covers the REAL execute window (t0, t0+wall) —
@@ -515,6 +630,8 @@ class CampaignService:
         self._last_residency = 0
         self._last_cache_hit = False
         self._last_compile_s = 0.0
+        self._last_store_hit = False
+        self._last_deserialize_s = 0.0
         # pad to the class's FIXED capacity with replicas of job 0 so
         # every batch of this class shares one [B, T, L] program shape;
         # the replicas' rows are dropped below (the tail mask)
@@ -549,7 +666,10 @@ class CampaignService:
             if cspan is not None:
                 cspan.attrs.update(hit=self._last_cache_hit,
                                    compile_s=round(
-                                       self._last_compile_s, 6))
+                                       self._last_compile_s, 6),
+                                   store_hit=self._last_store_hit,
+                                   deserialize_s=round(
+                                       self._last_deserialize_s, 6))
         t_exec = self._clock()
         out = runner.run(max_quanta=self.max_quanta)
         t_done = self._clock()
@@ -637,8 +757,34 @@ class CampaignService:
                 "refusing the insert: the same class key must not "
                 "silently serve two different artifacts")
         self.registry[name] = record
-        jitted = runner._get_runner(self.max_quanta)
-        self._last_compile_s = self._clock() - t_compile
+        if self.store is not None:
+            # STORE HIT: another fleet process (or a prior life of this
+            # one) already compiled this exact program — deserialize
+            # its executable and inject it, zero compiles.  The
+            # fingerprint we just lowered IS the store key, so every
+            # store hit is identity-proven by retrace (the same proof
+            # `verify_hits` buys for in-memory hits).
+            t_probe = self._clock()
+            entry = self._store_resolve(runner, record, B, shape_sig)
+            if entry is not None:
+                self.cache.put(key, entry, expect_fingerprint=fp)
+                return entry
+            # the disk probe (possibly a multi-MB read + sha256 + a
+            # quarantine rename) is not compile time: keep it out of
+            # compile_seconds and the compile_s the manifest persists
+            t_compile += self._clock() - t_probe
+            # STORE MISS: compile AOT against the real device inputs
+            # (the jit path compiles lazily inside run(), which cannot
+            # be serialized), fill the store, serve the batch
+            from graphite_tpu.store.aot import aot_compile_runner
+
+            compiled = aot_compile_runner(runner, self.max_quanta)
+            self._last_compile_s = self._clock() - t_compile
+            self._m["store_misses"].inc()
+            jitted = compiled
+        else:
+            jitted = runner._get_runner(self.max_quanta)
+            self._last_compile_s = self._clock() - t_compile
         self._h["compile"].observe(self._last_compile_s)
         entry = CacheEntry(
             name=name, record=record, jitted=jitted,
@@ -647,7 +793,157 @@ class CampaignService:
             compile_s=self._last_compile_s)
         self.cache.put(key, entry, expect_fingerprint=fp)
         self._m["compiles"].inc()
+        if self.store is not None:
+            self._store_fill(entry, B, jitted)
         return entry
+
+    def _store_resolve(self, runner, record, B: int, shape_sig
+                       ) -> "CacheEntry | None":
+        """Serve an in-memory miss from the persistent store when it
+        can prove the artifact: `warm_start()`-staged executables
+        first, then a disk load.  An integrity failure quarantines the
+        entry, counts, and returns None (fall through to compile) —
+        never a crash, never a silently wrong program."""
+        from graphite_tpu.store import (
+            StoreError, StoreIntegrityError, StoreKey,
+        )
+        from graphite_tpu.store.aot import runtime_env
+
+        fp = record.fingerprint
+        staged = self._warm.pop((fp, B), None)
+        if staged is not None:
+            fnc, man, des_s = staged
+        else:
+            skey = StoreKey(fp, B, self.max_quanta, runtime_env())
+            t0 = self._clock()
+            try:
+                got = self.store.load_executable(
+                    skey, expect_fingerprint=fp)
+            except StoreIntegrityError:
+                self._m["store_integrity"].inc()
+                return None
+            except (StoreError, OSError):
+                # store unreachable (read-only mount, deleted locks/,
+                # disk error): an availability loss, not a
+                # correctness one — fall back to a local compile,
+                # never a crash
+                return None
+            if got is None:
+                return None
+            fnc, man = got
+            des_s = self._clock() - t0
+        runner._runner = fnc
+        runner._runner_max_quanta = self.max_quanta
+        self._m["store_hits"].inc()
+        self._h["store_deserialize"].observe(des_s)
+        self._last_store_hit = True
+        self._last_deserialize_s = des_s
+        # what the ORIGINAL fleet miss paid to build this program —
+        # the round-14 "a hit still knows its build cost" contract,
+        # now surviving process death via the manifest
+        try:
+            self._last_compile_s = float(man.get("compile_s", 0.0))
+        except (TypeError, ValueError):
+            self._last_compile_s = 0.0
+        return CacheEntry(
+            name=record.name, record=record, jitted=fnc,
+            max_quanta=self.max_quanta, nbytes=self._last_residency,
+            shape_sig=shape_sig, compile_s=self._last_compile_s,
+            source="store", deserialize_s=des_s)
+
+    def _store_fill(self, entry: CacheEntry, B: int, compiled) -> None:
+        """Serialize + publish the fresh executable (atomic, locked).
+        A fill failure is an availability loss, not a correctness one:
+        counted, never raised into the batch — the compiled program
+        still serves this process."""
+        from graphite_tpu.store import StoreKey
+        from graphite_tpu.store.aot import runtime_env
+
+        t0 = self._clock()
+        try:
+            skey = StoreKey(entry.record.fingerprint, B,
+                            self.max_quanta, runtime_env())
+            self.store.save_executable(skey, compiled, manifest={
+                "name": entry.name,
+                "shape_sig": list(entry.shape_sig),
+                "nbytes": int(entry.nbytes),
+                "compile_s": round(float(entry.compile_s), 6),
+                "record": {"name": entry.record.name,
+                           **entry.record.to_json()},
+            })
+        except Exception:    # noqa: BLE001 — the batch must serve:
+            # serialize/pickle/disk failures of EVERY flavor are an
+            # availability loss for the FLEET, never a correctness
+            # loss for this batch (StoreError, PicklingError, OSError,
+            # backend serialization RuntimeErrors, ...)
+            self._m["store_fill_errors"].inc()
+            return
+        self._m["store_fills"].inc()
+        self._h["store_fill"].observe(self._clock() - t0)
+
+    def warm_start(self, limit: "int | None" = None) -> int:
+        """Pre-populate from the persistent store: deserialize entries
+        compatible with this process (same runtime environment, same
+        `max_quanta`) into a fingerprint-keyed staging area, so the
+        first job of each stored class pays its deserialize at STARTUP
+        and zero compiles at serve time.  Returns the number of
+        programs staged; 0 without a store.  Integrity failures
+        quarantine + count and skip the entry, exactly like the lazy
+        load path.
+
+        Staged executables live on the host/devices until a job of
+        their class pops them, so startup wall time and memory scale
+        with what is staged — `limit` bounds that to the N
+        most-recently-used entries (a fleet store can hold far more
+        classes than one process will ever serve; an unstaged class
+        still store-hits lazily on its first job).  None stages every
+        compatible entry."""
+        if self.store is None:
+            return 0
+        from graphite_tpu.store import (
+            StoreError, StoreIntegrityError, StoreKey,
+        )
+        from graphite_tpu.store.aot import runtime_env
+
+        env = runtime_env()
+        n = 0
+        try:
+            rows = self.store.entries()
+        except OSError:
+            return 0    # store unreachable: cold start, not a crash
+        # entries() sorts oldest-used first; stage MRU-first so a
+        # `limit` keeps the entries most likely to serve soon
+        for row in reversed(rows):
+            if limit is not None and n >= limit:
+                break
+            man = row["manifest"]
+            if man is None:
+                continue
+            try:
+                fp = str(man["fingerprint"])
+                batch = int(man["batch"])
+                ok = (int(man["max_quanta"]) == self.max_quanta
+                      and tuple(man["env"]) == env)
+            except (KeyError, TypeError, ValueError):
+                continue
+            if not ok or (fp, batch) in self._warm:
+                continue
+            skey = StoreKey(fp, batch, self.max_quanta, env)
+            t0 = self._clock()
+            try:
+                got = self.store.load_executable(
+                    skey, expect_fingerprint=fp)
+            except StoreIntegrityError:
+                self._m["store_integrity"].inc()
+                continue
+            except (StoreError, OSError):
+                continue    # unreachable entry: serve cold instead
+            if got is None:
+                continue
+            fnc, man2 = got
+            self._warm[(fp, batch)] = (fnc, man2, self._clock() - t0)
+            n += 1
+        return n
 
     # -- observability ---------------------------------------------------
 
@@ -663,6 +959,11 @@ class CampaignService:
         m = self._m
         hits = int(m["cache_hits"].value)
         compiles = int(m["compiles"].value)
+        # store hits are neither an in-memory hit nor a compile, but
+        # they ARE resolved batches — the rate's denominator counts
+        # every resolution so a warm-started fleet member reads an
+        # honest in-memory hit fraction
+        store_hits = int(m["store_hits"].value)
         occ = self._h["occupancy"]
         wall = m["execute_wall"].value
         completed = int(m["completed"].value)
@@ -679,10 +980,15 @@ class CampaignService:
             "compile_count": compiles,
             "queue_depth": self.admission.queue_depth,
             "mean_batch_occupancy": occ.mean,
-            "cache_hit_rate": (hits / (hits + compiles)
-                               if hits + compiles else 0.0),
+            "cache_hit_rate": (hits / (hits + compiles + store_hits)
+                               if hits + compiles + store_hits
+                               else 0.0),
             "cache_entries": len(self.cache),
             "cache_bytes": self.cache.total_bytes,
             "cache_evictions": self.cache.evictions,
+            "store_hits": store_hits,
+            "store_misses": int(m["store_misses"].value),
+            "store_fills": int(m["store_fills"].value),
+            "store_integrity": int(m["store_integrity"].value),
             "jobs_per_s": completed / wall if wall > 0 else 0.0,
         }

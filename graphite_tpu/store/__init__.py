@@ -1,0 +1,6 @@
+"""Persistent AOT program store: fingerprint-keyed on-disk executables
+shared across a service fleet (see store/store.py)."""
+
+from graphite_tpu.store.store import (       # noqa: F401
+    ProgramStore, REASONS, StoreError, StoreIntegrityError, StoreKey,
+)

@@ -1,0 +1,659 @@
+"""The persistent, fingerprint-keyed store of compiled executables.
+
+This is the miss/fill backend under the round-13 in-memory
+`ProgramCache`: a content-addressed on-disk layout keyed by
+
+    (canonical program fingerprint, batch capacity B, max_quanta,
+     runtime environment tuple)
+
+where the fingerprint is the round-11 `analysis/identity` digest of the
+lowered campaign program and the environment tuple
+(`store/aot.runtime_env`) pins the jax/jaxlib versions, backend
+platform, device kind and device count the executable was compiled
+for.  A fleet of
+service processes pointed at one store directory compiles each program
+class ONCE per fleet: every later process (or restart) deserializes the
+stored executable instead of recompiling it.
+
+Layout (everything under one root):
+
+    root/entries/<eid>/program.bin    the serialized executable payload
+    root/entries/<eid>/manifest.json  identity + sha256 + metadata
+    root/entries/<eid>/last_used      LRU timestamp (gc's sort key)
+    root/entries/<eid>.corrupt-<n>/   quarantined entries (forensics)
+    root/locks/<eid>.lock             advisory per-entry flock
+    root/locks/store.lock             gc's store-wide flock
+
+Durability and concurrency invariants:
+
+ - **Atomic publication.**  Payload and manifest are written to
+   temporaries and `os.replace`d into place, payload FIRST and manifest
+   LAST — a visible manifest always names a fully written payload, so a
+   crashed writer leaves a miss, never a half-entry.
+ - **Advisory locking.**  Writers (fill, quarantine, evict, gc) hold an
+   exclusive `flock` on the entry's lock file, so concurrent service
+   processes never interleave partial writes; a filler that finds a
+   valid entry under the lock skips its own write (the lost race is
+   counted, not an error).  Readers stay lock-free: atomic publication
+   plus checksums make a torn read detectable, and the one detectable
+   race (manifest swapped between the reader's two reads) is retried
+   and then arbitrated under the entry lock before it can quarantine
+   a healthy entry.
+ - **Integrity before identity before payload.**  A load verifies, in
+   order: the manifest parses and carries every required field; the
+   entry's format/environment/key fields match the requested key; the
+   fingerprint matches both the key and the caller's expectation; the
+   payload length matches; the sha256 matches.  Each failure raises a
+   named `StoreIntegrityError` (`.reason` in REASONS) after the entry
+   is QUARANTINED (renamed to `.corrupt-<n>`) — corruption is loud,
+   forensically preserved, and never served.
+ - **Byte-budgeted GC.**  `gc(max_bytes)` evicts least-recently-used
+   entries (the `last_used` stamp, refreshed on every successful load)
+   until the store fits; the most-recently-used entry always survives,
+   mirroring the in-memory cache's newest-entry rule.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+import time
+
+try:
+    import fcntl
+except ImportError:          # pragma: no cover - non-POSIX fallback
+    fcntl = None
+
+FORMAT = "graphite-store-v1"
+
+# every named way a stored entry can fail verification
+REASONS = ("manifest", "version", "fingerprint", "truncated",
+           "checksum", "deserialize")
+
+_MANIFEST_REQUIRED = ("format", "fingerprint", "batch", "max_quanta",
+                      "env", "payload_sha256", "payload_bytes")
+
+
+class StoreError(RuntimeError):
+    """Base type for program-store failures."""
+
+
+class StoreIntegrityError(StoreError):
+    """A stored entry failed verification; `.reason` names how (one of
+    `REASONS`).  Raised AFTER the entry was quarantined — the caller's
+    only correct recovery is a fresh compile."""
+
+    def __init__(self, reason: str, message: str):
+        assert reason in REASONS, reason
+        super().__init__(message)
+        self.reason = reason
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreKey:
+    """One executable's identity: program fingerprint x batch capacity
+    x quantum bound x runtime environment."""
+
+    fingerprint: str
+    batch: int
+    max_quanta: int
+    env: tuple  # aot.runtime_env(): (jax, jaxlib, backend, kind, ndev)
+
+    def canonical(self) -> str:
+        return json.dumps(
+            {"fingerprint": self.fingerprint, "batch": int(self.batch),
+             "max_quanta": int(self.max_quanta), "env": list(self.env)},
+            sort_keys=True)
+
+    @property
+    def entry_id(self) -> str:
+        return hashlib.sha256(self.canonical().encode()).hexdigest()[:40]
+
+
+def _sha256(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """Write-to-temporary + fsync + rename: `path` is either absent,
+    the old content, or the complete new content — never a prefix."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class ProgramStore:
+    """Fingerprint-keyed on-disk executables with integrity + LRU GC.
+
+    `max_bytes` (0 = unbounded) arms auto-GC after every fill; `clock`
+    injects the wall-clock source the LRU stamps and manifests read
+    (tests pass a fake).  `counters` tracks store-local events (fills,
+    lost write races, integrity quarantines, evictions) — the serving
+    metrics (hits/misses) live in the service's round-14 registry,
+    which owns rate accounting."""
+
+    def __init__(self, root: str, *, max_bytes: int = 0, clock=time.time):
+        self.root = os.path.abspath(root)
+        self.max_bytes = int(max_bytes)
+        self._clock = clock
+        self.counters = {"fills": 0, "races": 0, "integrity": 0,
+                         "evictions": 0}
+        os.makedirs(os.path.join(self.root, "entries"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "locks"), exist_ok=True)
+
+    # -- paths -----------------------------------------------------------
+
+    def _entries_root(self) -> str:
+        return os.path.join(self.root, "entries")
+
+    def _entry_dir(self, eid: str) -> str:
+        return os.path.join(self._entries_root(), eid)
+
+    @contextlib.contextmanager
+    def _lock(self, name: str):
+        """Blocking exclusive advisory flock on `locks/<name>.lock`.
+
+        Stale-inode safe: gc's housekeeping may UNLINK a lock file for
+        a long-gone entry, so after acquiring we confirm the path
+        still names the inode we locked — a waiter that was blocked on
+        the unlinked inode would otherwise "hold" a lock no later
+        process can see, silently breaking mutual exclusion."""
+        path = os.path.join(self.root, "locks", f"{name}.lock")
+        while True:
+            fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+            if fcntl is None:
+                break
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            try:
+                if os.fstat(fd).st_ino == os.stat(path).st_ino:
+                    break
+            except OSError:
+                pass            # unlinked while we waited: retry
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+        try:
+            yield
+        finally:
+            if fcntl is not None:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    # -- read path -------------------------------------------------------
+
+    def _read_manifest(self, eid: str) -> "dict | None":
+        """The entry's manifest dict, or None when absent/unparsable —
+        callers decide whether unparsable is a miss or an integrity
+        failure."""
+        try:
+            with open(os.path.join(self._entry_dir(eid),
+                                   "manifest.json")) as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return man if isinstance(man, dict) else None
+
+    _READ = object()    # _check_entry sentinel: read the payload here
+
+    def _check_entry(self, eid: str,
+                     key: "StoreKey | None" = None,
+                     expect_fingerprint: "str | None" = None,
+                     blob=_READ, man=_READ) -> "tuple[str, str] | None":
+        """Verify one entry without quarantining: None when it is
+        sound, else (reason, message).  `blob` / `man` skip the
+        re-read when the caller already holds the payload bytes or the
+        parsed manifest (None = the caller found them missing or
+        unparsable) — verifying the caller's copies also guarantees
+        the verified manifest IS the one the caller returns."""
+        edir = self._entry_dir(eid)
+        if man is ProgramStore._READ:
+            man = self._read_manifest(eid)
+        if man is None:
+            if os.path.exists(os.path.join(edir, "manifest.json")):
+                return ("manifest", f"entry {eid}: manifest.json does "
+                        "not parse as a JSON object")
+            return ("manifest", f"entry {eid}: manifest.json missing "
+                    "(payload without identity)")
+        missing = [k for k in _MANIFEST_REQUIRED if k not in man]
+        if missing:
+            return ("manifest", f"entry {eid}: manifest missing "
+                    f"field(s) {missing}")
+        try:
+            return self._check_fields(eid, man, key,
+                                      expect_fingerprint, blob)
+        except (TypeError, ValueError) as e:
+            # a JSON-parsable manifest whose fields have the wrong
+            # TYPES (int("12a"), tuple(None), slicing a number) is
+            # corruption like any other: a named failure, not a crash
+            return ("manifest", f"entry {eid}: manifest field has a "
+                    f"wrong type: {type(e).__name__}: {e}")
+
+    def _check_fields(self, eid, man, key, expect_fingerprint,
+                      blob) -> "tuple[str, str] | None":
+        """`_check_entry`'s field checks, free to assume the manifest
+        values coerce (the caller maps TypeError/ValueError to a
+        "manifest" integrity failure)."""
+        edir = self._entry_dir(eid)
+        if man["format"] != FORMAT:
+            return ("version", f"entry {eid}: store format "
+                    f"{man['format']!r} != {FORMAT!r}")
+        if key is None:
+            # keyless audits (`verify`) must still prove the entry
+            # LIVES where its key fields hash — a dir restored under
+            # the wrong id, or a manifest whose key fields were edited
+            # consistently with its checksum, would audit clean here
+            # yet quarantine at the first real request
+            expect_eid = StoreKey(
+                str(man["fingerprint"]), int(man["batch"]),
+                int(man["max_quanta"]), tuple(man["env"])).entry_id
+            if expect_eid != eid:
+                return ("manifest", f"entry {eid}: manifest key "
+                        f"fields hash to {expect_eid} — the entry "
+                        "does not live where its identity says")
+        if key is not None:
+            if tuple(man["env"]) != tuple(key.env):
+                return ("version", f"entry {eid}: compiled for env "
+                        f"{tuple(man['env'])} but this process is "
+                        f"{tuple(key.env)}")
+            if (int(man["batch"]) != int(key.batch)
+                    or int(man["max_quanta"]) != int(key.max_quanta)):
+                return ("manifest", f"entry {eid}: manifest key fields "
+                        f"(B={man['batch']}, max_quanta="
+                        f"{man['max_quanta']}) do not match the "
+                        f"requested key (B={key.batch}, max_quanta="
+                        f"{key.max_quanta})")
+            if man["fingerprint"] != key.fingerprint:
+                return ("fingerprint", f"entry {eid}: stores "
+                        f"{man['fingerprint'][:24]}... but the key "
+                        f"names {key.fingerprint[:24]}...")
+        if expect_fingerprint is not None \
+                and man["fingerprint"] != expect_fingerprint:
+            return ("fingerprint", f"entry {eid}: stores "
+                    f"{man['fingerprint'][:24]}... but the caller "
+                    f"expects {expect_fingerprint[:24]}... — a stale "
+                    "artifact must recompile, never serve")
+        if blob is ProgramStore._READ:
+            try:
+                with open(os.path.join(edir, "program.bin"), "rb") as f:
+                    blob = f.read()
+            except OSError:
+                blob = None
+        if blob is None:
+            return ("truncated", f"entry {eid}: payload missing")
+        if len(blob) != int(man["payload_bytes"]):
+            return ("truncated", f"entry {eid}: payload is {len(blob)} "
+                    f"bytes, manifest says {man['payload_bytes']}")
+        if _sha256(blob) != man["payload_sha256"]:
+            return ("checksum", f"entry {eid}: payload sha256 does not "
+                    "match the manifest")
+        return None
+
+    def get_blob(self, key: StoreKey, *,
+                 expect_fingerprint: "str | None" = None
+                 ) -> "tuple[bytes, dict] | None":
+        """Read + verify one entry: (payload bytes, manifest) on a
+        sound hit, None on a clean miss.  An entry failing verification
+        is quarantined and raises `StoreIntegrityError` — the caller
+        falls back to a fresh compile.
+
+        Lock-free read: atomic publication means a visible manifest
+        names a complete payload.  A writer REPLACING the entry between
+        our manifest and payload reads can make a sound entry look
+        torn, so a checksum/truncation failure is re-read once; every
+        failure is then arbitrated — and, if confirmed, quarantined in
+        the same lock hold — under the entry lock, where no writer can
+        be mid-publish."""
+        eid = key.entry_id
+        edir = self._entry_dir(eid)
+        if not os.path.exists(os.path.join(edir, "manifest.json")):
+            return None
+        bad: "tuple[str, str] | None" = None
+        for _attempt in range(2):
+            try:
+                with open(os.path.join(edir, "program.bin"), "rb") as f:
+                    blob = f.read()
+            except OSError:
+                blob = None
+            man = self._read_manifest(eid)
+            bad = self._check_entry(
+                eid, key=key, expect_fingerprint=expect_fingerprint,
+                blob=blob, man=man)
+            if bad is None:
+                self._touch(eid)
+                return blob, man
+            if bad[0] not in ("truncated", "checksum"):
+                break           # identity failures don't race-retry
+        # final arbitration under the entry lock: writers publish
+        # while HOLDING it, so this view cannot be torn — a
+        # repair-in-place writer that straddled both lock-free
+        # attempts resolves to a sound entry and serves, a vanished
+        # entry (concurrent evict/GC) resolves to a clean miss, and
+        # real corruption quarantines ATOMICALLY with this
+        # verification (the lock is not released in between, so a
+        # healthy entry is never quarantined)
+        with self._lock(eid):
+            try:
+                with open(os.path.join(edir, "program.bin"),
+                          "rb") as f:
+                    blob = f.read()
+            except OSError:
+                blob = None
+            man = self._read_manifest(eid)
+            bad = self._check_entry(
+                eid, key=key, expect_fingerprint=expect_fingerprint,
+                blob=blob, man=man)
+            if bad is None:
+                self._touch(eid)
+                return blob, man
+            reason, msg = bad
+            dst = self._quarantine_locked(eid, reason)
+        if dst is None:
+            return None     # evicted under us: a miss, not corruption
+        self.counters["integrity"] += 1
+        raise StoreIntegrityError(reason, msg)
+
+    def load_executable(self, key: StoreKey, *,
+                        expect_fingerprint: "str | None" = None
+                        ) -> "tuple[object, dict] | None":
+        """`get_blob` + payload deserialize: (callable executable,
+        manifest) on a hit, None on a miss; a payload that passes its
+        checksum but fails to deserialize is quarantined too (reason
+        "deserialize")."""
+        got = self.get_blob(key, expect_fingerprint=expect_fingerprint)
+        if got is None:
+            return None
+        blob, man = got
+        from graphite_tpu.store.aot import deserialize_compiled
+
+        try:
+            fnc = deserialize_compiled(blob)
+        except Exception as e:
+            eid = key.entry_id
+            self.quarantine(eid, "deserialize")
+            raise StoreIntegrityError(
+                "deserialize", f"entry {eid}: payload verified but "
+                f"did not load: {type(e).__name__}: {e}") from e
+        return fnc, man
+
+    def _touch(self, eid: str) -> None:
+        """Refresh the LRU stamp (best-effort: a read-only store still
+        serves, it just can't reorder its own GC)."""
+        try:
+            _atomic_write(os.path.join(self._entry_dir(eid), "last_used"),
+                          repr(float(self._clock())).encode())
+        except OSError:
+            pass
+
+    def _last_used(self, eid: str) -> float:
+        try:
+            with open(os.path.join(self._entry_dir(eid),
+                                   "last_used")) as f:
+                return float(f.read().strip())
+        except (OSError, ValueError):
+            man = self._read_manifest(eid) or {}
+            try:
+                return float(man.get("created_s", 0.0))
+            except (TypeError, ValueError):
+                return 0.0
+
+    # -- write path ------------------------------------------------------
+
+    def put_blob(self, key: StoreKey, blob: bytes, *,
+                 manifest: "dict | None" = None) -> dict:
+        """Atomically publish one entry under the per-entry lock.  A
+        valid entry already present wins the race (ours is discarded
+        and `races` counted); an invalid one is repaired in place.
+        Returns the manifest that ended up published."""
+        eid = key.entry_id
+        with self._lock(eid):
+            if os.path.exists(os.path.join(self._entry_dir(eid),
+                                           "manifest.json")):
+                if self._check_entry(eid, key=key) is None:
+                    self.counters["races"] += 1
+                    return self._read_manifest(eid)
+            man = dict(manifest or {})
+            man.update({
+                "format": FORMAT,
+                "fingerprint": key.fingerprint,
+                "batch": int(key.batch),
+                "max_quanta": int(key.max_quanta),
+                "env": list(key.env),
+                "payload_sha256": _sha256(blob),
+                "payload_bytes": len(blob),
+                "created_s": float(self._clock()),
+            })
+            edir = self._entry_dir(eid)
+            os.makedirs(edir, exist_ok=True)
+            # payload FIRST, manifest LAST: publication is the manifest
+            _atomic_write(os.path.join(edir, "program.bin"), blob)
+            _atomic_write(os.path.join(edir, "manifest.json"),
+                          (json.dumps(man, indent=1, sort_keys=True)
+                           + "\n").encode())
+            self._touch(eid)
+            self.counters["fills"] += 1
+        if self.max_bytes:
+            self.gc(self.max_bytes)
+        return man
+
+    def save_executable(self, key: StoreKey, compiled, *,
+                        manifest: "dict | None" = None,
+                        verify: bool = True) -> dict:
+        """Serialize a `jax.stages.Compiled` and publish it.
+
+        `verify` (default on) load-backs the payload BEFORE publishing:
+        XLA backends can emit executables whose serialization is
+        incomplete (e.g. a CPU executable served from a warm
+        compilation cache loses its kernel object code), and a payload
+        that cannot deserialize here cannot deserialize anywhere —
+        raising `StoreError` now (the caller counts a fill error and
+        moves on) beats poisoning the fleet's store."""
+        from graphite_tpu.store.aot import (
+            deserialize_compiled, serialize_compiled,
+        )
+
+        blob = serialize_compiled(compiled)
+        if verify:
+            try:
+                deserialize_compiled(blob)
+            except Exception as e:
+                raise StoreError(
+                    f"refusing to publish {key.entry_id}: the payload "
+                    f"fails its own load-back ({type(e).__name__}: "
+                    f"{str(e)[:160]}) — the executable's serialization "
+                    "is incomplete") from e
+        return self.put_blob(key, blob, manifest=manifest)
+
+    def quarantine(self, eid: str, reason: str) -> "str | None":
+        """Move a failed entry aside (rename to `.corrupt-<n>`) so it
+        is never served again but stays on disk for forensics; returns
+        the quarantine path (None when the entry vanished under us)."""
+        with self._lock(eid):
+            dst = self._quarantine_locked(eid, reason)
+        if dst is None:
+            return None
+        self.counters["integrity"] += 1
+        return dst
+
+    def _quarantine_locked(self, eid: str, reason: str) -> "str | None":
+        """`quarantine`'s body, for callers already holding the entry
+        lock (does NOT count — the caller does, outside the lock)."""
+        edir = self._entry_dir(eid)
+        if not os.path.isdir(edir):
+            return None
+        n = 0
+        while os.path.exists(f"{edir}.corrupt-{n}"):
+            n += 1
+        dst = f"{edir}.corrupt-{n}"
+        try:
+            os.rename(edir, dst)
+        except OSError:
+            return None
+        with contextlib.suppress(OSError):
+            _atomic_write(os.path.join(dst, "quarantine.json"),
+                          (json.dumps({"reason": reason,
+                                       "when_s": float(self._clock())})
+                           + "\n").encode())
+        return dst
+
+    # -- enumeration / maintenance --------------------------------------
+
+    def _entry_bytes(self, path: str) -> int:
+        total = 0
+        with contextlib.suppress(OSError):
+            for name in os.listdir(path):
+                with contextlib.suppress(OSError):
+                    total += os.path.getsize(os.path.join(path, name))
+        return total
+
+    def entries(self, *, include_corrupt: bool = False) -> "list[dict]":
+        """One row per on-disk entry: {entry_id, manifest (None when
+        unparsable), bytes, last_used, corrupt}.  Sorted oldest-used
+        first (GC order)."""
+        rows = []
+        root = self._entries_root()
+        for name in sorted(os.listdir(root)):
+            path = os.path.join(root, name)
+            if not os.path.isdir(path):
+                continue
+            corrupt = ".corrupt-" in name
+            if corrupt and not include_corrupt:
+                continue
+            eid = name.split(".corrupt-")[0] if corrupt else name
+            rows.append({
+                "entry_id": name,
+                "manifest": None if corrupt else self._read_manifest(eid),
+                "bytes": self._entry_bytes(path),
+                "last_used": 0.0 if corrupt else self._last_used(eid),
+                "corrupt": corrupt,
+            })
+        rows.sort(key=lambda r: (r["corrupt"], r["last_used"]))
+        return rows
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(r["bytes"] for r in self.entries())
+
+    def verify(self) -> "list[dict]":
+        """Non-quarantining full-store audit: one row per entry with
+        {entry_id, ok, reason, message}.  Corrupt-quarantined dirs are
+        reported (ok=False, reason="quarantined") so a populated-then-
+        corrupted store audits loudly."""
+        out = []
+        for row in self.entries(include_corrupt=True):
+            name = row["entry_id"]
+            if row["corrupt"]:
+                out.append({"entry_id": name, "ok": False,
+                            "reason": "quarantined",
+                            "message": "previously quarantined entry"})
+                continue
+            bad = self._check_entry(name)
+            if bad is None:
+                out.append({"entry_id": name, "ok": True,
+                            "reason": None, "message": ""})
+            else:
+                out.append({"entry_id": name, "ok": False,
+                            "reason": bad[0], "message": bad[1]})
+        return out
+
+    def evict(self, eid: str) -> bool:
+        """Delete one entry (or quarantined dir) by its listing name.
+
+        The id is a LISTING name, never a path: anything that would
+        resolve outside `entries/` (separators, dot-segments, empty —
+        `entries/..` is the store root and `rmtree` would eat it) is
+        refused as not-an-entry, not deleted."""
+        if (not eid or eid != os.path.basename(eid)
+                or eid in (".", "..")):
+            return False
+        path = os.path.join(self._entries_root(), eid)
+        lock_name = eid.split(".corrupt-")[0]
+        with self._lock(lock_name):
+            if not os.path.isdir(path):
+                return False
+            shutil.rmtree(path, ignore_errors=True)
+            if os.path.isdir(path):
+                return False    # undeletable (permissions, in use):
+                                # the bytes are still there, say so
+        self.counters["evictions"] += 1
+        return True
+
+    def gc(self, max_bytes: "int | None" = None, *,
+           include_corrupt: bool = False) -> "list[str]":
+        """Evict least-recently-used entries until the store fits
+        `max_bytes` (default: the constructor budget).  The most-
+        recently-used entry always survives — a store that cannot hold
+        one program would force a compile per process, which is
+        strictly worse than admitting the overage.  `include_corrupt`
+        also deletes quarantined dirs (forensics over; they never count
+        against the byte budget)."""
+        budget = self.max_bytes if max_bytes is None else int(max_bytes)
+        evicted = []
+        with self._lock("store"):
+            if include_corrupt:
+                for row in self.entries(include_corrupt=True):
+                    if row["corrupt"] and self.evict(row["entry_id"]):
+                        evicted.append(row["entry_id"])
+            if budget:
+                rows = self.entries()      # oldest-used first
+                total = sum(r["bytes"] for r in rows)
+                while len(rows) > 1 and total > budget:
+                    row = rows.pop(0)
+                    if self.evict(row["entry_id"]):
+                        total -= row["bytes"]
+                        evicted.append(row["entry_id"])
+            self._gc_orphan_locks()
+        return evicted
+
+    def _gc_orphan_locks(self) -> None:
+        """Unlink lock files whose entry (and quarantine dirs) are
+        gone — GC churn would otherwise grow `locks/` without bound.
+        Non-blocking probe first: a held lock is in use, skip it; the
+        stale-inode retry in `_lock` keeps a waiter that raced the
+        unlink from holding an invisible lock."""
+        if fcntl is None:
+            return
+        lroot = os.path.join(self.root, "locks")
+        try:
+            names = os.listdir(lroot)
+            live = {n.split(".corrupt-")[0]
+                    for n in os.listdir(self._entries_root())}
+        except OSError:
+            return
+        for fname in names:
+            eid = fname[:-5] if fname.endswith(".lock") else fname
+            if eid == "store":
+                continue        # the store-wide lock we are holding
+            if eid in live:     # an entry or its quarantine dirs
+                continue
+            path = os.path.join(lroot, fname)
+            try:
+                fd = os.open(path, os.O_RDWR)
+            except OSError:
+                continue
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(fd)
+                continue        # held right now: it is not an orphan
+            try:
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+            finally:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+                os.close(fd)
+
+    def stats(self) -> dict:
+        rows = self.entries(include_corrupt=True)
+        valid = [r for r in rows if not r["corrupt"]]
+        return {
+            "entries": len(valid),
+            "corrupt": sum(1 for r in rows if r["corrupt"]),
+            "bytes": sum(r["bytes"] for r in valid),
+            **self.counters,
+        }

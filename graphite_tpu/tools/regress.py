@@ -458,6 +458,97 @@ def smoke(tiles: int = 16) -> int:
           f"{'PASS' if ok else 'FAIL'}")
     failures += 0 if ok else 1
 
+    # 11) persistent AOT program store (round 17, store/): the rung-8
+    #     mixed-geometry job set served through a store-backed service
+    #     must be bit-identical to the in-memory serve, a SECOND
+    #     service over the same store must warm-start with ZERO
+    #     compiles (fleet-once compilation), and `tools/store.py
+    #     verify` must exit 0 on the populated store and 1 after
+    #     deliberate corruption.
+    import shutil as _sh
+    import tempfile as _tf
+
+    from graphite_tpu.store import ProgramStore
+    from graphite_tpu.tools.store import main as store_main
+
+    store_dir = _tf.mkdtemp(prefix="graphite-regress-store-")
+    try:
+        def _mkjobs():
+            out = []
+            for i, s in enumerate((1, 2, 3)):
+                out.append(Job(f"t4-{i}", sc4, _mkt(4, s), seed=s))
+                out.append(Job(f"t8-{i}", sc8, _mkt(8, s), seed=s,
+                               telemetry=tel_sv))
+            return out
+
+        svc_st = CampaignService(batch_size=2, max_quanta=200_000,
+                                 store=store_dir)
+        for job in _mkjobs():
+            svc_st.submit(job)
+        served_st = {r.job_id: r for r in svc_st.drain()}
+        for jid, ref in served.items():
+            got = served_st[jid]
+            failures += _compare(f"store serve {jid} vs in-memory",
+                                 got.results, ref.results)
+            if ref.telemetry is not None:
+                ok = (got.telemetry.n_total == ref.telemetry.n_total
+                      and np.array_equal(got.telemetry.data,
+                                         ref.telemetry.data))
+                print(f"{f'store serve {jid} timeline':44} "
+                      f"{'PASS' if ok else 'FAIL'}")
+                failures += 0 if ok else 1
+        c_st = svc_st.counters
+        ok = (c_st["compile_count"] == 2 and c_st["store_fills"] == 2
+              and c_st["store_hits"] == 0
+              and c_st["store_integrity"] == 0)
+        print(f"{'store cold start: 2 compiles, 2 fills':44} "
+              f"{'PASS' if ok else 'FAIL'}"
+              + ("" if ok else f"  (compiles={c_st['compile_count']} "
+                 f"fills={c_st['store_fills']} "
+                 f"hits={c_st['store_hits']} "
+                 f"integ={c_st['store_integrity']})"))
+        failures += 0 if ok else 1
+
+        svc_w = CampaignService(batch_size=2, max_quanta=200_000,
+                                store=store_dir)
+        n_warm = svc_w.warm_start()
+        for job in _mkjobs():
+            svc_w.submit(job)
+        served_w = {r.job_id: r for r in svc_w.drain()}
+        for jid, ref in served.items():
+            failures += _compare(f"warm-start serve {jid} vs in-memory",
+                                 served_w[jid].results, ref.results)
+        c_w = svc_w.counters
+        ok = (n_warm == 2 and c_w["compile_count"] == 0
+              and c_w["store_hits"] == 2 and c_w["store_misses"] == 0
+              and c_w["store_integrity"] == 0)
+        print(f"{'store warm start: 0 compiles, 2 hits':44} "
+              f"{'PASS' if ok else 'FAIL'}"
+              + ("" if ok else f"  (warm={n_warm} "
+                 f"compiles={c_w['compile_count']} "
+                 f"hits={c_w['store_hits']} "
+                 f"integ={c_w['store_integrity']})"))
+        failures += 0 if ok else 1
+
+        rc_clean = store_main(["--store", store_dir, "verify"])
+        print(f"{'tools/store.py verify (sound store) == 0':44} "
+              f"{'PASS' if rc_clean == 0 else 'FAIL'}")
+        failures += 0 if rc_clean == 0 else 1
+        import os as _os
+        row = ProgramStore(store_dir).entries()[0]
+        pbin = _os.path.join(store_dir, "entries", row["entry_id"],
+                             "program.bin")
+        with open(pbin, "rb") as fh:
+            pb = fh.read()
+        with open(pbin, "wb") as fh:
+            fh.write(pb[:64] + bytes([pb[64] ^ 0xFF]) + pb[65:])
+        rc_bad = store_main(["--store", store_dir, "verify"])
+        print(f"{'tools/store.py verify (corrupted) == 1':44} "
+              f"{'PASS' if rc_bad == 1 else 'FAIL'}")
+        failures += 0 if rc_bad == 1 else 1
+    finally:
+        _sh.rmtree(store_dir, ignore_errors=True)
+
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
     return 1 if failures else 0
 

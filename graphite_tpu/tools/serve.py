@@ -30,6 +30,12 @@ Usage:
   python -m graphite_tpu.tools.serve --jobs jobs.jsonl --budget-bytes 2e9
   cat jobs.jsonl | python -m graphite_tpu.tools.serve --batch-size 8
   python -m graphite_tpu.tools.serve --dryrun    # tiny CPU smoke, no input
+  python -m graphite_tpu.tools.serve --jobs jobs.jsonl --store /shared/aot
+      # fleet mode (round 17): executables deserialize from / serialize
+      # into the shared store, warm-starting from compatible entries —
+      # each program class compiles once per FLEET; the summary line's
+      # store_hits / store_fills / compile_count report the split
+      # (maintain the store with tools/store.py ls|verify|gc|evict)
 
 `--dryrun` pins JAX to CPU and serves a built-in mixed-geometry,
 mixed-knob demo job set — the smoke shape `tools/regress.py --smoke`'s
@@ -165,6 +171,22 @@ def main(argv=None) -> int:
     ap.add_argument("--verify-hits", action="store_true",
                     help="re-lower every cache hit and re-prove "
                     "fingerprint equality (retrace, never recompile)")
+    ap.add_argument("--store", metavar="DIR",
+                    help="persistent AOT program store directory "
+                    "(created if absent, shared across a fleet of "
+                    "serve processes): compiled executables are "
+                    "deserialized from / serialized into it, and the "
+                    "service warm-starts from compatible entries "
+                    "(maintain with tools/store.py)")
+    ap.add_argument("--warm-limit", type=int, default=None,
+                    metavar="N",
+                    help="stage at most N most-recently-used store "
+                    "entries at startup (default: every compatible "
+                    "entry; unstaged classes still store-hit lazily)")
+    ap.add_argument("--max-dwell-s", type=float, default=0.0,
+                    help="let an under-full batch wait up to this long "
+                    "for its class to fill before forming (latency/"
+                    "occupancy trade; 0 = run immediately)")
     ap.add_argument("--trace-out", metavar="FILE",
                     help="enable span tracing and write job/batch "
                     "lifecycle spans as JSON-lines on exit "
@@ -186,6 +208,16 @@ def main(argv=None) -> int:
     if args.dryrun:
         # must land before jax initializes its backends
         os.environ["JAX_PLATFORMS"] = "cpu"
+
+    if args.store is not None:
+        # a clean refusal beats a traceback deep inside the store: a
+        # path that EXISTS but is not a directory can never hold the
+        # entries/ layout (a missing path is first boot — create it)
+        if os.path.exists(args.store) and not os.path.isdir(args.store):
+            print(f"error: --store {args.store!r} exists and is not a "
+                  "directory", file=sys.stderr)
+            return 2
+        os.makedirs(args.store, exist_ok=True)
 
     import graphite_tpu  # noqa: F401  (x64)
 
@@ -219,7 +251,13 @@ def main(argv=None) -> int:
         max_pending=args.max_pending,
         max_quanta=args.max_quanta,
         verify_hits=args.verify_hits,
-        tracing=bool(args.trace_out))
+        tracing=bool(args.trace_out),
+        store=args.store,
+        max_dwell_s=args.max_dwell_s)
+    n_warm = service.warm_start(limit=args.warm_limit)
+    if n_warm:
+        print(json.dumps({"warm_start": n_warm,
+                          "store": args.store}), flush=True)
 
     config_cache: dict = {}
     t0 = time.perf_counter()
@@ -251,8 +289,17 @@ def main(argv=None) -> int:
                 service.submit(job)
                 break
             except QueueFullError:
+                # drain through the dwell policy first (it runs a
+                # FULL class while an under-full head ages), forcing
+                # only when every class is under-full and held — the
+                # queue must shrink for the submit to retry
+                ran = False
                 for res in service.step():
+                    ran = True
                     emit(res)
+                if not ran:
+                    for res in service.step(force=True):
+                        emit(res)
             except (ResidencyBudgetError, TraceValidationError,
                     ValueError) as e:
                 failures += 1
@@ -260,7 +307,18 @@ def main(argv=None) -> int:
                                   "status": "rejected",
                                   "error": str(e)}))
                 break
-    for res in service.drain():
+        if args.max_dwell_s > 0:
+            # streaming dwell: run whatever the policy considers
+            # ready NOW (a full class, or a head past its window),
+            # holding under-full batches for later arrivals — the
+            # latency/occupancy dial acting mid-stream, not only at
+            # backpressure; with the default 0 the round-13
+            # submit-everything-then-drain flow is untouched
+            for res in service.step():
+                emit(res)
+    # input is exhausted: no job can ever fill an under-full batch, so
+    # force past any dwell hold instead of sleeping out the window
+    for res in service.drain(force=True):
         emit(res)
     counters = service.counters
     failures += counters["failed"]

@@ -1,0 +1,156 @@
+"""Program-store maintenance CLI: ls / verify / gc / evict.
+
+The operator's view of a fleet's shared AOT program store
+(`graphite_tpu/store/`): list what is cached (and how stale), audit
+integrity without quarantining, reclaim bytes, and drop entries by
+hand.
+
+Usage:
+  python -m graphite_tpu.tools.store --store DIR ls [--json]
+  python -m graphite_tpu.tools.store --store DIR verify [--json]
+  python -m graphite_tpu.tools.store --store DIR gc --max-bytes 2e9 \
+      [--purge-corrupt] [--json]
+  python -m graphite_tpu.tools.store --store DIR evict ENTRY_ID
+
+Exit codes: `verify` exits 1 when ANY entry fails its audit (including
+previously quarantined `.corrupt-*` dirs — a store that has seen
+corruption audits loudly until the wreckage is gc'd with
+`--purge-corrupt`); `evict` exits 1 when the entry does not exist;
+everything else exits 0 on success.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _age(now: float, then: float) -> str:
+    d = max(0.0, now - then)
+    for unit, width in (("d", 86400.0), ("h", 3600.0), ("m", 60.0)):
+        if d >= width:
+            return f"{d / width:.1f}{unit}"
+    return f"{d:.0f}s"
+
+
+def cmd_ls(store, args) -> int:
+    rows = store.entries(include_corrupt=True)
+    if args.json:
+        for r in rows:
+            man = r["manifest"] or {}
+            print(json.dumps({
+                "entry_id": r["entry_id"], "corrupt": r["corrupt"],
+                "bytes": r["bytes"], "last_used": r["last_used"],
+                "name": man.get("name"), "batch": man.get("batch"),
+                "max_quanta": man.get("max_quanta"),
+                "fingerprint": man.get("fingerprint"),
+                "env": man.get("env"),
+                "compile_s": man.get("compile_s"),
+            }))
+        return 0
+    now = time.time()
+    print(f"{'entry':42} {'name':34} {'B':>3} {'bytes':>12} "
+          f"{'used':>8} fingerprint")
+    for r in rows:
+        man = r["manifest"] or {}
+        tag = r["entry_id"]    # quarantined rows carry .corrupt-<n>
+        fp = (man.get("fingerprint") or "?")[:22]
+        name = (man.get("name") or
+                ("(corrupt)" if r["corrupt"] else "?"))[:34]
+        used = "-" if r["corrupt"] else _age(now, r["last_used"])
+        print(f"{tag:42} {name:34} {man.get('batch', '-'):>3} "
+              f"{r['bytes']:>12} {used:>8} {fp}")
+    s = store.stats()
+    print(f"{s['entries']} entr{'y' if s['entries'] == 1 else 'ies'}, "
+          f"{s['bytes']} bytes, {s['corrupt']} quarantined")
+    return 0
+
+
+def cmd_verify(store, args) -> int:
+    findings = store.verify()
+    bad = 0
+    for f in findings:
+        if args.json:
+            print(json.dumps(f))
+        else:
+            status = "PASS" if f["ok"] else f"FAIL ({f['reason']})"
+            print(f"{f['entry_id']:60} {status}")
+            if not f["ok"] and f["message"]:
+                print(f"    {f['message']}")
+        bad += 0 if f["ok"] else 1
+    if not args.json:
+        print(f"{len(findings)} entr{'y' if len(findings) == 1 else 'ies'}"
+              f", {bad} failure(s)")
+    return 1 if bad else 0
+
+
+def cmd_gc(store, args) -> int:
+    budget = int(args.max_bytes) if args.max_bytes is not None else None
+    if budget is not None and budget <= 0:
+        # the store layer reads 0 as "unbounded" (the constructor's
+        # no-budget convention) — an operator typing 0 means "empty
+        # it", which gc never does (the MRU entry always survives):
+        # refuse loudly instead of silently evicting nothing
+        print("error: --max-bytes must be positive (gc always keeps "
+              "the most-recently-used entry; --max-bytes 1 evicts "
+              "down to it, `evict ENTRY_ID` deletes by hand)",
+              file=sys.stderr)
+        return 2
+    evicted = store.gc(budget, include_corrupt=args.purge_corrupt)
+    out = {"evicted": evicted, "entries": store.stats()["entries"],
+           "bytes": store.total_bytes}
+    print(json.dumps(out) if args.json else
+          f"evicted {len(evicted)} entr"
+          f"{'y' if len(evicted) == 1 else 'ies'}; "
+          f"{out['entries']} remain ({out['bytes']} bytes)")
+    return 0
+
+
+def cmd_evict(store, args) -> int:
+    ok = store.evict(args.entry_id)
+    print(json.dumps({"evicted": args.entry_id, "ok": ok}))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="AOT program-store maintenance (ls / verify / gc / "
+        "evict)")
+    ap.add_argument("--store", required=True, metavar="DIR",
+                    help="the store directory (as passed to "
+                    "tools/serve.py --store)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON lines instead of the "
+                    "aligned table")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("ls", help="list entries (incl. quarantined)")
+    sub.add_parser("verify", help="audit every entry; exit 1 on any "
+                   "failure (non-quarantining)")
+    gc = sub.add_parser("gc", help="evict LRU entries to a byte budget")
+    gc.add_argument("--max-bytes", type=float, default=None,
+                    help="positive byte budget to evict down to "
+                    "(default: keep everything valid; the most-"
+                    "recently-used entry always survives)")
+    gc.add_argument("--purge-corrupt", action="store_true",
+                    help="also delete quarantined .corrupt-* dirs")
+    ev = sub.add_parser("evict", help="delete one entry by id")
+    ev.add_argument("entry_id")
+    args = ap.parse_args(argv)
+
+    import os
+
+    from graphite_tpu.store import ProgramStore
+
+    if not os.path.isdir(args.store):
+        print(f"error: --store {args.store!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    store = ProgramStore(args.store)
+    return {"ls": cmd_ls, "verify": cmd_verify, "gc": cmd_gc,
+            "evict": cmd_evict}[args.cmd](store, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
