@@ -299,6 +299,53 @@ scheme = lax
                 1000 * seq_warm_s / seq_iters, 4),
         })
 
+    # 2D batch x tile campaign layouts (round 18): warm ms/iter and
+    # bytes-per-device for solo vs 1D-batch vs 2D at one fixed
+    # geometry, plus the admission outcome for a sim that a 1-device
+    # budget rejects (accepted-as-2D across devices).  Runs in-process
+    # when >= 4 devices are visible; otherwise in a forced-4-device
+    # CPU subprocess (the fields are then CPU numbers, flagged by
+    # mesh2d_platform).  Skippable via BENCH_MESH2D=0.
+    if os.environ.get("BENCH_MESH2D", "1") != "0":
+        if len(jax.devices()) >= 4:
+            from graphite_tpu.tools.mesh2d_bench import measure_mesh2d
+
+            companions.update(measure_mesh2d())
+        else:
+            import subprocess as _sp
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=4").strip()
+            try:
+                proc = _sp.run(
+                    [sys.executable, "-m",
+                     "graphite_tpu.tools.mesh2d_bench"],
+                    capture_output=True, text=True, env=env,
+                    timeout=int(os.environ.get("BENCH_MESH2D_TIMEOUT",
+                                               "900")))
+                row = None
+                for line in reversed(
+                        proc.stdout.strip().splitlines()):
+                    try:
+                        cand = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(cand, dict):
+                        row = cand
+                        break
+                if row:
+                    row["mesh2d_platform"] = "cpu-forced-4"
+                    companions.update(row)
+                else:
+                    companions["mesh2d_error"] = (
+                        f"rc={proc.returncode}: "
+                        + proc.stderr.strip()[-200:])
+            except _sp.TimeoutExpired:
+                companions["mesh2d_error"] = "timeout"
+
     # Telemetry overhead (round 9, obs/ subsystem): warm per-iteration
     # cost of recording a DENSE device timeline (every available series,
     # S=256, sampled every barrier quantum — the worst case) vs

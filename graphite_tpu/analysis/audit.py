@@ -271,7 +271,8 @@ def spec_from_sweep(name: str, runner,
 
 
 DEFAULT_PROGRAM_NAMES = ("gated-msi", "ungated-msi", "shl2-mesi",
-                         "sweep-b4", "gated-msi-tel", "sweep-b4-tel")
+                         "sweep-b4", "gated-msi-tel", "sweep-b4-tel",
+                         "sweep-b4-2d")
 
 # cache/directory geometry chosen so the directory entry/sharers avals
 # are UNIQUE in the program (same trick as the phase-gating test) — a
@@ -318,13 +319,16 @@ def gated_msi_simulator(tiles: int = 8, extra_cfg: str = ""):
 
 def default_programs(tiles: int = 8, max_quanta: int = 4096,
                      names=None) -> "list[ProgramSpec]":
-    """The six audited shapes: gated, ungated, shl2, sweep B=4, the
+    """The seven audited shapes: gated, ungated, shl2, sweep B=4, the
     telemetry-recording gated engine (round 9: the ring's aval joins
     the cond-payload forbidden set; telemetry-OFF programs additionally
-    run the telemetry-off lint), and the COMBINED sweep-B=4 + telemetry
+    run the telemetry-off lint), the COMBINED sweep-B=4 + telemetry
     campaign (round 10: campaign timelines were previously only audited
     solo, so the [B, S, n_series] ring under vmap never met the
-    cond-payload or knob-fold lints — the composition is audited now).
+    cond-payload or knob-fold lints — the composition is audited now),
+    and the 2D batch x tile sweep campaign (round 18: the same B=4
+    sweep on a 2x2 Mesh(('batch','tile')) with the packed tile-axis
+    exchange, lowered over a device-less AbstractMesh).
 
     Small geometry on purpose — the lints are structural, so the
     8-tile lowering carries the same program shape the 1024-tile
@@ -366,7 +370,8 @@ def default_programs(tiles: int = 8, max_quanta: int = 4096,
         specs.append(spec_from_simulator("shl2-mesi", Simulator(
             sc_shl2, batch, phase_gate=True, mem_gate_bytes=0),
             max_quanta))
-    if "sweep-b4" in names or "sweep-b4-tel" in names:
+    if "sweep-b4" in names or "sweep-b4-tel" in names \
+            or "sweep-b4-2d" in names:
         # the sweep config splits the modules over TWO DVFS domains so
         # the sync_delay knob actually crosses a boundary — in a
         # single-domain config it is structurally inert (MemParams.
@@ -411,6 +416,15 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
             telemetry=TelemetrySpec(sample_interval_ps=1_000_000,
                                     n_samples=32))
         specs.append(spec_from_sweep("sweep-b4-tel", runner_tel,
+                                     max_quanta))
+    if "sweep-b4-2d" in names:
+        # the round-18 2D batch x tile campaign: the SAME B=4 sweep on
+        # a 2x2 Mesh(('batch','tile')) — each device one tile block of
+        # two sims, the packed per-phase exchange over the tile axis.
+        # Lowered via a device-less AbstractMesh (SweepRunner.lower),
+        # so the lints/cost/lock cover the composition on 1-device CI.
+        runner_2d = SweepRunner(sc_sweep, sweep_traces, layout=(2, 2))
+        specs.append(spec_from_sweep("sweep-b4-2d", runner_2d,
                                      max_quanta))
     return specs
 

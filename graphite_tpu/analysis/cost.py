@@ -127,6 +127,51 @@ def residency_breakdown(*, state=None, trace=None, batch: int = 1,
     return out
 
 
+def device_residency_breakdown(*, state=None, state_split=None,
+                               sims_per_shard: int = 1,
+                               tile_shards: int = 1,
+                               per_sim_trace_bytes: int = 0,
+                               telemetry_spec=None,
+                               profile_spec=None) -> "dict[str, int]":
+    """Itemized PER-DEVICE residency of one mesh cell under the round-18
+    2D batch x tile campaign layout: each device holds
+    `sims_per_shard` sims' tile blocks.
+
+    The split follows the shard_map sharding policy
+    (parallel/mesh._SHARD_MAP_LOCAL): the big per-tile arrays, the
+    trace rows and the per-tile profile ring hold 1/tile_shards of
+    their tile axis per device; the replicated control state and the
+    telemetry ring (scalar series, identical on every tile shard) are
+    held in full.  `tile_shards=1, sims_per_shard=B` reduces to the
+    whole-campaign bill, so one arithmetic serves solo, 1D and 2D
+    admission.  `state_split` (a precomputed
+    `parallel/mesh.shard_split_bytes` dict) substitutes for `state`
+    when the caller dropped the probe pytree and kept only the byte
+    counts (the admission controller's JobMeasure).  Returns consumer
+    -> bytes plus a "total" key — the same shape
+    `residency_breakdown` produces, so `format_breakdown` and the
+    refusal messages serve both."""
+    sims = int(sims_per_shard)
+    dt = max(int(tile_shards), 1)
+    out: "dict[str, int]" = {}
+    if state is not None and state_split is None:
+        from graphite_tpu.parallel.mesh import shard_split_bytes
+
+        state_split = shard_split_bytes(state)
+    if state_split is not None:
+        out["state"] = sims * (int(state_split["replicated"])
+                               + int(state_split["tile_local"]) // dt)
+    if per_sim_trace_bytes:
+        out["trace"] = sims * (int(per_sim_trace_bytes) // dt)
+    if telemetry_spec is not None:
+        out["telemetry"] = sims * int(telemetry_ring_bytes(telemetry_spec))
+    if profile_spec is not None:
+        out["profile"] = sims * int(profile_spec.ring_bytes(
+            tile_shards=dt))
+    out["total"] = sum(out.values())
+    return out
+
+
 def telemetry_ring_bytes(spec) -> int:
     """Per-sim bytes of a telemetry spec's device-resident state (ring +
     prev snapshot + cursors) — delegates to the spec's own accounting

@@ -520,7 +520,13 @@ class Simulator:
                         "consolidated base (base_consolidate=True): the "
                         "legacy per-phase directory view does not "
                         "overlay the staging rows before the shard_map "
-                        "exchange")
+                        "exchange.  Drop base_consolidate=False (the "
+                        "consolidated default shards the per-home-lane "
+                        "staging rows with the directory), or run the "
+                        "sim as a campaign under SweepRunner's 2D "
+                        "batch x tile layout (layout='tile'/'2d'), "
+                        "which composes the consolidated exchange with "
+                        "batching")
                 wpi = (5 if mem_params.dir_type == "limited_no_broadcast"
                        else 3)
                 # per-LANE capacity (round-12 layout): each home stages
@@ -830,9 +836,14 @@ class Simulator:
             raise ResidencyBudgetError(
                 "telemetry timelines support single-device resident runs "
                 "and batched sweeps only (the ring is not threaded "
-                "through the multi-chip exchange or the streaming window "
-                "loop; use the chunked StatisticsManager backend there); "
-                "refused residency: "
+                "through the Simulator's own multi-chip exchange or the "
+                "streaming window loop).  For a multi-device run, serve "
+                "the sim as a campaign under SweepRunner's 2D "
+                "batch x tile layout (layout='tile'/'2d'), which records "
+                "the ring replicated per batch cell and splits the "
+                "residency bill into per-device tile blocks — or use "
+                "the chunked StatisticsManager backend.  Refused "
+                "residency: "
                 + format_breakdown(self.residency_breakdown(spec)))
         self.telemetry_spec = spec
         self.state = self.state.replace(telemetry=init_telemetry(spec))
@@ -861,8 +872,13 @@ class Simulator:
             raise ResidencyBudgetError(
                 "per-tile profile rings support single-device resident "
                 "runs and batched sweeps only (the ring is not threaded "
-                "through the multi-chip exchange or the streaming "
-                "window loop); refused residency: "
+                "through the Simulator's own multi-chip exchange or the "
+                "streaming window loop).  For a multi-device run, serve "
+                "the sim as a campaign under SweepRunner's 2D "
+                "batch x tile layout (layout='tile'/'2d'): the "
+                "[S, T, m] ring's tile axis shards with the directory "
+                "and reassembles on fetch, so each device holds only "
+                "its tile block of the ring.  Refused residency: "
                 + format_breakdown(
                     self.residency_breakdown(profile_spec=spec)))
         self.profile_spec = spec

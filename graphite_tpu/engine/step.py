@@ -1290,8 +1290,10 @@ def run_simulation(
         if profile is not None:
             # same boundary arithmetic as the telemetry tick — with
             # equal intervals XLA CSEs the shared scalar reductions, so
-            # the two rings cost one boundary test per quantum
-            st2 = st2.replace(profile=profile_tick(profile, st2))
+            # the two rings cost one boundary test per quantum; under a
+            # tile-sharded px the [S, T, m] ring is block-local and the
+            # tick appends only this device's lanes (obs/profile.py)
+            st2 = st2.replace(profile=profile_tick(profile, st2, px=px))
         # Zero progress: if some non-done tile sits beyond qend (it crossed
         # the boundary executing one long record), jump the window up to it
         # — blocked peers may wait on its future sends.  Only when every

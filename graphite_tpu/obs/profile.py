@@ -176,17 +176,28 @@ class ProfileSpec:
         return ((int(self.n_samples), int(self.n_tiles), self.n_series),
                 "int64")
 
-    def ring_bytes(self) -> int:
+    def ring_bytes(self, tile_shards: int = 1) -> int:
         """Per-sim device residency of this spec's ProfileState: the
         [S, T, m] ring + the [T, m] prev snapshot + the [S] times ring
         + the two scalar cursors, all int64.  The ONE size model the
         residency budget and the admission bill consume
         (analysis/cost.residency_breakdown) — a campaign pays B x this,
         and the T factor is why a 1024-tile dense profile is priced,
-        not assumed."""
+        not assumed.
+
+        `tile_shards` (round 18): per-DEVICE bytes under a tile-sharded
+        2D campaign layout — the [S, T, m] ring and the [T, m] prev
+        snapshot shard their tile axis with the directory (each device
+        holds T/tile_shards rows), while the [S] times ring and the
+        cursors stay replicated."""
         (S, T, m), dtype = self.buffer_sig()
         item = np.dtype(dtype).itemsize
-        return (S * T * m + T * m + S + 2) * item
+        ts = max(int(tile_shards), 1)
+        if T % ts:
+            raise ValueError(
+                f"tile count {T} not divisible by tile_shards={ts}")
+        Tl = T // ts
+        return (S * Tl * m + Tl * m + S + 2) * item
 
     def delta_mask(self) -> np.ndarray:
         """bool[n_series]: True where the series records a delta."""
@@ -285,7 +296,7 @@ def _tile_series_values(spec: ProfileSpec, state) -> jax.Array:
     return jnp.stack([vals[s].astype(I64) for s in spec.series], axis=1)
 
 
-def profile_tick(spec: ProfileSpec, state) -> ProfileState:
+def profile_tick(spec: ProfileSpec, state, px=None) -> ProfileState:
     """One outer-loop quantum's profile update (device-side, traced).
 
     The boundary test is the SAME arithmetic as `telemetry_tick` —
@@ -297,6 +308,14 @@ def profile_tick(spec: ProfileSpec, state) -> ProfileState:
     [S, T, m] buffer must not ride any cond output (it joins the
     cond-payload forbidden set), and the row itself is a handful of
     [T]-lane reads — noise next to a quantum.
+
+    Under a tile-sharded `px` (the round-18 2D batch x tile campaign)
+    the ring's tile axis shards with the directory: `ps.buf` is this
+    device's [S, Tl, m] block and `ps.prev` its [Tl, m] snapshot, so
+    the full [T, m] row — computed from replicated carry state — is
+    sliced to the local lanes before the append (the cursors and the
+    [S] times ring stay replicated).  The reassembled-on-fetch ring is
+    bit-identical to the solo recording by construction.
     """
     ps = state.profile
     if ps is None:
@@ -311,6 +330,8 @@ def profile_tick(spec: ProfileSpec, state) -> ProfileState:
     sim_time = jnp.where(all_done, jnp.max(clocks), pending_min)
 
     cur = _tile_series_values(spec, state)                 # [T, m]
+    if px is not None and px.sharded:
+        cur = px.lo(cur)                                   # [Tl, m]
     do = (sim_time >= ps.next_ps) | all_done
     mask = jnp.asarray(spec.delta_mask())                  # [m]
     row = jnp.where(mask[None, :], cur - ps.prev, cur)

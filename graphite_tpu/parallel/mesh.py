@@ -249,6 +249,116 @@ def make_shard_map_runner(params, quantum_ps, max_quanta: int, mesh: Mesh,
     return jax.jit(sm)
 
 
+# --------------------------------------------------------------------------
+# The 2D batch x tile campaign layout (round 18).
+#
+# A Mesh(('batch', 'tile')) program: each device holds a TILE BLOCK of a
+# SUBSET of sims — the batch axis stays embarrassingly parallel (the
+# round-7 campaign semantics per cell) while the tile axis runs the
+# round-12 packed per-phase exchange (parallel/px.py: one working-set
+# gather + one merged scatter per iteration) WITHIN each batch cell.
+# This is Graphite's process striping (config.cc
+# computeProcessToTileMapping) crossed with campaign batching: one
+# compiled artifact serving pod-sized grids of sims too big for one
+# device's budget.  Specs follow the shard_map policy above — the big
+# per-tile arrays (_SHARD_MAP_LOCAL) are block-local on the tile axis,
+# control state is replicated per batch cell — plus the round-16
+# per-tile profile ring, whose [S, T, m] tile axis shards with the
+# directory (obs/profile.profile_tick slices the row to local lanes).
+
+BATCH_AXIS = "batch"
+TILE_AXIS_2D = "tile"
+
+# ProfileState leaves whose tile axis shards under the 2D layout, and
+# WHICH axis of the unbatched leaf it is (buf is [S, T, m]; prev is
+# [T, m]); the [S] times ring and the scalar cursors stay replicated.
+_PROFILE_TILE_AXES = {"profile.buf": 1, "profile.prev": 0}
+
+
+def make_batch_tile_mesh(batch_shards: int, tile_shards: int,
+                         devices=None, abstract: bool = False):
+    """A Mesh(('batch', 'tile')) over batch_shards x tile_shards
+    devices.  `abstract=True` returns a device-less AbstractMesh — the
+    tracing form `SweepRunner.lower()` uses so the 2D program can be
+    audited/fingerprinted on any host (including 1-device CI) without
+    the forced-device platform the execution mesh needs."""
+    db, dt = int(batch_shards), int(tile_shards)
+    if db < 1 or dt < 1:
+        raise ValueError(
+            f"mesh shards must be positive (got batch={db}, tile={dt})")
+    if abstract:
+        from jax.sharding import AbstractMesh
+
+        return AbstractMesh(((BATCH_AXIS, db), (TILE_AXIS_2D, dt)))
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < db * dt:
+        raise ValueError(
+            f"2D campaign layout needs {db}x{dt}={db * dt} devices but "
+            f"only {len(devices)} are visible — force more with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N on "
+            f"CPU, or shrink the layout")
+    return Mesh(np.asarray(devices[:db * dt]).reshape(db, dt),
+                (BATCH_AXIS, TILE_AXIS_2D))
+
+
+def campaign_state_specs(state: SimState):
+    """PartitionSpec tree for a BATCHED [B, ...] state under the 2D
+    layout, built from the UNBATCHED per-sim example: every leaf gains
+    a leading 'batch' axis; the big per-tile arrays additionally shard
+    their tile axis (the same _SHARD_MAP_LOCAL policy as the 1D
+    multi-chip runner); the profile ring's tile axis shards with them;
+    everything else — control vectors, sync tables, the telemetry ring
+    (scalar series, replicated-identical on every tile shard) — rides
+    the batch axis only."""
+
+    def spec(path, leaf):
+        name = _path_name(path)
+        if name in _SHARD_MAP_LOCAL:
+            return P(BATCH_AXIS, TILE_AXIS_2D,
+                     *([None] * (leaf.ndim - 1)))
+        t_axis = _PROFILE_TILE_AXES.get(name)
+        if t_axis is not None:
+            dims = [None] * leaf.ndim
+            dims[t_axis] = TILE_AXIS_2D
+            return P(BATCH_AXIS, *dims)
+        return P(BATCH_AXIS)
+
+    return jax.tree_util.tree_map_with_path(spec, state)
+
+
+def campaign_trace_specs(trace: DeviceTrace):
+    """Specs for the packed [B, T, L] campaign traces: each device
+    holds its batch cells' tile-block rows."""
+    return jax.tree.map(lambda leaf: P(BATCH_AXIS, TILE_AXIS_2D, None),
+                        trace)
+
+
+def shard_split_bytes(state: SimState) -> "dict[str, int]":
+    """Split one sim's state bytes into the 2D layout's residency
+    classes: {'tile_local': bytes of the _SHARD_MAP_LOCAL arrays (each
+    device holds 1/tile_shards of them), 'replicated': everything else
+    (every tile shard holds a full copy)}.  Telemetry/profile ring
+    leaves are excluded — they are priced separately through their
+    specs' own ring_bytes (the one size model)."""
+    from graphite_tpu.analysis.walk import aval_bytes
+
+    out = {"tile_local": 0, "replicated": 0}
+
+    def visit(path, leaf):
+        name = _path_name(path)
+        if name.startswith("telemetry.") or name.startswith("profile."):
+            return
+        b = aval_bytes(leaf)
+        if name in _SHARD_MAP_LOCAL:
+            out["tile_local"] += b
+        else:
+            out["replicated"] += b
+
+    jax.tree_util.tree_map_with_path(visit, state)
+    return out
+
+
 def shard_sim(
     state: SimState, trace: DeviceTrace, mesh: Mesh
 ) -> tuple[SimState, DeviceTrace]:

@@ -6,23 +6,36 @@ entirely from host-side arithmetic (no tracing, no compile):
  - *which program class* does it belong to?  Jobs co-batch only when
    they provably share one compiled program: same config digest, same
    tile count, same memory-ness, same telemetry spec, same per-tile
-   profile spec, and the same
+   profile spec, the same
    bucketed mailbox depth / trace length (lengths and depths round up
    to powers of two so successive batches share one [B, T, L] shape —
-   and therefore one program-cache entry);
+   and therefore one program-cache entry), and — round 18 — the same
+   DEVICE LAYOUT axis: a job served under the 2D batch x tile mesh
+   lowers a different program than a solo job, so 1D and 2D jobs never
+   co-batch (the layout tag is the key's last element);
 
  - *can it ever fit*?  The per-sim residency bill — state pytree +
    padded trace rows + telemetry ring, the exact consumers
    `analysis/cost.residency_breakdown` itemizes — is compared against
-   `hbm_budget_bytes`.  A job whose B=1 bill exceeds the budget can
-   never be admitted and is rejected IMMEDIATELY with the itemized
-   breakdown (`ResidencyBudgetError`, the round-10 refusal type);
+   `hbm_budget_bytes`.  A job whose B=1 bill exceeds ONE device's
+   budget is no longer bounced (round 18): with `n_devices` > 1 the
+   bill is split into per-device TILE BLOCKS
+   (`analysis/cost.device_residency_breakdown` — the big per-tile
+   arrays, trace rows and profile ring shard with the directory) and
+   the job is admitted under the smallest tile split whose per-device
+   block fits.  Only a job too big even when split over EVERY device
+   is rejected — immediately, with the itemized per-device breakdown
+   (`ResidencyBudgetError`, the round-10 refusal type);
 
  - *how many co-batch*?  Every campaign consumer scales linearly in B,
-   so the class's batch capacity is `budget // per_sim_total`, clamped
-   to the service's `batch_size`.  No admitted batch's
-   `residency_breakdown` total can exceed the budget by construction
-   (and the SweepRunner's own pre-compile fail-fast re-proves it).
+   so a solo class's batch capacity is `budget // per_sim_total`,
+   clamped to the service's `batch_size`.  A 2D class accounts
+   DEVICES x budget instead of one budget: with batch_shards
+   devices on the batch axis, capacity is `batch_shards x (budget //
+   per_device_block)` (then rounded to a batch_shards multiple so the
+   mesh divides evenly).  No admitted batch's per-device
+   residency can exceed the budget by construction (and the
+   SweepRunner's own pre-compile fail-fast re-proves it).
 
 Jobs that fit but not *now* wait in per-class FIFO queues under a
 global `max_pending` bound — when the queue is full, `admit` raises
@@ -65,71 +78,178 @@ class Pending:
     dwell_s: float = 0.0
 
 
+@dataclasses.dataclass
+class JobMeasure:
+    """One class's probe measurements: the engine params, resolved
+    ring specs, and the residency byte counts the layout planner and
+    the class capacity arithmetic both consume.  The probe Simulator
+    itself is dropped immediately (its state pytree is real device
+    memory — retaining one per class forever would be exactly the
+    residency the controller polices)."""
+
+    params: object
+    telemetry: object          # resolved TelemetrySpec | None
+    profile: object            # resolved ProfileSpec | None
+    pad_length: int
+    per_sim_bytes: "dict[str, int]"    # whole-sim consumers (dt=1)
+    state_replicated: int      # control state every tile shard holds
+    state_tile_local: int      # big per-tile arrays (shard with dt)
+
+    @property
+    def per_sim_total(self) -> int:
+        return sum(self.per_sim_bytes.values())
+
+    def device_block(self, tile_shards: int = 1,
+                     sims: int = 1) -> "dict[str, int]":
+        """Itemized PER-DEVICE bill of `sims` sims' tile blocks under
+        a `tile_shards`-way tile split — delegates to THE per-device
+        arithmetic (`analysis/cost.device_residency_breakdown`) with
+        the probe's retained byte counts, so the admission bill and
+        the runner's fail-fast can never desynchronize."""
+        from graphite_tpu.analysis.cost import device_residency_breakdown
+
+        return device_residency_breakdown(
+            state_split={"replicated": self.state_replicated,
+                         "tile_local": self.state_tile_local},
+            sims_per_shard=sims, tile_shards=tile_shards,
+            per_sim_trace_bytes=self.per_sim_bytes["trace"],
+            telemetry_spec=self.telemetry,
+            profile_spec=self.profile)
+
+
+def measure_job(job: Job, *, mailbox_depth: int,
+                pad_length: int) -> JobMeasure:
+    """Build the probe Simulator exactly the way the batch runner will
+    build its per-sim program (same config, same mailbox depth), read
+    the byte counts, drop the probe."""
+    from graphite_tpu.analysis.cost import trace_record_bytes, tree_bytes
+    from graphite_tpu.engine.simulator import Simulator
+    from graphite_tpu.parallel.mesh import shard_split_bytes
+
+    probe = Simulator(job.resolved_config(), job.trace,
+                      mailbox_depth=int(mailbox_depth),
+                      barrier_host=False)
+    params = probe.params
+    telemetry = (job.telemetry.resolve(params)
+                 if job.telemetry is not None else None)
+    # the per-tile profile ring joins the admission bill the same way
+    # (obs.ProfileSpec.ring_bytes — the one size model); its T factor
+    # is what makes a dense big-tile profile pay its way through the
+    # budget instead of OOMing a compiled batch
+    profile = (job.profile.resolve(params)
+               if job.profile is not None else None)
+    per_sim = {
+        "state": int(tree_bytes(probe.state)),
+        "trace": (params.n_tiles * int(pad_length)
+                  * trace_record_bytes(job.trace)),
+    }
+    if telemetry is not None:
+        per_sim["telemetry"] = int(telemetry.ring_bytes())
+    if profile is not None:
+        per_sim["profile"] = int(profile.ring_bytes())
+    split = shard_split_bytes(probe.state)
+    return JobMeasure(params=params, telemetry=telemetry,
+                      profile=profile, pad_length=int(pad_length),
+                      per_sim_bytes=per_sim,
+                      state_replicated=int(split["replicated"]),
+                      state_tile_local=int(split["tile_local"]))
+
+
+def plan_layout(measure: JobMeasure, *, hbm_budget_bytes: int,
+                batch_size: int, n_devices: int) -> dict:
+    """The class's device layout + batch capacity, from arithmetic the
+    measure already holds.
+
+    Solo (tag ('solo',)) when the budget is off or one sim fits one
+    device: capacity = budget // per_sim (the round-13 rule).  When a
+    sim alone exceeds the budget and devices exist, the smallest tile
+    split whose per-device block fits wins (tag ('2d', db, dt)):
+    batch_shards devices on the batch axis each run cap//db sims'
+    blocks, so capacity accounts DEVICES x budget.  Tag ('never',)
+    when even the maximal split exceeds the budget — the only
+    remaining rejection."""
+    budget = int(hbm_budget_bytes)
+    batch_size = int(batch_size)
+    n_dev = max(int(n_devices), 1)
+    if not budget:
+        return {"tag": ("solo",), "batch_shards": 1, "tile_shards": 1,
+                "batch_cap": batch_size}
+    if measure.per_sim_total <= budget:
+        return {"tag": ("solo",), "batch_shards": 1, "tile_shards": 1,
+                "batch_cap": min(batch_size,
+                                 budget // max(measure.per_sim_total,
+                                               1))}
+    T = int(measure.params.n_tiles)
+    best_bd = measure.device_block(1)
+    # any tile divisor up to the device count is a candidate — dt need
+    # not divide n_devices (the mesh simply uses db*dt of them; idle
+    # devices beat a rejection), smallest split that fits wins
+    for dt in range(2, n_dev + 1):
+        if T % dt:
+            continue
+        bd = measure.device_block(dt)
+        if bd["total"] < best_bd["total"]:
+            best_bd = bd
+        if bd["total"] > budget:
+            continue
+        cap_per_shard = budget // bd["total"]
+        db = n_dev // dt
+        cap = min(batch_size, db * cap_per_shard)
+        if cap < 1:
+            continue
+        if cap < db:
+            # fewer sims than batch shards: shrink the batch axis
+            db = cap
+        else:
+            cap -= cap % db
+        return {"tag": ("2d", db, dt), "batch_shards": db,
+                "tile_shards": dt, "batch_cap": cap}
+    return {"tag": ("never",), "batch_shards": 1, "tile_shards": 1,
+            "batch_cap": 0, "best_breakdown": best_bd}
+
+
 class JobClass:
     """One program class: jobs that provably share a compiled program.
 
     A probe Simulator is built once (never run) to read the engine
     params and the per-sim state bytes, then dropped; the class keeps
-    the per-sim residency bill, the batch capacity the budget allows,
-    and the class FIFO.
+    the per-sim residency bill, the device layout + batch capacity the
+    budget allows, and the class FIFO.
     """
 
     def __init__(self, key: tuple, job: Job, *, mailbox_depth: int,
-                 pad_length: int, hbm_budget_bytes: int, batch_size: int):
-        from graphite_tpu.analysis.cost import tree_bytes
-        from graphite_tpu.engine.simulator import Simulator
-
+                 pad_length: int, hbm_budget_bytes: int, batch_size: int,
+                 n_devices: int = 1, measure: "JobMeasure | None" = None):
         self.key = key
         self.config = job.resolved_config()
         self.mailbox_depth = int(mailbox_depth)
         self.pad_length = int(pad_length)
         self.fifo: "collections.deque[Pending]" = collections.deque()
-        # The probe: ONE Simulator built exactly the way the batch
-        # runner will build its per-sim program (same config, same
-        # mailbox depth), so its state pytree IS the per-sim state bill.
-        # Telemetry stays off the probe — the ring is priced separately
-        # (obs.TelemetrySpec.ring_bytes, the one size model).
-        from graphite_tpu.analysis.cost import trace_record_bytes
-
-        probe = Simulator(self.config, job.trace,
-                          mailbox_depth=self.mailbox_depth,
-                          barrier_host=False)
-        # keep only the params and the byte counts: the probe's state
-        # pytree is real device memory, and retaining one per class
-        # forever would be exactly the residency this controller
-        # exists to police
-        self.params = probe.params
-        self.telemetry = None
-        if job.telemetry is not None:
-            self.telemetry = job.telemetry.resolve(self.params)
-        # the per-tile profile ring joins the admission bill the same
-        # way (obs.ProfileSpec.ring_bytes — the one size model); its T
-        # factor is what makes a dense big-tile profile pay its way
-        # through the budget instead of OOMing a compiled batch
-        self.profile = None
-        if job.profile is not None:
-            self.profile = job.profile.resolve(self.params)
-        per_sim = {
-            "state": int(tree_bytes(probe.state)),
-            "trace": (self.params.n_tiles * self.pad_length
-                      * trace_record_bytes(job.trace)),
-        }
-        if self.telemetry is not None:
-            per_sim["telemetry"] = int(self.telemetry.ring_bytes())
-        if self.profile is not None:
-            per_sim["profile"] = int(self.profile.ring_bytes())
-        self.per_sim_bytes = per_sim
-        self.per_sim_total = sum(per_sim.values())
-        if hbm_budget_bytes:
-            self.batch_cap = min(
-                int(batch_size),
-                int(hbm_budget_bytes) // max(self.per_sim_total, 1))
-        else:
-            self.batch_cap = int(batch_size)
+        if measure is None:
+            measure = measure_job(job, mailbox_depth=self.mailbox_depth,
+                                  pad_length=self.pad_length)
+        self.measure = measure
+        self.params = measure.params
+        self.telemetry = measure.telemetry
+        self.profile = measure.profile
+        self.per_sim_bytes = dict(measure.per_sim_bytes)
+        self.per_sim_total = measure.per_sim_total
+        plan = plan_layout(measure, hbm_budget_bytes=hbm_budget_bytes,
+                           batch_size=batch_size, n_devices=n_devices)
+        self.layout_tag = plan["tag"]
+        self.batch_shards = int(plan["batch_shards"])
+        self.tile_shards = int(plan["tile_shards"])
+        self.batch_cap = int(plan["batch_cap"])
+        self.best_breakdown = plan.get("best_breakdown")
 
     @property
     def n_tiles(self) -> int:
         return int(self.params.n_tiles)
+
+    @property
+    def sharded(self) -> bool:
+        """True when this class runs under the 2D batch x tile mesh."""
+        return self.tile_shards > 1
 
     def breakdown(self, batch: int = 1) -> "dict[str, int]":
         """The itemized residency bill for a `batch`-wide campaign of
@@ -140,18 +260,38 @@ class JobClass:
         out["total"] = sum(out.values())
         return out
 
+    def device_breakdown(self, batch: "int | None" = None
+                         ) -> "dict[str, int]":
+        """The itemized PER-DEVICE bill of a `batch`-wide campaign
+        (default: the class capacity) under this class's layout — the
+        bill the 2D admission proves <= hbm_budget_bytes."""
+        batch = self.batch_cap if batch is None else int(batch)
+        db = max(self.batch_shards, 1)
+        sims = max((batch + db - 1) // db, 1) if batch else 0
+        return self.measure.device_block(self.tile_shards, sims=sims)
+
 
 class AdmissionController:
     """Classify, budget-check, and queue jobs; form FIFO-fair batches."""
 
     def __init__(self, *, hbm_budget_bytes: int = 0, batch_size: int = 4,
-                 max_pending: int = 1024):
+                 max_pending: int = 1024, n_devices: int = 1):
         if int(batch_size) < 1:
             raise ValueError("batch_size must be >= 1")
         self.hbm_budget_bytes = int(hbm_budget_bytes)
         self.batch_size = int(batch_size)
         self.max_pending = int(max_pending)
+        # round 18: devices the service may spread a class over — a
+        # per-sim bill above ONE device's budget bin-packs ACROSS them
+        # (the 2D batch x tile layout) instead of bouncing.  Default 1
+        # keeps the round-13 single-device admission bit-identically.
+        self.n_devices = max(int(n_devices), 1)
         self.classes: "dict[tuple, JobClass]" = {}
+        # probe measurements + layout plans memoized per BASE key (the
+        # key minus its layout element): the layout axis is derived
+        # from the measurement, and re-probing per submit would build a
+        # device-state pytree per job
+        self._measures: "dict[tuple, JobMeasure]" = {}
         # pre-formed batches (split/retry requeues) served before any
         # new batch forms — without this, a split's halves would simply
         # re-coalesce into the failing batch on the next pop
@@ -187,8 +327,27 @@ class AdmissionController:
         prof_key = None if prof is None else (
             int(prof.sample_interval_ps), int(prof.n_samples),
             prof.series, prof.energy_prices)
-        return (config_digest(job.resolved_config()), job.n_tiles,
+        base = (config_digest(job.resolved_config()), job.n_tiles,
                 job.has_mem_trace(), depth, length, tel_key, prof_key)
+        # round 18: the DEVICE LAYOUT axis.  A 2D batch x tile class
+        # lowers a different program than a solo class (the shard_map
+        # mesh, specs and exchange are part of the artifact), so the
+        # layout tag joins the key and 1D/2D jobs never co-batch.  The
+        # tag is derived from the probe measurement (memoized per base
+        # key) + the controller's budget/device arithmetic.
+        return base + (self._layout_tag(base, job, depth, length),)
+
+    def _layout_tag(self, base: tuple, job: Job, mailbox_depth: int,
+                    pad_length: int) -> tuple:
+        measure = self._measures.get(base)
+        if measure is None:
+            measure = measure_job(job, mailbox_depth=mailbox_depth,
+                                  pad_length=pad_length)
+            self._measures[base] = measure
+        return plan_layout(measure,
+                           hbm_budget_bytes=self.hbm_budget_bytes,
+                           batch_size=self.batch_size,
+                           n_devices=self.n_devices)["tag"]
 
     def admit(self, job: Job) -> "tuple[JobClass, Pending]":
         """Queue `job` (validated by the caller) or refuse it.
@@ -212,16 +371,30 @@ class AdmissionController:
             cls = JobClass(key, job,
                            mailbox_depth=key[3], pad_length=key[4],
                            hbm_budget_bytes=self.hbm_budget_bytes,
-                           batch_size=self.batch_size)
+                           batch_size=self.batch_size,
+                           n_devices=self.n_devices,
+                           measure=self._measures.get(key[:-1]))
             self.classes[key] = cls
         if self.hbm_budget_bytes and cls.batch_cap < 1:
             bd = cls.breakdown(1)
+            if self.n_devices > 1:
+                best = cls.best_breakdown or bd
+                extra = (
+                    f" — at the best tile split the {self.n_devices} "
+                    f"device(s) allow, one per-device block still costs "
+                    + format_breakdown(best)
+                    + "; shrink the trace/telemetry ring, raise the "
+                    "budget, or add devices")
+            else:
+                extra = (
+                    " — shrink the trace/telemetry ring, raise the "
+                    "budget, or give the service devices to bin-pack "
+                    "across (n_devices > 1 admits it under the 2D "
+                    "batch x tile layout)")
             err = ResidencyBudgetError(
                 f"job {job.job_id!r} can never fit hbm_budget_bytes="
                 f"{self.hbm_budget_bytes}: one sim alone costs "
-                + format_breakdown(bd)
-                + " — shrink the trace/telemetry ring or raise the "
-                "budget")
+                + format_breakdown(bd) + extra)
             err.breakdown = bd
             raise err
         pending = Pending(job=job, seq=self._seq)

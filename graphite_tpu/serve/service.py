@@ -84,6 +84,9 @@ class BatchReport:
     # round 17: the in-memory miss was served by the persistent AOT
     # program store (deserialize, not compile)
     store_hit: bool = False
+    # round 18: the device layout the batch ran under ("solo",
+    # "1d-batch(d=N)", "2d(b=DB,t=DT)", ...)
+    layout: str = "solo"
 
 
 class CampaignService:
@@ -91,7 +94,12 @@ class CampaignService:
 
     `hbm_budget_bytes`: per-device admission budget (0 = off);
     `batch_size`: max sims per campaign batch (the class capacity is
-    `min(batch_size, budget // per_sim_bytes)`); `cache_bytes`: program
+    `min(batch_size, budget // per_sim_bytes)`); `n_devices` (round
+    18): devices admission may bin-pack a too-big-for-one-device sim
+    across — such jobs are served under the 2D batch x tile mesh
+    layout (per-device tile blocks proven <= the budget) instead of
+    rejected; "auto" reads the visible device count, the default 1
+    keeps round-13 admission exactly; `cache_bytes`: program
     cache budget for byte-accounted LRU eviction (0 = unbounded);
     `max_pending`: queue depth before submit raises backpressure;
     `max_attempts`: per-job failure budget across splits/retries;
@@ -131,6 +139,7 @@ class CampaignService:
                  max_attempts: int = 3, max_quanta: int = 1_000_000,
                  verify_hits: bool = False, validate: bool = True,
                  shard_batch: "bool | None" = False,
+                 n_devices: "int | str" = 1,
                  max_history: int = 4096,
                  tracing: "bool | Tracer" = False,
                  clock=None,
@@ -138,9 +147,29 @@ class CampaignService:
                  max_dwell_s: float = 0.0):
         import collections
 
+        # round 18: devices the admission controller may bin-pack a
+        # too-big-for-one-device sim across (the 2D batch x tile
+        # layout).  "auto" reads the visible device count; the default
+        # 1 keeps round-13 single-device admission bit-identically.
+        import jax
+
+        if n_devices == "auto":
+            n_devices = len(jax.devices())
+        self.n_devices = max(int(n_devices), 1)
+        if self.n_devices > len(jax.devices()):
+            # fail at construction, not mid-drain: a 2D class planned
+            # for more devices than exist would otherwise crash the
+            # serve loop at execute (mesh construction), stranding
+            # admitted jobs without terminal envelopes
+            raise ValueError(
+                f"n_devices={self.n_devices} exceeds the "
+                f"{len(jax.devices())} visible device(s) — the service "
+                "executes locally; force more host devices with "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                "on CPU, or pass 'auto'")
         self.admission = AdmissionController(
             hbm_budget_bytes=hbm_budget_bytes, batch_size=batch_size,
-            max_pending=max_pending)
+            max_pending=max_pending, n_devices=self.n_devices)
         self.cache = ProgramCache(cache_bytes)
         self.registry: "dict[str, object]" = {}   # name -> ProgramRecord
         self.hbm_budget_bytes = int(hbm_budget_bytes)
@@ -178,6 +207,7 @@ class CampaignService:
         self._last_residency = 0
         self._last_cache_hit = False
         self._last_compile_s = 0.0
+        self._last_layout = "solo"
         # persistent AOT program store (round 17): the in-memory
         # cache's miss/fill backend — a fleet of service processes
         # sharing one store dir compiles each class once per FLEET
@@ -482,7 +512,8 @@ class CampaignService:
             occupancy=occupancy,
             residency_total=self._last_residency,
             cache_hit=self._last_cache_hit,
-            store_hit=self._last_store_hit, ok=True, wall_s=wall))
+            store_hit=self._last_store_hit, ok=True, wall_s=wall,
+            layout=self._last_layout))
         if self.tracer is not None:
             self.tracer.record(
                 btid, "batch", t0, t0 + wall,
@@ -530,6 +561,7 @@ class CampaignService:
             "compile_s": round(self._last_compile_s, 6),
             "deserialize_s": round(self._last_deserialize_s, 6),
             "residency_bytes": self._last_residency,
+            "layout": self._last_layout,
             "jobs": [p.job.job_id for p in pendings],
             "ok": ok,
         }
@@ -551,7 +583,8 @@ class CampaignService:
             residency_total=self._last_residency,
             cache_hit=self._last_cache_hit,
             store_hit=self._last_store_hit,
-            ok=False, wall_s=wall, error=msg))
+            ok=False, wall_s=wall, error=msg,
+            layout=self._last_layout))
         if self.tracer is not None:
             # the span covers the REAL execute window (t0, t0+wall) —
             # clock reads after it (metrics sampling) must not shift it
@@ -606,13 +639,19 @@ class CampaignService:
         digest = cls.key[0][:8]
         tel = "-tel" if cls.telemetry is not None else ""
         tel += "-prof" if cls.profile is not None else ""
+        # round 18: 2D classes carry their mesh in the name — the
+        # layout tag is in the key (injective hash below), but a
+        # readable "-2d2x2" names the program a human greps for
+        mesh = (f"-2d{cls.batch_shards}x{cls.tile_shards}"
+                if getattr(cls, "tile_shards", 1) > 1 else "")
         # the key hash keeps the name INJECTIVE over class keys: the
         # readable fields alone miss key components (mem-ness,
         # telemetry spec details), and two distinct classes colliding
         # on one registry name would read as an identity violation
         khash = hashlib.sha256(repr(cls.key).encode()).hexdigest()[:8]
         return (f"serve-{digest}-t{cls.n_tiles}-b{cls.batch_cap}"
-                f"-l{cls.pad_length}-d{cls.mailbox_depth}{tel}-k{khash}")
+                f"-l{cls.pad_length}-d{cls.mailbox_depth}{tel}{mesh}"
+                f"-k{khash}")
 
     def _execute(self, cls: JobClass, pendings: "list[Pending]",
                  batch_id: int) -> "list[JobResult]":
@@ -632,6 +671,7 @@ class CampaignService:
         self._last_compile_s = 0.0
         self._last_store_hit = False
         self._last_deserialize_s = 0.0
+        self._last_layout = "solo"
         # pad to the class's FIXED capacity with replicas of job 0 so
         # every batch of this class shares one [B, T, L] program shape;
         # the replicas' rows are dropped below (the tail mask)
@@ -644,22 +684,34 @@ class CampaignService:
         # the runner's fail-fast (None would fall back to the config's
         # own `[general] hbm_budget_bytes`, refusing batches the
         # service-level admission never checked against)
+        # round 18: a 2D class runs the Mesh(('batch','tile')) program
+        # its admission plan sized — the layout is part of the class
+        # key, so every batch of the class lowers the same artifact
+        if getattr(cls, "tile_shards", 1) > 1:
+            layout_kw = {"layout": (cls.batch_shards, cls.tile_shards)}
+        else:
+            layout_kw = {"shard_batch": self.shard_batch}
         runner = SweepRunner(
             cls.config, pack, points,
             mailbox_depth=cls.mailbox_depth,
-            shard_batch=self.shard_batch,
             hbm_budget_bytes=self.hbm_budget_bytes,
             telemetry=cls.telemetry,
-            profile=cls.profile)
+            profile=cls.profile, **layout_kw)
+        self._last_layout = runner.layout_name
         self._last_residency = int(
             runner.residency_breakdown()["total"])
+        # the budget is PER DEVICE: a 2D batch's whole-campaign bill
+        # legitimately exceeds it — its per-device tile blocks may not
+        admitted = (int(runner.device_breakdown()["total"])
+                    if getattr(cls, "tile_shards", 1) > 1
+                    else self._last_residency)
         if self.hbm_budget_bytes \
-                and self._last_residency > self.hbm_budget_bytes:
+                and admitted > self.hbm_budget_bytes:
             # unreachable by construction (admission sized batch_cap
             # from the same arithmetic and the runner's own fail-fast
             # already re-checked) — a trip here is a real bug, not load
             raise AssertionError(
-                f"admitted batch residency {self._last_residency} "
+                f"admitted batch per-device residency {admitted} "
                 f"exceeds hbm_budget_bytes={self.hbm_budget_bytes}")
         with self._span(btid, "cache") as cspan:
             entry = self._resolve_program(cls, runner, B)

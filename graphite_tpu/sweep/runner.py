@@ -60,6 +60,10 @@ class SweepOutcome:
     # quantum in the program, so reporting the knob would claim a value
     # that never entered it
     quantum_valid: bool = True
+    # the device layout the campaign actually ran under (round 18):
+    # "solo", "1d-batch(d=N)", "1d-tile(t=N)", or "2d(b=DB,t=DT)" —
+    # reported per row so a result line names the program that made it
+    layout: str = "solo"
 
     def json_rows(self) -> "list[dict]":
         """One JSON-able dict per sim (the CLI's output lines)."""
@@ -73,6 +77,7 @@ class SweepOutcome:
                 **({"seed": int(self.seeds[b])}
                    if self.seeds is not None else {}),
                 **point,
+                "layout": self.layout,
                 "completion_time_ns": r.completion_time_ps // 1000,
                 "total_instructions": r.total_instructions,
                 "n_quanta": int(self.n_quanta[b]),
@@ -80,6 +85,10 @@ class SweepOutcome:
                 "func_errors": r.func_errors,
             })
         return rows
+
+
+def _divisors(n: int) -> "list[int]":
+    return [d for d in range(1, int(n) + 1) if int(n) % d == 0]
 
 
 class SweepRunner:
@@ -98,29 +107,55 @@ class SweepRunner:
     PER SIM ([B, S, T, m] total), demuxed into `SweepOutcome.profiles`
     / each result's `.profile` — under both vmap and batch shard_map.
 
-    Two batching programs, chosen by `shard_batch`:
-     - `vmap` over the sim axis (the default on one device): one
-       program, B-wide arrays.  vmap converts the engine's activity-
-       gating lax.conds into both-branch selects, so this program runs
-       UNGATED by default (gating is mechanism, not policy — results are
-       bit-identical either way; pass phase_gate=True to override).
-     - batch-axis `shard_map` when several devices are visible and B
-       divides evenly: each device runs B/ndev sims; with one sim per
-       device the per-device program is the plain UNBATCHED engine —
-       real lax.cond gating stays alive and sims run in parallel across
-       devices (host cores on the virtual CPU platform, chips on a TPU
-       slice).  `shard_batch=False` forces plain vmap.
+    Four batching programs, chosen by `layout` (or the legacy
+    `shard_batch` kwarg):
+     - "solo": `vmap` over the sim axis (the default on one device):
+       one program, B-wide arrays.  vmap converts the engine's
+       activity-gating lax.conds into both-branch selects, so this
+       program runs UNGATED by default (gating is mechanism, not policy
+       — results are bit-identical either way; pass phase_gate=True to
+       override).
+     - "batch" (legacy `shard_batch=True`): batch-axis `shard_map` when
+       several devices are visible and B divides evenly: each device
+       runs B/ndev sims; with one sim per device the per-device program
+       is the plain UNBATCHED engine — real lax.cond gating stays alive
+       and sims run in parallel across devices.
+     - "tile" / "2d" / an explicit `(batch_shards, tile_shards)` tuple:
+       the round-18 `Mesh(('batch', 'tile'))` program — each device
+       holds a TILE BLOCK of a SUBSET of sims.  The big per-tile arrays
+       (cache meta, the directory + its staging rows, trace rows, the
+       per-tile profile ring) are block-local on the tile axis and the
+       round-12 packed per-phase exchange (one working-set gather + one
+       merged scatter per iteration, parallel/px.py) runs over the tile
+       axis only; batch cells never communicate.  This is the layout
+       for sims whose per-sim residency bill exceeds ONE device's
+       `hbm_budget_bytes`: the bill splits into per-device tile blocks
+       (`device_breakdown()`).  Results are bit-identical to solo runs
+       (regress rung 12).
+
+    `layout=None` picks automatically from `residency_breakdown` + the
+    device count: a campaign whose PER-SIM bill exceeds the per-device
+    budget shards the tile axis (smallest tile_shards that fits, batch
+    shards filling the remaining devices); otherwise the legacy choice
+    (batch-axis shard_map when B divides the device count, else solo).
+    The chosen layout is reported in `json_rows` ("layout" column) and
+    `SweepOutcome.layout`, and `lower()` lowers the REAL composition
+    (via a device-less AbstractMesh) so the audit lints, cost model and
+    identity lock cover the 2D program on any host.
 
     `hbm_budget_bytes` (else `[general] hbm_budget_bytes`, 0 = off)
     arms the pre-compile residency fail-fast: the campaign's estimated
     footprint (B x state + resident traces + telemetry rings) above the
     budget raises `analysis.cost.ResidencyBudgetError` — with the
-    per-consumer breakdown — before any tracing starts.
+    per-consumer breakdown — before any tracing starts.  Under a
+    tile-sharded layout the check is PER DEVICE (`device_breakdown`),
+    which is exactly what lets a too-big-for-one-device sim run.
     """
 
     def __init__(self, config, traces, points: "list[dict] | None" = None,
                  *, mailbox_depth: "int | None" = None,
                  shard_batch: "bool | None" = None,
+                 layout=None,
                  hbm_budget_bytes: "int | None" = None, **sim_kwargs):
         from graphite_tpu.engine.simulator import Simulator, \
             auto_mailbox_depth
@@ -165,25 +200,30 @@ class SweepRunner:
             mailbox_depth = max(auto_mailbox_depth(pack.sim(b))
                                 for b in range(B))
 
-        # batch-axis sharding layout: K sims per device (see class doc)
+        # device layout: solo vmap, batch-axis shard_map, or the 2D
+        # batch x tile mesh (see class doc)
         n_dev = len(jax.devices())
-        if shard_batch is None:
-            shard_batch = n_dev > 1 and B % n_dev == 0
-        if shard_batch and (n_dev <= 1 or B % n_dev != 0):
+        if layout is not None and shard_batch is not None:
             raise ValueError(
-                f"shard_batch needs B ({B}) divisible by the device "
-                f"count ({n_dev})")
-        self.shard_batch = bool(shard_batch)
-        self._sims_per_dev = B // n_dev if self.shard_batch else B
-        if self._sims_per_dev > 1 and has_mem[0]:
-            # the per-device program is vmapped: its gating conds become
-            # both-branch selects, so default them OFF (bit-identical
-            # results, measured faster; explicit kwargs win)
-            sim_kwargs.setdefault("phase_gate", False)
-            sim_kwargs.setdefault("mem_gate_bytes", 0)
-        self.sim = Simulator(config, pack.sim(0),
-                             mailbox_depth=mailbox_depth,
-                             barrier_host=False, **sim_kwargs)
+                "pass layout= OR the legacy shard_batch=, not both "
+                "(shard_batch=True is layout='batch', False is 'solo')")
+        if layout is None and shard_batch is not None:
+            layout = "batch" if shard_batch else "solo"
+        auto = layout is None
+        self._n_dev = n_dev
+        if auto:
+            # legacy auto guess; a budget-driven promotion to the 2D
+            # layout happens below, once the sim's state bytes exist
+            layout = ("batch" if n_dev > 1 and B % n_dev == 0
+                      else "solo")
+        layout = self._normalize_layout(layout, B, n_dev)
+        self._user_gating = {
+            k: sim_kwargs[k] for k in ("phase_gate", "mem_gate_bytes")
+            if k in sim_kwargs}
+        self._sim_ctor = (config, pack.sim(0), mailbox_depth,
+                          dict(sim_kwargs))
+        self._has_mem = bool(has_mem[0])
+        self.sim = self._build_sim(layout)
         self.mailbox_depth = mailbox_depth
         base = Knobs.from_params(self.sim.params,
                                  self.sim.quantum_ps)
@@ -228,21 +268,234 @@ class SweepRunner:
             hbm_budget_bytes = self.sim.config.cfg.get_int(
                 "general/hbm_budget_bytes", 0)
         self.hbm_budget_bytes = int(hbm_budget_bytes)
+        # Budget-driven layout promotion (round 18): a per-sim bill too
+        # big for ONE device's budget is not a refusal anymore — shard
+        # the tile axis (the smallest tile_shards whose per-device
+        # block fits), batch shards filling the remaining devices.
+        if auto and self.hbm_budget_bytes and n_dev > 1 \
+                and not isinstance(layout, tuple):
+            per_sim = self._per_sim_bill()
+            if per_sim > self.hbm_budget_bytes:
+                promoted = self._auto_mesh_layout(
+                    B, pack.n_tiles, n_dev,
+                    budget=self.hbm_budget_bytes)
+                if promoted is not None:
+                    old_vmapped = self._sims_per_cell(layout) > 1
+                    layout = promoted
+                    if (self._sims_per_cell(layout) > 1) != old_vmapped \
+                            and self._has_mem and not self._user_gating:
+                        # the gating defaults follow the per-cell
+                        # program shape (vmapped cells run ungated);
+                        # rebuild the wrapped sim so the executed and
+                        # certified program agree
+                        self.sim = self._build_sim(layout)
+                        self._sim_lower_gen = self.sim.lower_gen
+        self.layout_spec = layout
+        self.shard_batch = layout == "batch"
+        self._sims_per_dev = self._sims_per_cell(layout)
+        self.layout_name = self._layout_name(layout)
         if self.hbm_budget_bytes:
             from graphite_tpu.analysis.cost import (
                 ResidencyBudgetError, format_breakdown,
             )
 
-            breakdown = self.residency_breakdown()
-            if breakdown["total"] > self.hbm_budget_bytes:
-                raise ResidencyBudgetError(
-                    f"campaign residency exceeds hbm_budget_bytes="
-                    f"{self.hbm_budget_bytes} before compile (B="
-                    f"{self.pack.n_sims}): "
-                    + format_breakdown(breakdown)
-                    + " — shrink the batch, stream fewer consumers "
-                    "(drop telemetry or shorten traces), or raise "
-                    "`[general] hbm_budget_bytes`")
+            if isinstance(layout, tuple):
+                # tile-sharded layouts budget PER DEVICE: each device
+                # holds (B/db) sims' tile blocks, which is exactly what
+                # lets a too-big-for-one-device sim run at all
+                bd = self.device_breakdown()
+                if bd["total"] > self.hbm_budget_bytes:
+                    raise ResidencyBudgetError(
+                        f"per-device residency of the "
+                        f"{self.layout_name} campaign layout exceeds "
+                        f"hbm_budget_bytes={self.hbm_budget_bytes} (B="
+                        f"{self.pack.n_sims}): "
+                        + format_breakdown(bd)
+                        + " per device — raise tile_shards, shrink the "
+                        "batch, or raise `[general] hbm_budget_bytes`")
+            else:
+                breakdown = self.residency_breakdown()
+                if breakdown["total"] > self.hbm_budget_bytes:
+                    raise ResidencyBudgetError(
+                        f"campaign residency exceeds hbm_budget_bytes="
+                        f"{self.hbm_budget_bytes} before compile (B="
+                        f"{self.pack.n_sims}): "
+                        + format_breakdown(breakdown)
+                        + " — shrink the batch, stream fewer consumers "
+                        "(drop telemetry or shorten traces), raise "
+                        "`[general] hbm_budget_bytes`, or shard the "
+                        "mesh both ways (layout='2d' / layout=(batch_"
+                        "shards, tile_shards): the 2D batch x tile "
+                        "layout splits the bill into per-device tile "
+                        "blocks)")
+
+    # -- device layouts (round 18) ---------------------------------------
+
+    def _normalize_layout(self, layout, B: int, n_dev: int):
+        """Normalize a layout request to "solo" | "batch" | (db, dt)."""
+        T = self.pack.n_tiles
+        if isinstance(layout, str):
+            name = layout.lower().replace("_", "-")
+            if name == "solo":
+                return "solo"
+            if name in ("batch", "1d-batch"):
+                if n_dev <= 1 or B % n_dev != 0:
+                    raise ValueError(
+                        f"layout 'batch' needs B ({B}) divisible by "
+                        f"the device count ({n_dev})")
+                return "batch"
+            if name in ("tile", "1d-tile"):
+                if n_dev <= 1:
+                    raise ValueError(
+                        "layout 'tile' needs more than one device "
+                        "(force some with XLA_FLAGS=--xla_force_host_"
+                        "platform_device_count=N on CPU)")
+                return self._check_mesh_layout((1, n_dev), B, T)
+            if name == "2d":
+                got = self._auto_mesh_layout(B, T, n_dev, budget=None)
+                if got is None:
+                    raise ValueError(
+                        f"no 2D layout fits: {n_dev} device(s), tile "
+                        f"count {T}, B={B} — need a >1 tile divisor of "
+                        "the device count (pass an explicit (batch_"
+                        "shards, tile_shards) tuple to override)")
+                return got
+            raise ValueError(
+                f"unknown layout {layout!r} (choose 'solo', 'batch', "
+                "'tile', '2d', or an explicit (batch_shards, "
+                "tile_shards) tuple)")
+        if isinstance(layout, (tuple, list)) and len(layout) == 2:
+            return self._check_mesh_layout(
+                (int(layout[0]), int(layout[1])), B,
+                self.pack.n_tiles)
+        raise ValueError(
+            f"unknown layout {layout!r} (choose 'solo', 'batch', "
+            "'tile', '2d', or an explicit (batch_shards, tile_shards) "
+            "tuple)")
+
+    def _check_mesh_layout(self, layout, B: int, T: int):
+        """Validate an explicit (db, dt) mesh layout.  Device
+        availability is deliberately NOT checked here: lowering (audit,
+        fingerprint, lock) uses a device-less AbstractMesh, so a 2D
+        program is auditable on a 1-device host; `_get_runner` checks
+        the real devices at execution time."""
+        db, dt = layout
+        if db < 1 or dt < 1:
+            raise ValueError(
+                f"layout shards must be positive (got {layout})")
+        if B % db:
+            raise ValueError(
+                f"layout batch_shards={db} must divide B ({B})")
+        if T % dt:
+            raise ValueError(
+                f"layout tile_shards={dt} must divide the tile count "
+                f"({T})")
+        return (db, dt)
+
+    def _auto_mesh_layout(self, B: int, T: int, n_dev: int, *,
+                          budget: "int | None"):
+        """Pick a (db, dt) mesh layout.  With a `budget`, the smallest
+        tile_shards whose per-device block fits, batch shards filling
+        the remaining devices (largest divisor of B that fits); with
+        budget=None (an explicit '2d' request), the smallest >1 tile
+        split the geometry allows.  None when nothing fits."""
+        # any tile divisor up to the device count is a candidate — dt
+        # need not divide n_dev (the mesh uses db*dt of the devices;
+        # idle devices beat a refusal), smallest split that fits wins
+        for dt in range(2, n_dev + 1):
+            if T % dt:
+                continue
+            db_max = n_dev // dt
+            if budget is None:
+                db = max(d for d in _divisors(B) if d <= db_max)
+                return (db, dt)
+            block = self._per_sim_bill(tile_shards=dt)
+            cap = budget // max(block, 1)
+            if cap < 1 or block > budget:
+                continue
+            db = max(d for d in _divisors(B) if d <= db_max)
+            if B // db <= cap:
+                return (db, dt)
+        return None
+
+    def _sims_per_cell(self, layout) -> int:
+        B = self.pack.n_sims
+        if layout == "batch":
+            return B // self._n_dev_hint()
+        if isinstance(layout, tuple):
+            return B // layout[0]
+        return B
+
+    def _n_dev_hint(self) -> int:
+        n = getattr(self, "_n_dev", None)
+        return n if n else len(jax.devices())
+
+    def _layout_name(self, layout) -> str:
+        if layout == "solo":
+            return "solo"
+        if layout == "batch":
+            return f"1d-batch(d={self._n_dev_hint()})"
+        db, dt = layout
+        if db == 1:
+            return f"1d-tile(t={dt})"
+        return f"2d(b={db},t={dt})"
+
+    def _build_sim(self, layout):
+        from graphite_tpu.engine.simulator import Simulator
+
+        config, trace0, mbd, kwargs = self._sim_ctor
+        kwargs = dict(kwargs)
+        if self._sims_per_cell(layout) > 1 and self._has_mem:
+            # the per-cell program is vmapped: its gating conds become
+            # both-branch selects, so default them OFF (bit-identical
+            # results, measured faster; explicit kwargs win)
+            kwargs.setdefault("phase_gate", False)
+            kwargs.setdefault("mem_gate_bytes", 0)
+        return Simulator(config, trace0, mailbox_depth=mbd,
+                         barrier_host=False, **kwargs)
+
+    def _per_sim_bill(self, tile_shards: int = 1) -> int:
+        """ONE sim's residency bill — whole (tile_shards=1) or its
+        per-device tile block under a tile-sharded layout."""
+        return self._device_bd(sims_per_shard=1,
+                               tile_shards=tile_shards)["total"]
+
+    def _device_bd(self, *, sims_per_shard: int,
+                   tile_shards: int) -> "dict[str, int]":
+        from graphite_tpu.analysis.cost import (
+            device_residency_breakdown, trace_record_bytes,
+        )
+
+        state = self.sim.state
+        if state.telemetry is not None:
+            state = state.replace(telemetry=None)
+        if state.profile is not None:
+            state = state.replace(profile=None)
+        per_sim_trace = (self.pack.n_tiles * self.pack.length
+                         * trace_record_bytes(self.pack.sim(0)))
+        return device_residency_breakdown(
+            state=state, sims_per_shard=sims_per_shard,
+            tile_shards=tile_shards,
+            per_sim_trace_bytes=per_sim_trace,
+            telemetry_spec=self.sim.telemetry_spec,
+            profile_spec=self.sim.profile_spec)
+
+    def device_breakdown(self) -> "dict[str, int]":
+        """Per-DEVICE itemized residency of the chosen layout: each
+        device holds (B / batch_shards) sims' tile blocks — the
+        replicated control state in full, 1/tile_shards of the big
+        per-tile arrays, trace rows and profile ring (the telemetry
+        ring's scalar rows are replicated).  For solo this equals
+        `residency_breakdown` modulo the packed-trace padding; for the
+        batch layout it is the per-device share."""
+        if isinstance(self.layout_spec, tuple):
+            db, dt = self.layout_spec
+        elif self.layout_spec == "batch":
+            db, dt = self._n_dev_hint(), 1
+        else:
+            db, dt = 1, 1
+        return self._device_bd(sims_per_shard=self.pack.n_sims // db,
+                               tile_shards=dt)
 
     def residency_breakdown(self) -> "dict[str, int]":
         """Per-consumer HBM estimate of this campaign's resident layout
@@ -271,10 +524,13 @@ class SweepRunner:
     def n_sims(self) -> int:
         return self.pack.n_sims
 
-    def _runner_fn(self, max_quanta: int):
+    def _runner_fn(self, max_quanta: int, abstract: bool = False):
         """The (unjitted) batched campaign function — `_get_runner`
         jits it; `lower()` hands it to `jax.make_jaxpr` for the
-        program auditor."""
+        program auditor.  `abstract=True` (lowering only) builds any
+        mesh layout over a device-less AbstractMesh, so the 2D program
+        is auditable/fingerprintable on hosts without the forced
+        device platform."""
         from graphite_tpu.engine.step import run_simulation
 
         params = self.sim.params
@@ -282,10 +538,50 @@ class SweepRunner:
         tel = self.sim.telemetry_spec
         prof = self.sim.profile_spec
 
-        def one(state, trace, kn):
+        def one(state, trace, kn, px=None):
             q = None if unbounded else kn.quantum_ps
+            kw = {} if px is None else {"px": px}
             return run_simulation(params, trace, state, q, max_quanta,
-                                  knobs=kn, telemetry=tel, profile=prof)
+                                  knobs=kn, telemetry=tel, profile=prof,
+                                  **kw)
+
+        if isinstance(self.layout_spec, tuple):
+            # the 2D batch x tile mesh: each device holds a tile block
+            # of a subset of sims; the packed per-phase exchange runs
+            # over the tile axis only (parallel/mesh.py round 18)
+            from jax.sharding import PartitionSpec as P
+
+            from graphite_tpu.parallel.mesh import (
+                TILE_AXIS_2D, _shard_map, campaign_state_specs,
+                campaign_trace_specs, make_batch_tile_mesh,
+            )
+            from graphite_tpu.parallel.px import ParallelCtx
+
+            db, dt = self.layout_spec
+            px = ParallelCtx(axis=TILE_AXIS_2D, n_dev=dt)
+            mesh = make_batch_tile_mesh(db, dt, abstract=abstract)
+            state_specs = campaign_state_specs(self.sim.state)
+            trace_specs = campaign_trace_specs(self.sim.device_trace)
+            knob_specs = jax.tree.map(lambda _: P("batch"), self.knobs)
+            Bl = self.pack.n_sims // db
+
+            def per_cell(state, trace, kn):
+                if Bl == 1:
+                    # one sim's tile blocks per batch cell: strip the
+                    # [1] batch dim and run the plain engine under the
+                    # tile exchange — real lax.cond gating stays alive
+                    sq = jax.tree_util.tree_map
+                    out = one(*(sq(lambda x: x[0], t)
+                                for t in (state, trace, kn)), px)
+                    return sq(lambda x: x[None], out)
+                return jax.vmap(lambda s, t, k: one(s, t, k, px))(
+                    state, trace, kn)
+
+            return _shard_map(
+                per_cell, mesh=mesh,
+                in_specs=(state_specs, trace_specs, knob_specs),
+                out_specs=(state_specs, P("batch"), P("batch"),
+                           P("batch")))
 
         if not self.shard_batch:
             return jax.vmap(one)
@@ -375,7 +671,8 @@ class SweepRunner:
             f: jax.ShapeDtypeStruct(getattr(self.pack, f).shape,
                                     getattr(self.pack, f).dtype)
             for f in PackedTraces._TRACE_FIELDS})
-        closed = jax.make_jaxpr(self._runner_fn(max_quanta))(
+        closed = jax.make_jaxpr(self._runner_fn(max_quanta,
+                                                abstract=True))(
             states_abs, dtr_abs, self.knobs)
         self.lower_count += 1
         hit = (closed, invar_path_strings((states_abs, dtr_abs,
@@ -468,4 +765,5 @@ class SweepRunner:
                             seeds=self.pack.seeds,
                             quantum_valid=self.sim.quantum_ps is not None,
                             timelines=timelines,
-                            profiles=profiles)
+                            profiles=profiles,
+                            layout=self.layout_name)

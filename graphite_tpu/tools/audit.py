@@ -2,8 +2,10 @@
 
 Lowers the default config set — the per-phase-GATED private-L2 engine,
 the UNGATED one, the shared-L2 engine, the B=4 vmapped sweep campaign,
-the telemetry-recording gated engine, and the combined sweep+telemetry
-campaign — and runs every jaxpr invariant lint (analysis/rules.py) over
+the telemetry-recording gated engine, the combined sweep+telemetry
+campaign, and the 2D batch x tile campaign (round 18, lowered over a
+device-less AbstractMesh) — and runs every jaxpr invariant lint
+(analysis/rules.py) over
 each: cond-payload (with the telemetry/profile ring avals in the
 forbidden set for recording programs), knob-fold, time-dtype,
 vmap-gate, host-sync, telemetry-off, profile-off.  Each program's STATIC COST report (analysis/cost.py —
@@ -71,7 +73,7 @@ def main(argv=None) -> int:
                     help="exit nonzero on warnings too (e.g. vmap-gate)")
     ap.add_argument("--programs", default=None,
                     help="comma-separated subset of program names "
-                    "(default: all six)")
+                    "(default: all seven)")
     ap.add_argument("--budget", action="store_true",
                     help="gate each cost report against BUDGETS.json "
                     "ceilings (exit nonzero on any excess)")

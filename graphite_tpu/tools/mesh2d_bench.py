@@ -1,0 +1,114 @@
+"""BENCH_MESH2D companion: solo vs 1D vs 2D campaign layouts.
+
+Measures, at one fixed geometry, the warm per-iteration wall cost and
+the PER-DEVICE residency bill of the three campaign layouts (solo vmap,
+1D batch-axis shard_map, the round-18 2D batch x tile mesh), plus the
+admission outcome for a sim whose per-sim bill exceeds one device's
+budget: a 1-device admission controller rejects it, a multi-device one
+admits it as a 2D class.  Emits ONE JSON line (the bench.py contract);
+bench.py merges the fields into the round artifact, running this module
+in-process when >= 4 devices are visible and as a forced-4-device CPU
+subprocess otherwise.
+
+Usage: python -m graphite_tpu.tools.mesh2d_bench
+Needs >= 4 devices (force on CPU with
+XLA_FLAGS=--xla_force_host_platform_device_count=4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def measure_mesh2d() -> dict:
+    import jax
+
+    import graphite_tpu  # noqa: F401  (x64)
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.analysis.cost import ResidencyBudgetError
+    from graphite_tpu.serve.admission import (
+        AdmissionController, measure_job,
+    )
+    from graphite_tpu.serve.job import Job
+    from graphite_tpu.sweep import SweepRunner
+    from graphite_tpu.tools._template import config_text
+    from graphite_tpu.trace import synthetic
+
+    n_dev = len(jax.devices())
+    if n_dev < 4:
+        return {"mesh2d_error": f"needs >= 4 devices, have {n_dev}"}
+    tiles = int(os.environ.get("BENCH_MESH2D_TILES", "16"))
+    # B = the device count so every layout uses the whole platform
+    # (solo runs them all on one device — that contrast IS the point)
+    B = n_dev
+    sc = SimConfig(ConfigFile.from_string(config_text(
+        tiles, shared_mem=True, clock_scheme="lax_barrier")))
+    traces = [
+        synthetic.memory_stress_trace(
+            tiles, n_accesses=24, working_set_bytes=1 << 13,
+            write_fraction=0.4, shared_fraction=0.5, seed=s)
+        for s in range(1, B + 1)
+    ]
+    # gating forced off uniformly: the three layouts then lower the
+    # same per-sim engine shape and the wall contrast is the layout's
+    gate_kw = dict(phase_gate=False, mem_gate_bytes=0)
+
+    def timed(layout):
+        r = SweepRunner(sc, traces, layout=layout, **gate_kw)
+        r.run(max_quanta=200_000)            # compile + first run
+        t0 = time.perf_counter()
+        out = r.run(max_quanta=200_000)      # warm steady state
+        wall = time.perf_counter() - t0
+        iters = max(int(out.n_iterations.sum()), 1)
+        return (round(1000 * wall / iters, 4),
+                int(r.device_breakdown()["total"]), out.layout)
+
+    ms_solo, dev_solo, _ = timed("solo")
+    ms_1d, dev_1d, name_1d = timed("batch")
+    ms_2d, dev_2d, name_2d = timed((B // 2, 2))
+
+    # admission outcome: a sim too big for ONE device's budget
+    job = Job("mesh2d-big", sc, traces[0], seed=1)
+    m = measure_job(job, mailbox_depth=8, pad_length=64)
+    budget = (m.per_sim_total + m.device_block(2)["total"]) // 2
+    try:
+        AdmissionController(hbm_budget_bytes=budget, batch_size=4,
+                            n_devices=1).admit(job)
+        adm_1dev = "accepted"  # should not happen — the bench flags it
+    except ResidencyBudgetError:
+        adm_1dev = "rejected"
+    cls, _ = AdmissionController(
+        hbm_budget_bytes=budget, batch_size=4,
+        n_devices=n_dev).admit(job)
+    adm_nd = (f"accepted-2d(b={cls.batch_shards},t={cls.tile_shards})"
+              if cls.tile_shards > 1 else "accepted-1d")
+    return {
+        "mesh2d_devices": n_dev,
+        "mesh2d_tiles": tiles,
+        "mesh2d_batch": B,
+        "mesh2d_ms_per_iter_solo": ms_solo,
+        "mesh2d_ms_per_iter_1d": ms_1d,
+        "mesh2d_ms_per_iter_2d": ms_2d,
+        "mesh2d_bytes_per_device_solo": dev_solo,
+        "mesh2d_bytes_per_device_1d": dev_1d,
+        "mesh2d_bytes_per_device_2d": dev_2d,
+        "mesh2d_layout_1d": name_1d,
+        "mesh2d_layout_2d": name_2d,
+        "mesh2d_admission_budget": int(budget),
+        "mesh2d_big_sim_bytes": int(m.per_sim_total),
+        "mesh2d_admission_1dev": adm_1dev,
+        "mesh2d_admission": adm_nd,
+    }
+
+
+def main() -> int:
+    out = measure_mesh2d()
+    print(json.dumps(out))
+    return 1 if "mesh2d_error" in out else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,9 +25,14 @@ must pass on the lowered default programs, every default program's
 static cost report must sit within the checked-in BUDGETS.json
 ceilings (the round-10 budget gate — kernel proxy, bytes/iter, peak
 residency; tools/audit.py --budget-update refreshes after an
-intentional change), and every default program's canonical fingerprint
+intentional change), every default program's canonical fingerprint
 must match its registered identity in PROGRAMS.lock (the round-11
-identity gate — tools/audit.py --lock-update re-registers).
+identity gate — tools/audit.py --lock-update re-registers), and the
+round-18 2D batch x tile campaign must be bit-identical — results,
+timelines, per-tile profile rings — to the 1D batch layout and to
+sequential solo runs on forced host devices, with the admission
+controller bin-packing a too-big-for-one-device sim across devices
+(rung 12; standalone via --smoke-mesh2d).
 """
 
 from __future__ import annotations
@@ -549,8 +554,159 @@ def smoke(tiles: int = 16) -> int:
     finally:
         _sh.rmtree(store_dir, ignore_errors=True)
 
+    # 12) 2D batch x tile campaigns (round 18): the Mesh(('batch',
+    #     'tile')) program on forced host devices must be bit-identical
+    #     — results, demuxed timelines AND per-tile profile rings — to
+    #     the 1D batch-axis layout and to sequential solo runs on the
+    #     same job set, and the admission controller must bin-pack a
+    #     sim too big for one device's budget ACROSS devices (admitted
+    #     as 2D, per-device block <= budget) where a 1-device service
+    #     rejects it.  Needs >= 4 devices: run in-process when the
+    #     platform has them, else re-exec this rung under
+    #     XLA_FLAGS=--xla_force_host_platform_device_count=4.
+    import jax as _jax
+
+    if len(_jax.devices()) >= 4:
+        failures += smoke_mesh2d(tiles)
+    else:
+        import os as _os
+        import subprocess as _sp
+
+        env = dict(_os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
+        rc = _sp.call([sys.executable, "-m",
+                       "graphite_tpu.tools.regress", "--smoke-mesh2d",
+                       "--tiles", str(tiles)], env=env)
+        print(f"{'mesh2d rung (forced 4-device subprocess)':44} "
+              f"{'PASS' if rc == 0 else 'FAIL'}")
+        failures += 0 if rc == 0 else 1
+
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
     return 1 if failures else 0
+
+
+def smoke_mesh2d(tiles: int = 16) -> int:
+    """Regress rung 12 (round 18): 2D batch x tile campaign equality +
+    across-device admission, on >= 4 (forced host) devices."""
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.engine.simulator import Simulator
+    from graphite_tpu.obs import ProfileSpec, TelemetrySpec
+    from graphite_tpu.serve import CampaignService, Job
+    from graphite_tpu.sweep import SweepRunner
+    from graphite_tpu.trace import synthetic
+
+    t0 = _t.perf_counter()
+    failures = 0
+    n_dev = len(jax.devices())
+    if n_dev < 4:
+        print(f"{'mesh2d rung':44} FAIL  (needs >= 4 devices, have "
+              f"{n_dev})")
+        return 1
+    # every tile count this rung uses must split 2 ways
+    tiles = tiles if tiles % 2 == 0 else 16
+    sc = SimConfig(ConfigFile.from_string(config_text(
+        tiles, shared_mem=True, clock_scheme="lax_barrier")))
+    seeds = (1, 2, 3, 4)
+    traces = [
+        synthetic.memory_stress_trace(
+            tiles, n_accesses=24, working_set_bytes=1 << 13,
+            write_fraction=0.4, shared_fraction=0.5, seed=s)
+        for s in seeds
+    ]
+    tel = TelemetrySpec(sample_interval_ps=1_000_000, n_samples=64)
+    prof = ProfileSpec(sample_interval_ps=1_000_000, n_samples=64)
+    # gating forced OFF uniformly so the 2D (vmapped cells), 1D-batch
+    # (one gated sim per device) and solo programs record identical
+    # skip_* telemetry columns — gating is mechanism, results are
+    # bit-identical either way (rung 1)
+    gate_kw = dict(phase_gate=False, mem_gate_bytes=0)
+
+    r2d = SweepRunner(sc, traces, layout=(2, 2), telemetry=tel,
+                      profile=prof, **gate_kw)
+    out2d = r2d.run(max_quanta=200_000)
+    r1d = SweepRunner(sc, traces, layout="batch", telemetry=tel,
+                      profile=prof, **gate_kw)
+    out1d = r1d.run(max_quanta=200_000)
+    print(f"{'mesh2d layouts':44} 2d={out2d.layout} 1d={out1d.layout}")
+    for b, s in enumerate(seeds):
+        solo = Simulator(sc, traces[b], mailbox_depth=r2d.mailbox_depth,
+                         telemetry=tel, profile=prof, **gate_kw).run()
+        failures += _compare(
+            f"2D campaign sim {b} (seed {s}) vs solo",
+            out2d.results[b], solo)
+        failures += _compare(
+            f"2D campaign sim {b} vs 1D-batch",
+            out2d.results[b], out1d.results[b])
+        tl, pf = out2d.timelines[b], out2d.profiles[b]
+        ok = (tl.n_total == solo.telemetry.n_total
+              and np.array_equal(tl.data, solo.telemetry.data))
+        print(f"{f'2D sim {b} timeline demux vs solo':44} "
+              f"{'PASS' if ok else 'FAIL'}")
+        failures += 0 if ok else 1
+        ok = (pf.n_total == solo.profile.n_total
+              and np.array_equal(pf.data, solo.profile.data)
+              and np.array_equal(pf.times_ps, solo.profile.times_ps))
+        print(f"{f'2D sim {b} profile ring demux vs solo':44} "
+              f"{'PASS' if ok else 'FAIL'}")
+        failures += 0 if ok else 1
+        ok = (out1d.timelines[b].n_total == tl.n_total
+              and np.array_equal(out1d.timelines[b].data, tl.data)
+              and np.array_equal(out1d.profiles[b].data, pf.data))
+        print(f"{f'2D sim {b} rings vs 1D-batch':44} "
+              f"{'PASS' if ok else 'FAIL'}")
+        failures += 0 if ok else 1
+
+    # across-device admission: a sim whose per-sim bill exceeds one
+    # device's budget is REJECTED by a 1-device service and ADMITTED
+    # as a 2D class (per-device block proven <= budget) by one that
+    # may bin-pack across devices — results still bit-equal to solo
+    from graphite_tpu.analysis.cost import ResidencyBudgetError
+    from graphite_tpu.serve.admission import measure_job
+
+    sc_big = SimConfig(ConfigFile.from_string(config_text(
+        tiles, shared_mem=True, clock_scheme="lax")))
+    big_jobs = [Job(f"big-{i}", sc_big, traces[i], seed=seeds[i])
+                for i in range(2)]
+    m = measure_job(big_jobs[0], mailbox_depth=8, pad_length=64)
+    budget = (m.per_sim_total + m.device_block(2)["total"]) // 2
+    try:
+        CampaignService(batch_size=2, max_quanta=200_000,
+                        hbm_budget_bytes=budget).submit(big_jobs[0])
+        print(f"{'1-device service rejects the big sim':44} FAIL")
+        failures += 1
+    except ResidencyBudgetError:
+        print(f"{'1-device service rejects the big sim':44} PASS")
+    svc = CampaignService(batch_size=2, max_quanta=200_000,
+                          hbm_budget_bytes=budget, n_devices="auto")
+    for j in big_jobs:
+        svc.submit(j)
+    served = {r.job_id: r for r in svc.drain()}
+    cls = next(iter(svc.admission.classes.values()))
+    ok = (cls.tile_shards > 1
+          and cls.device_breakdown()["total"] <= budget
+          and all(served[j.job_id].status == "ok" for j in big_jobs))
+    print(f"{'big sim admitted as 2D, per-device <= budget':44} "
+          f"{'PASS' if ok else 'FAIL'}"
+          + ("" if ok else f"  (tile_shards={cls.tile_shards} "
+             f"per_dev={cls.device_breakdown()['total']} "
+             f"budget={budget})"))
+    failures += 0 if ok else 1
+    for j in big_jobs:
+        seq = Simulator(sc_big, j.trace, **gate_kw).run()
+        failures += _compare(f"2D-served {j.job_id} vs sequential",
+                             served[j.job_id].results, seq)
+
+    print(f"mesh2d: {failures} failure(s)  "
+          f"({_t.perf_counter() - t0:.0f}s)")
+    return failures
 
 
 def main() -> int:
@@ -562,7 +718,16 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="fast tier-1 companion: 16-tile gated/ungated "
                     "pair + batched-barrier equivalence on CPU")
+    ap.add_argument("--smoke-mesh2d", action="store_true",
+                    help="rung 12 alone: 2D batch x tile campaign "
+                    "equality + across-device admission (needs >= 4 "
+                    "devices; --smoke re-execs this under a forced "
+                    "4-device host platform when needed)")
     args = ap.parse_args()
+
+    if args.smoke_mesh2d:
+        return 1 if smoke_mesh2d(args.tiles if args.tiles != 8
+                                 else 16) else 0
 
     if args.smoke:
         return smoke(args.tiles if args.tiles != 8 else 16)

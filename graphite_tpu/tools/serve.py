@@ -168,6 +168,12 @@ def main(argv=None) -> int:
                     help="program-cache eviction budget (0 = unbounded)")
     ap.add_argument("--max-pending", type=int, default=1024)
     ap.add_argument("--max-quanta", type=int, default=1_000_000)
+    ap.add_argument("--n-devices", default="1",
+                    help="devices admission may bin-pack a too-big-"
+                    "for-one-device sim across (the 2D batch x tile "
+                    "layout); an integer or 'auto' (visible device "
+                    "count).  Default 1 = round-13 single-device "
+                    "admission")
     ap.add_argument("--verify-hits", action="store_true",
                     help="re-lower every cache hit and re-prove "
                     "fingerprint equality (retrace, never recompile)")
@@ -244,6 +250,13 @@ def main(argv=None) -> int:
         if args.jobs:
             fh.close()
 
+    if args.n_devices != "auto":
+        try:
+            args.n_devices = int(args.n_devices)
+        except ValueError:
+            raise SystemExit(
+                f"--n-devices must be an integer or 'auto' "
+                f"(got {args.n_devices!r})")
     service = CampaignService(
         hbm_budget_bytes=int(args.budget_bytes),
         batch_size=args.batch_size,
@@ -251,6 +264,7 @@ def main(argv=None) -> int:
         max_pending=args.max_pending,
         max_quanta=args.max_quanta,
         verify_hits=args.verify_hits,
+        n_devices=args.n_devices,
         tracing=bool(args.trace_out),
         store=args.store,
         max_dwell_s=args.max_dwell_s)

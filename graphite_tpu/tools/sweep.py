@@ -60,6 +60,10 @@ def main(argv=None) -> int:
     ap.add_argument("--protocol", default="pr_l1_pr_l2_dram_directory_msi")
     ap.add_argument("--network", default="emesh_hop_counter")
     ap.add_argument("--max-quanta", type=int, default=1_000_000)
+    ap.add_argument("--layout", default=None,
+                    help="device layout: solo | batch | tile | 2d | "
+                    "DBxDT (e.g. 2x2 — batch_shards x tile_shards; "
+                    "default: auto from residency + device count)")
     ap.add_argument("--dryrun", action="store_true",
                     help="CPU smoke: force JAX_PLATFORMS=cpu, shrink the "
                     "workload, cap the grid at 4 points")
@@ -121,7 +125,16 @@ def main(argv=None) -> int:
             points.append(p)
             meta.append(s)
 
-    runner = SweepRunner(sc, pack_traces(traces, seeds=meta), points)
+    layout = args.layout
+    if layout and "x" in layout:
+        try:
+            db, dt = (int(v) for v in layout.split("x"))
+        except ValueError:
+            raise SystemExit(
+                f"bad --layout {layout!r}: DBxDT needs two integers")
+        layout = (db, dt)
+    runner = SweepRunner(sc, pack_traces(traces, seeds=meta), points,
+                         layout=layout)
     t0 = time.perf_counter()
     out = runner.run(max_quanta=args.max_quanta)
     elapsed = time.perf_counter() - t0
@@ -131,6 +144,7 @@ def main(argv=None) -> int:
     print(json.dumps({
         "summary": True,
         "sweep_batch": runner.n_sims,
+        "layout": out.layout,
         "wall_s": round(elapsed, 3),
         "sims_per_s": round(runner.n_sims / elapsed, 3),
         # amortized per-sim cost of one engine iteration: campaign wall

@@ -511,20 +511,23 @@ def test_scatter_determinism_const_tables_and_row_axis_limits():
 
 def test_audit_default_programs_clean():
     """The acceptance gate: gated, ungated, shl2, sweep B=4, the
-    telemetry-recording gated engine AND the combined sweep+telemetry
-    campaign all pass every rule — the same call
-    `tools/regress.py --smoke` and `python -m graphite_tpu.tools.audit`
-    make."""
+    telemetry-recording gated engine, the combined sweep+telemetry
+    campaign AND the 2D batch x tile campaign (round 18) all pass
+    every rule — the same call `tools/regress.py --smoke` and
+    `python -m graphite_tpu.tools.audit` make."""
     report = audit(tiles=8)
     assert {r.program for r in report.results} == {
         "gated-msi", "ungated-msi", "shl2-mesi", "sweep-b4",
-        "gated-msi-tel", "sweep-b4-tel"}
+        "gated-msi-tel", "sweep-b4-tel", "sweep-b4-2d"}
     # the sweep programs must get the knob-fold rule, the others not
     by_prog = {}
     for r in report.results:
         by_prog.setdefault(r.program, set()).add(r.rule)
     assert "knob-fold" in by_prog["sweep-b4"]
     assert "knob-fold" in by_prog["sweep-b4-tel"]
+    # the 2D campaign's knobs must stay live THROUGH the shard_map
+    # call boundary — knob-fold runs (and passes) on the composition
+    assert "knob-fold" in by_prog["sweep-b4-2d"]
     assert "knob-fold" not in by_prog["gated-msi"]
     # the combined campaign records telemetry, so the telemetry-off
     # lint must NOT run on it (the ring is policed via cond-payload)

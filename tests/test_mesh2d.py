@@ -1,0 +1,307 @@
+"""2D batch x tile campaigns (round 18).
+
+The Mesh(('batch', 'tile')) program: each device holds a tile block of
+a subset of sims, the round-12 packed per-phase exchange runs over the
+tile axis only, batch stays embarrassingly parallel.  Pinned here:
+layout selection (device counts x residency bills -> chosen layout),
+2D-vs-solo bit-equality for the gated-MSI and shl2-MESI engines,
+admission class keys splitting on the layout axis, and the per-device
+residency arithmetic the across-device bin-packing proves against the
+budget.  Runs on the conftest's forced 8-device CPU platform.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigFile, SimConfig
+from graphite_tpu.engine.simulator import Simulator
+from graphite_tpu.sweep import SweepRunner
+from graphite_tpu.tools._template import config_text
+from graphite_tpu.trace import synthetic
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+MSI = None   # default protocol from config_text(shared_mem=True)
+SHL2_MESI = "pr_l1_sh_l2_mesi"
+
+
+def _cfg(tiles=8, protocol=None, scheme="lax_barrier"):
+    kw = {} if protocol is None else {"protocol": protocol}
+    return SimConfig(ConfigFile.from_string(config_text(
+        tiles, shared_mem=True, clock_scheme=scheme, **kw)))
+
+
+def _traces(tiles, n, accesses=16):
+    return [synthetic.memory_stress_trace(
+        tiles, n_accesses=accesses, working_set_bytes=1 << 12,
+        write_fraction=0.4, shared_fraction=0.5, seed=s)
+        for s in range(1, n + 1)]
+
+
+def _assert_equal(res_a, res_b, msg=""):
+    np.testing.assert_array_equal(
+        np.asarray(res_a.clock_ps), np.asarray(res_b.clock_ps),
+        err_msg=f"clocks diverge {msg}")
+    np.testing.assert_array_equal(
+        np.asarray(res_a.instruction_count),
+        np.asarray(res_b.instruction_count))
+    if res_a.mem_counters is not None:
+        for k, v in res_a.mem_counters.items():
+            np.testing.assert_array_equal(
+                np.asarray(v), np.asarray(res_b.mem_counters[k]),
+                err_msg=f"mem counter {k} diverges {msg}")
+
+
+# ---- bit-equality ----------------------------------------------------------
+
+
+def test_2d_gated_msi_matches_solo():
+    # Bl=1 cells keep the REAL per-phase lax.cond gating alive inside
+    # each batch cell's tile exchange — the strongest engine shape
+    sc = _cfg(8)
+    traces = _traces(8, 2)
+    r = SweepRunner(sc, traces, layout=(2, 2), phase_gate=True,
+                    mem_gate_bytes=0)
+    assert r.layout_name == "2d(b=2,t=2)"
+    out = r.run(max_quanta=200_000)
+    assert out.layout == "2d(b=2,t=2)"
+    for b in range(2):
+        solo = Simulator(sc, traces[b], mailbox_depth=r.mailbox_depth,
+                         phase_gate=True, mem_gate_bytes=0).run()
+        _assert_equal(out.results[b], solo, f"(2D gated sim {b})")
+        # vacuity guard: real coherence traffic crossed the tile shards
+        assert int(np.asarray(
+            solo.mem_counters["l2_misses"]).sum()) > 0
+
+
+def test_2d_shl2_mesi_matches_solo():
+    sc = _cfg(8, protocol=SHL2_MESI)
+    traces = _traces(8, 2)
+    r = SweepRunner(sc, traces, layout=(2, 2), phase_gate=True,
+                    mem_gate_bytes=0)
+    out = r.run(max_quanta=200_000)
+    for b in range(2):
+        solo = Simulator(sc, traces[b], mailbox_depth=r.mailbox_depth,
+                         phase_gate=True, mem_gate_bytes=0).run()
+        _assert_equal(out.results[b], solo, f"(2D shl2 sim {b})")
+
+
+def test_2d_vmapped_cells_match_solo():
+    # Bl=2: batch cells vmap the px-sharded engine (batched collectives)
+    sc = _cfg(8)
+    traces = _traces(8, 4)
+    r = SweepRunner(sc, traces, layout=(2, 2))
+    assert r._sims_per_dev == 2
+    out = r.run(max_quanta=200_000)
+    for b in range(4):
+        solo = Simulator(sc, traces[b], mailbox_depth=r.mailbox_depth,
+                         phase_gate=False, mem_gate_bytes=0).run()
+        _assert_equal(out.results[b], solo, f"(2D Bl=2 sim {b})")
+
+
+# ---- layout selection ------------------------------------------------------
+
+
+def test_layout_selection_matrix():
+    # device counts x residency bills -> chosen layout, via the same
+    # arithmetic SweepRunner's auto promotion runs
+    sc = _cfg(8)
+    traces = _traces(8, 2)
+    probe = SweepRunner(sc, traces, layout="solo")
+    per_sim = probe._per_sim_bill()
+    blk2 = probe._per_sim_bill(tile_shards=2)
+    blk4 = probe._per_sim_bill(tile_shards=4)
+    assert per_sim > blk2 > blk4 > 0
+
+    # fits one device -> no mesh promotion (None from the picker)
+    assert probe._auto_mesh_layout(2, 8, 8, budget=None) is not None
+    # budget below per-sim, above the 2-way block -> dt=2
+    budget = (per_sim + blk2) // 2
+    assert probe._auto_mesh_layout(2, 8, 8, budget=budget) == (2, 2)
+    # budget below the 2-way block, above the 4-way -> dt=4
+    budget = (blk2 + blk4) // 2
+    assert probe._auto_mesh_layout(2, 8, 8, budget=budget) == (2, 4)
+    # 2 devices can only split 2 ways; below that block nothing fits
+    assert probe._auto_mesh_layout(2, 8, 2, budget=budget) is None
+    # single device: no mesh to shard over
+    assert probe._auto_mesh_layout(2, 8, 1, budget=budget) is None
+
+    # end-to-end: the runner auto-promotes and proves per-device fit
+    budget = (per_sim + blk2) // 2
+    r = SweepRunner(sc, traces, hbm_budget_bytes=budget)
+    assert r.layout_spec == (2, 2)
+    assert r.device_breakdown()["total"] <= budget
+    # explicit legacy kwargs still pin the old layouts
+    assert SweepRunner(sc, traces, shard_batch=False).layout_spec \
+        == "solo"
+    assert SweepRunner(sc, _traces(8, 8),
+                       shard_batch=True).layout_spec == "batch"
+
+
+def test_layout_validation():
+    sc = _cfg(8)
+    traces = _traces(8, 2)
+    with pytest.raises(ValueError, match="divide B"):
+        SweepRunner(sc, traces, layout=(3, 2))
+    with pytest.raises(ValueError, match="divide the tile count"):
+        SweepRunner(sc, traces, layout=(2, 3))
+    with pytest.raises(ValueError, match="not both"):
+        SweepRunner(sc, traces, layout="solo", shard_batch=True)
+    with pytest.raises(ValueError, match="unknown layout"):
+        SweepRunner(sc, traces, layout="diagonal")
+
+
+# ---- per-device residency arithmetic ---------------------------------------
+
+
+def test_per_device_residency_arithmetic():
+    from graphite_tpu.obs import ProfileSpec, TelemetrySpec
+    from graphite_tpu.parallel.mesh import shard_split_bytes
+
+    tel = TelemetrySpec(sample_interval_ps=1_000_000, n_samples=16)
+    prof = ProfileSpec(sample_interval_ps=1_000_000, n_samples=16)
+    sc = _cfg(8)
+    traces = _traces(8, 4)
+    r = SweepRunner(sc, traces, layout=(2, 2), telemetry=tel,
+                    profile=prof)
+    state = r.sim.state.replace(telemetry=None, profile=None)
+    split = shard_split_bytes(state)
+    assert split["tile_local"] > 0 and split["replicated"] > 0
+
+    bd = r.device_breakdown()          # 2 sims' tile blocks per device
+    # state: full replicated control + half the big per-tile arrays
+    assert bd["state"] == 2 * (split["replicated"]
+                               + split["tile_local"] // 2)
+    # telemetry ring replicates across tile shards; profile shards
+    rtel = r.sim.telemetry_spec.ring_bytes()
+    rprof = r.sim.profile_spec.ring_bytes(tile_shards=2)
+    assert bd["telemetry"] == 2 * rtel
+    assert bd["profile"] == 2 * rprof
+    assert rprof < r.sim.profile_spec.ring_bytes()
+    assert bd["total"] == sum(v for k, v in bd.items() if k != "total")
+    # the whole-campaign bill strictly exceeds any device's share
+    assert r.residency_breakdown()["total"] > bd["total"]
+
+
+def test_profile_ring_shard_accounting():
+    from graphite_tpu.obs import ProfileSpec
+
+    class _P:
+        n_tiles = 8
+        mem = None
+
+    spec = ProfileSpec(sample_interval_ps=1, n_samples=4).resolve(_P)
+    S, T, m = spec.buffer_sig()[0]
+    item = 8
+    assert spec.ring_bytes() == (S * T * m + T * m + S + 2) * item
+    assert spec.ring_bytes(tile_shards=2) == \
+        (S * (T // 2) * m + (T // 2) * m + S + 2) * item
+    with pytest.raises(ValueError, match="divisible"):
+        spec.ring_bytes(tile_shards=3)
+
+
+# ---- admission -------------------------------------------------------------
+
+
+def _measure(job, budget=0, n_devices=1, batch_size=4):
+    from graphite_tpu.serve.admission import AdmissionController
+
+    return AdmissionController(hbm_budget_bytes=budget,
+                               batch_size=batch_size,
+                               n_devices=n_devices)
+
+
+def test_admission_class_key_splits_on_layout():
+    from graphite_tpu.serve.admission import measure_job
+    from graphite_tpu.serve.job import Job
+
+    sc = _cfg(8, scheme="lax")
+    trace = _traces(8, 1, accesses=12)[0]
+    job = Job("k0", sc, trace, seed=1)
+    m = measure_job(job, mailbox_depth=8, pad_length=64)
+    budget = (m.per_sim_total + m.device_block(2)["total"]) // 2
+
+    solo_key = _measure(job).class_key(job)           # budget off
+    mesh_key = _measure(job, budget=budget,
+                        n_devices=8).class_key(job)
+    # identical program class, different LAYOUT axis — never co-batch
+    assert solo_key[:-1] == mesh_key[:-1]
+    assert solo_key[-1] == ("solo",)
+    assert mesh_key[-1][0] == "2d" and mesh_key[-1][2] > 1
+    assert solo_key != mesh_key
+
+
+def test_admission_bin_packs_across_devices():
+    from graphite_tpu.analysis.cost import ResidencyBudgetError
+    from graphite_tpu.serve.admission import measure_job
+    from graphite_tpu.serve.job import Job
+
+    from graphite_tpu.engine.simulator import auto_mailbox_depth
+    from graphite_tpu.serve.admission import _pow2_bucket
+
+    sc = _cfg(8, scheme="lax")
+    trace = _traces(8, 1, accesses=12)[0]
+    job = Job("b0", sc, trace, seed=1)
+    # measure at the controller's OWN bucketed depth/length, so the
+    # rejection breakdown is comparable number for number
+    m = measure_job(
+        job,
+        mailbox_depth=_pow2_bucket(auto_mailbox_depth(job.trace), 2),
+        pad_length=_pow2_bucket(job.trace.length, 16))
+    budget = (m.per_sim_total + m.device_block(2)["total"]) // 2
+
+    # one device: the round-13 never-fits rejection, breakdown attached
+    with pytest.raises(ResidencyBudgetError,
+                       match="can never fit") as ei:
+        _measure(job, budget=budget).admit(job)
+    assert ei.value.breakdown["total"] == m.per_sim_total
+
+    # eight devices: admitted under the 2D layout, per-device block
+    # PROVEN <= the budget, capacity accounting devices x budget
+    ctrl = _measure(job, budget=budget, n_devices=8)
+    cls, _ = ctrl.admit(job)
+    assert cls.tile_shards == 2 and cls.batch_shards >= 1
+    assert cls.batch_cap >= 1
+    assert cls.batch_cap % cls.batch_shards == 0
+    dev = cls.device_breakdown()
+    assert dev["total"] <= budget
+    # the whole batch exceeds one budget — that is the point
+    if cls.batch_cap > 1:
+        assert cls.breakdown(cls.batch_cap)["total"] > budget
+
+    # a budget below even the maximal split still rejects, naming the
+    # per-device attempt
+    with pytest.raises(ResidencyBudgetError, match="per-device block"):
+        _measure(job, budget=m.device_block(8)["total"] // 2,
+                 n_devices=8).admit(job)
+    # dt need not divide n_devices: with 6 devices and an 8-tile job,
+    # the 4-way split (one batch shard, two devices idle) is still
+    # found when only it fits
+    blk4 = m.device_block(4)["total"]
+    ctrl6 = _measure(job, budget=blk4 + 1, n_devices=6)
+    cls6, _ = ctrl6.admit(Job("b6", sc, trace, seed=1))
+    assert cls6.tile_shards == 4 and cls6.batch_shards == 1
+
+
+def test_admission_capacity_accounts_devices():
+    from graphite_tpu.serve.admission import measure_job, plan_layout
+    from graphite_tpu.serve.job import Job
+
+    sc = _cfg(8, scheme="lax")
+    trace = _traces(8, 1, accesses=12)[0]
+    job = Job("c0", sc, trace, seed=1)
+    m = measure_job(job, mailbox_depth=8, pad_length=64)
+    blk2 = m.device_block(2)["total"]
+    # budget fits exactly one sim's 2-way block per device: with 8
+    # devices (4 batch shards x 2 tile shards) capacity is 4, not 1
+    plan = plan_layout(m, hbm_budget_bytes=blk2 + 1, batch_size=16,
+                       n_devices=8)
+    assert plan["tag"] == ("2d", 4, 2)
+    assert plan["batch_cap"] == 4
+    # batch_size still clamps
+    plan = plan_layout(m, hbm_budget_bytes=blk2 + 1, batch_size=2,
+                       n_devices=8)
+    assert plan["batch_cap"] == 2 and plan["batch_shards"] == 2
