@@ -193,7 +193,6 @@ scheme = lax
         # via BENCH_COHERENCE_1024=0.
         if os.environ.get("BENCH_COHERENCE_1024", "1") != "0":
             import subprocess
-            import sys
 
             for net, dirsz, wl in (
                     ("hbh", "full", "fft"), ("hopctr", "full", "fft"),
@@ -602,6 +601,128 @@ scheme = lax
                 })
             finally:
                 _sh.rmtree(sdir, ignore_errors=True)
+
+    # Runtime-DVFS overhead + race-to-idle campaign (round 19, dvfs/):
+    # (a) warm per-iteration cost of CARRYING per-domain frequency
+    # through the quantum loop (DvfsSpec attached at the config's own
+    # frequencies, so both memory engines and the network/DRAM timing
+    # read carried state instead of constant-folded MemParams) vs the
+    # folded baseline on the 16-tile coherence program — MEDIANS of
+    # BENCH_DVFS_REPS warm runs; (b) the headline race-to-idle
+    # campaign: TWO domain layouts (chip-global, core/uncore split) x
+    # a per-domain frequency grid served as ONE job stream with
+    # V^2*f-scaled energy pricing, one (energy_pj, wall) trade point
+    # per operating point — the rows tools/report.py --trade-curve
+    # renders as the energy-vs-wall Pareto frontier.  Skippable via
+    # BENCH_DVFS=0; rows also land in $BENCH_DVFS_OUT (JSON-lines)
+    # when that is set.
+    if os.environ.get("BENCH_DVFS", "1") != "0":
+        import statistics as _stats
+
+        from graphite_tpu.dvfs import DvfsSpec
+        from graphite_tpu.obs import EnergyPrices, TelemetrySpec
+        from graphite_tpu.serve import CampaignService, Job
+        from graphite_tpu.tools._template import config_text
+
+        dv_tiles = int(os.environ.get("BENCH_DVFS_TILES", "16"))
+        dv_reps = max(1, int(os.environ.get("BENCH_DVFS_REPS", "3")))
+        sc_dv = SimConfig(ConfigFile.from_string(config_text(
+            dv_tiles, shared_mem=True, clock_scheme="lax_barrier")))
+        dv_trace = synthetic.memory_stress_trace(
+            dv_tiles, n_accesses=24, working_set_bytes=1 << 13,
+            write_fraction=0.4, shared_fraction=0.5, seed=7)
+
+        def _dv_median(mk):
+            donor = mk()
+            donor.warmup()
+            samples = []
+            for _ in range(dv_reps):
+                sim2 = mk()
+                sim2.adopt_runner(donor)
+                t0 = time.perf_counter()
+                sim2.run()
+                wall = time.perf_counter() - t0
+                samples.append(
+                    1000 * wall / max(int(sim2.last_n_iterations), 1))
+            return _stats.median(samples)
+
+        ms_dv_off = _dv_median(lambda: Simulator(sc_dv, dv_trace))
+        ms_dv_on = _dv_median(
+            lambda: Simulator(sc_dv, dv_trace, dvfs=DvfsSpec()))
+        companions.update({
+            "ms_per_iter_dvfs_off": round(ms_dv_off, 4),
+            "ms_per_iter_dvfs_carried": round(ms_dv_on, 4),
+            "dvfs_carry_overhead_pct": round(
+                100 * (ms_dv_on / ms_dv_off - 1), 2),
+        })
+
+        # race-to-idle: one served stream, two admission classes (the
+        # domain layout is part of the config digest AND Job.dvfs
+        # joins the class key), frequency grid co-batched per class
+        # through the dvfs_domain_mhz knob
+        dv_extra = """
+[general]
+technology_node = 22
+[dvfs]
+max_frequency = 1.0
+synchronization_delay = 2
+domains = "{domains}"
+"""
+        sc_one = SimConfig(ConfigFile.from_string(
+            config_text(dv_tiles, shared_mem=True, clock_scheme="lax")
+            + dv_extra.format(
+                domains="<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE, "
+                "DIRECTORY, NETWORK_USER, NETWORK_MEMORY>")))
+        sc_two = SimConfig(ConfigFile.from_string(
+            config_text(dv_tiles, shared_mem=True, clock_scheme="lax")
+            + dv_extra.format(
+                domains="<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, "
+                "<1.0, DIRECTORY, NETWORK_USER, NETWORK_MEMORY>")))
+        prices = EnergyPrices(
+            instruction_pj=3, l1d_access_pj=2, l2_access_pj=9,
+            l2_miss_pj=120, invalidation_pj=15, eviction_pj=20,
+            dram_access_pj=500, packet_pj=7)
+        tel_dv = TelemetrySpec(sample_interval_ps=1_000_000,
+                               n_samples=256, energy_prices=prices)
+        grid_one = ((1000,), (870,), (750,), (500,))
+        grid_two = ((1000, 1000), (870, 1000), (750, 870), (500, 630))
+        dv_jobs = [
+            Job(f"r2i-one-{p[0]}", sc_one, dv_trace,
+                knobs={"dvfs_domain_mhz": p}, dvfs=DvfsSpec(),
+                telemetry=tel_dv)
+            for p in grid_one
+        ] + [
+            Job(f"r2i-two-{p[0]}-{p[1]}", sc_two, dv_trace,
+                knobs={"dvfs_domain_mhz": p}, dvfs=DvfsSpec(),
+                telemetry=tel_dv)
+            for p in grid_two
+        ]
+        svc_dv = CampaignService(batch_size=4, max_quanta=200_000)
+        t0 = time.perf_counter()
+        for job in dv_jobs:
+            svc_dv.submit(job)
+        served_dv = svc_dv.run_all()
+        r2i_wall = time.perf_counter() - t0
+        assert len(served_dv) == len(dv_jobs) \
+            and all(r.ok for r in served_dv)
+        trade = [r.to_json() for r in served_dv]
+        assert all("energy_pj" in row for row in trade)
+        out_path = os.environ.get("BENCH_DVFS_OUT")
+        if out_path:
+            with open(out_path, "w") as fh:
+                for row in trade:
+                    fh.write(json.dumps(row) + "\n")
+        companions.update({
+            "dvfs_campaign_jobs": len(dv_jobs),
+            "dvfs_campaign_classes": svc_dv.counters["compile_count"],
+            "dvfs_campaign_wall_s": round(r2i_wall, 3),
+            "dvfs_trade_points": [
+                {"job": row["job"],
+                 "dvfs_domain_mhz": row["dvfs_domain_mhz"],
+                 "wall_ns": row["completion_time_ns"],
+                 "energy_pj": row["energy_pj"]}
+                for row in trade],
+        })
 
     # Static cost-model trajectory (round 12): the audited gated-MSI
     # program's per-iteration kernel/byte proxy and its per-phase/base

@@ -79,6 +79,13 @@ class ProgramSpec:
     expect_profile: bool = False
     profile_sig: "tuple | None" = None     # ((S, T, m), dtype)
     profile_extra_sigs: "tuple" = ()
+    # round 19: the runtime DVFS manager.  dvfs-ON programs carry the
+    # per-domain operating point in the carry (SimState.dvfs_rt);
+    # dvfs-OFF programs run the dvfs-off rule — no dvfs_rt invar may
+    # survive in the lowering (the same None-adds-no-leaves contract as
+    # telemetry/profile; the always-carried legacy `.dvfs.` table does
+    # NOT match the `dvfs_rt` key)
+    expect_dvfs: bool = False
     # round 10: the engine's protocol-phase names in phase-cond program
     # order, so the cost model (analysis/cost.py) can attribute the
     # per-iteration kernel proxy phase-by-phase
@@ -205,6 +212,7 @@ def spec_from_simulator(name: str, sim,
         expect_profile=expect_prof,
         profile_sig=prof_sig,
         profile_extra_sigs=prof_extra,
+        expect_dvfs=getattr(sim, "dvfs_spec", None) is not None,
         phase_names=phase_names)
 
 
@@ -220,6 +228,15 @@ def spec_from_sweep(name: str, runner,
         f: [i for i, p in enumerate(paths) if p.endswith("." + f)]
         for f in KNOB_FIELDS
     }
+    if runner.knobs.dvfs_domain_mhz is not None:
+        # the domain-frequency axis is a traced knob too: its invars
+        # must stay live through the carried-frequency reads (knob-fold
+        # proves a config that silently ignores the grid)
+        from graphite_tpu.sweep.knobs import DVFS_KNOB_FIELD
+
+        knob_invars[DVFS_KNOB_FIELD] = [
+            i for i, p in enumerate(paths)
+            if p.endswith("." + DVFS_KNOB_FIELD)]
     if runner.sim.quantum_ps is None:
         # unbounded clock schemes have no quantum for the knob to steer
         knob_invars.pop("quantum_ps", None)
@@ -261,6 +278,7 @@ def spec_from_sweep(name: str, runner,
         expect_profile=expect_prof,
         profile_sig=prof_sig,
         profile_extra_sigs=prof_extra,
+        expect_dvfs=getattr(sim, "dvfs_spec", None) is not None,
         phase_names=phase_names,
         batched=not runner.shard_batch or runner._sims_per_dev > 1)
 
@@ -272,7 +290,7 @@ def spec_from_sweep(name: str, runner,
 
 DEFAULT_PROGRAM_NAMES = ("gated-msi", "ungated-msi", "shl2-mesi",
                          "sweep-b4", "gated-msi-tel", "sweep-b4-tel",
-                         "sweep-b4-2d")
+                         "sweep-b4-2d", "sweep-b4-dvfs")
 
 # cache/directory geometry chosen so the directory entry/sharers avals
 # are UNIQUE in the program (same trick as the phase-gating test) — a
@@ -319,7 +337,7 @@ def gated_msi_simulator(tiles: int = 8, extra_cfg: str = ""):
 
 def default_programs(tiles: int = 8, max_quanta: int = 4096,
                      names=None) -> "list[ProgramSpec]":
-    """The seven audited shapes: gated, ungated, shl2, sweep B=4, the
+    """The eight audited shapes: gated, ungated, shl2, sweep B=4, the
     telemetry-recording gated engine (round 9: the ring's aval joins
     the cond-payload forbidden set; telemetry-OFF programs additionally
     run the telemetry-off lint), the COMBINED sweep-B=4 + telemetry
@@ -328,7 +346,11 @@ def default_programs(tiles: int = 8, max_quanta: int = 4096,
     cond-payload or knob-fold lints — the composition is audited now),
     and the 2D batch x tile sweep campaign (round 18: the same B=4
     sweep on a 2x2 Mesh(('batch','tile')) with the packed tile-axis
-    exchange, lowered over a device-less AbstractMesh).
+    exchange, lowered over a device-less AbstractMesh), and the
+    runtime-DVFS sweep campaign (round 19: a genuinely two-domain
+    config sweeping a dvfs_domain_mhz grid — the carried-frequency
+    program where both the sync-delay knob and the frequency grid must
+    prove live).
 
     Small geometry on purpose — the lints are structural, so the
     8-tile lowering carries the same program shape the 1024-tile
@@ -371,7 +393,7 @@ def default_programs(tiles: int = 8, max_quanta: int = 4096,
             sc_shl2, batch, phase_gate=True, mem_gate_bytes=0),
             max_quanta))
     if "sweep-b4" in names or "sweep-b4-tel" in names \
-            or "sweep-b4-2d" in names:
+            or "sweep-b4-2d" in names or "sweep-b4-dvfs" in names:
         # the sweep config splits the modules over TWO DVFS domains so
         # the sync_delay knob actually crosses a boundary — in a
         # single-domain config it is structurally inert (MemParams.
@@ -426,6 +448,37 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
         runner_2d = SweepRunner(sc_sweep, sweep_traces, layout=(2, 2))
         specs.append(spec_from_sweep("sweep-b4-2d", runner_2d,
                                      max_quanta))
+    if "sweep-b4-dvfs" in names:
+        # the round-19 runtime-DVFS campaign: the SAME B=4 sweep with a
+        # GENUINELY multi-domain [dvfs] table (note `domains =` under
+        # [dvfs] itself — the sc_sweep block above nests it under
+        # [dvfs/domains], where the parser files it as the unread key
+        # `dvfs/domains/domains` and the config silently stays
+        # single-domain, which is why sync_delay_cycles was popped from
+        # its required knob set for ten rounds).  Here the two-domain
+        # split is real, so knob-fold proves sync_delay_cycles AND the
+        # dvfs_domain_mhz grid live through the carried-frequency reads.
+        from graphite_tpu.dvfs import DvfsSpec
+
+        sc_dvfs = SimConfig(ConfigFile.from_string(
+            config_text(tiles, shared_mem=True,
+                        clock_scheme="lax_barrier")
+            + geometry + """
+[general]
+technology_node = 22
+[dvfs]
+max_frequency = 1.0
+synchronization_delay = 2
+domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
+<1.0, DIRECTORY, NETWORK_USER, NETWORK_MEMORY>"
+"""))
+        dvfs_points = [{"dvfs_domain_mhz": p} for p in
+                       ((1000, 1000), (870, 1000), (750, 870),
+                        (500, 630))]
+        runner_dvfs = SweepRunner(sc_dvfs, sweep_traces, dvfs_points,
+                                  shard_batch=False, dvfs=DvfsSpec())
+        specs.append(spec_from_sweep("sweep-b4-dvfs", runner_dvfs,
+                                     max_quanta))
     return specs
 
 
@@ -435,7 +488,7 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
 
 RULE_NAMES = ("cond-payload", "knob-fold", "time-dtype", "vmap-gate",
               "host-sync", "scatter-determinism", "telemetry-off",
-              "profile-off")
+              "profile-off", "dvfs-off")
 
 
 @dataclasses.dataclass
@@ -537,6 +590,15 @@ def audit_program(spec: ProgramSpec, *,
                         if spec.profile_sig is not None else ())
                        + tuple(spec.profile_extra_sigs)),
             state_key="profile", rule="profile-off"))
+    if not spec.expect_dvfs:
+        # dvfs=None programs must carry no runtime-DVFS manager state:
+        # no `dvfs_rt` invar may survive (the carried operating point
+        # would change the lowering).  No ring sigs — the manager has
+        # no ring; its state is a handful of [n_domains] vectors whose
+        # avals are too generic to scan for.
+        add("dvfs-off", rules.telemetry_off(
+            spec.closed, spec.invar_paths, ring_sigs=(),
+            state_key="dvfs_rt", rule="dvfs-off"))
     return results
 
 

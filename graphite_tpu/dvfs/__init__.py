@@ -1,0 +1,36 @@
+"""Runtime DVFS manager: carried per-domain operating points, V/f level
+tables, voltage-scaled energy pricing, and the reactive governor."""
+
+from graphite_tpu.dvfs.levels import (
+    energy_scale_q16,
+    freq_at_level,
+    level_for_freq,
+    validate_levels,
+    voltage_for_freq,
+)
+from graphite_tpu.dvfs.runtime import (
+    DvfsRtState,
+    DvfsSpec,
+    GovernorSpec,
+    apply_rt_mem,
+    core_freq_tiles,
+    elect_domains,
+    governor_tick,
+    init_dvfs_rt,
+)
+
+__all__ = [
+    "DvfsRtState",
+    "DvfsSpec",
+    "GovernorSpec",
+    "apply_rt_mem",
+    "core_freq_tiles",
+    "elect_domains",
+    "energy_scale_q16",
+    "freq_at_level",
+    "governor_tick",
+    "init_dvfs_rt",
+    "level_for_freq",
+    "validate_levels",
+    "voltage_for_freq",
+]

@@ -1,0 +1,109 @@
+"""V/f level-table helpers for the runtime DVFS manager.
+
+The static tables live in `models/dvfs.py` (`DvfsParams.voltages_mv` /
+`max_freq_mhz`, descending voltage — `DVFSManager::initializeDVFSLevels`).
+This module adds what the *runtime* manager needs on top:
+
+- `validate_levels`: the monotone V-per-f contract every table must obey
+  (a lower voltage can never support a higher frequency) — checked once
+  at spec-resolve time so traced lookups can use the argmax trick without
+  re-validating on device.
+- `voltage_for_freq`: the traced AUTO-voltage lookup (lowest voltage
+  whose max frequency supports the request) — the vectorized
+  `getMinVoltage`.
+- `level_for_freq` / level stepping: the governor's discrete ladder.
+- `energy_scale_q16`: the V²·f operating-point factor per domain as a
+  Q16 fixed-point int64 — integer math end to end so the energy series
+  stays bit-deterministic (no float in the carry).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+def validate_levels(voltages_mv, max_freq_mhz) -> None:
+    """Raise ValueError unless the (voltage, max-frequency) rows form a
+    valid V/f table: equal length, positive entries, strictly descending
+    voltage, and monotone non-increasing max frequency (V-per-f: a lower
+    voltage never supports a higher frequency)."""
+    v = tuple(int(x) for x in voltages_mv)
+    f = tuple(int(x) for x in max_freq_mhz)
+    if len(v) != len(f):
+        raise ValueError(
+            f"V/f table length mismatch: {len(v)} voltages vs "
+            f"{len(f)} frequencies")
+    if not v:
+        raise ValueError("empty V/f table")
+    if any(x <= 0 for x in v) or any(x <= 0 for x in f):
+        raise ValueError("V/f table entries must be positive")
+    for a, b in zip(v, v[1:]):
+        if b >= a:
+            raise ValueError(
+                f"V/f table voltages must be strictly descending "
+                f"(got {a} mV then {b} mV)")
+    for a, b in zip(f, f[1:]):
+        if b > a:
+            raise ValueError(
+                f"V/f table is not monotone V-per-f: max frequency rises "
+                f"from {a} MHz to {b} MHz as voltage drops")
+
+
+def level_arrays(dvp):
+    """The table as device constants: (voltages_mv int32[L],
+    max_freq_mhz int32[L]), descending."""
+    return (jnp.asarray(np.asarray(dvp.voltages_mv, np.int32)),
+            jnp.asarray(np.asarray(dvp.max_freq_mhz, np.int32)))
+
+
+def level_for_freq(dvp, freq_mhz):
+    """Traced: the DEEPEST (lowest-voltage) level whose max frequency
+    still supports `freq_mhz` (int32[...]).  Levels are descending, so
+    this is `(L-1) - argmax(ok[..., ::-1])` — exactly the in-trace
+    DVFS_SET lookup.  Frequencies above level 0 clamp to level 0."""
+    _, maxf = level_arrays(dvp)
+    ok = freq_mhz[..., None] <= maxf[None, :]
+    L = maxf.shape[0]
+    return jnp.where(jnp.any(ok, axis=-1),
+                     (L - 1) - jnp.argmax(ok[..., ::-1], axis=-1),
+                     0).astype(I32)
+
+
+def voltage_for_freq(dvp, freq_mhz):
+    """Traced AUTO-voltage: lowest voltage supporting `freq_mhz`
+    (vectorized `DvfsParams.min_voltage_mv`); requests above the table
+    max get level 0's voltage (the in-trace path rejects them before
+    this lookup)."""
+    volts, _ = level_arrays(dvp)
+    return volts[level_for_freq(dvp, freq_mhz)]
+
+
+def freq_at_level(dvp, level):
+    """Traced: the max frequency at `level` (clamped to the table)."""
+    _, maxf = level_arrays(dvp)
+    L = maxf.shape[0]
+    return maxf[jnp.clip(level, 0, L - 1)]
+
+
+def energy_scale_q16(dvp, domain_mhz, domain_mv):
+    """Per-domain V²·f operating-point factor as Q16 int64[ND].
+
+    The reference point is level 0 (max voltage, max frequency) — the
+    operating point the static `EnergyPrices` were quoted at — so a
+    domain running the table top prices at exactly 1.0 (1 << 16) and the
+    `dvfs=None` series is reproduced bit-for-bit at full throttle.
+    int64 headroom: mv² · mhz ≲ 1.5e6² · 4e3 ≈ 9e15, × 2^16 overflows —
+    so the shift happens after dividing mv² by the reference mv² would
+    lose precision; instead scale in two stages (voltage² Q8 then
+    frequency Q8)."""
+    ref_mv = jnp.asarray(int(dvp.voltages_mv[0]), I64)
+    ref_f = jnp.asarray(int(dvp.max_freq_mhz[0]), I64)
+    mv = domain_mv.astype(I64)
+    f = domain_mhz.astype(I64)
+    v2 = (mv * mv * 256) // (ref_mv * ref_mv)          # Q8
+    fq = (f * 256) // ref_f                            # Q8
+    return v2 * fq                                     # Q16

@@ -1,0 +1,236 @@
+"""Runtime DVFS manager: the chip-global per-domain operating point as
+simulation carry.
+
+The round-1 port parsed `[dvfs] domains` into static `DvfsParams` and
+mirrored only the CORE domain into `CoreState.freq_mhz`; cache/network/
+DRAM timing constant-folded their domain frequencies out of `MemParams`.
+This module makes the operating point *state*: `DvfsRtState` rides the
+simulation carry (`SimState.dvfs_rt` — int32 MHz + mV per domain per
+sim), the memory engines read the carried frequency through
+`apply_rt_mem`, in-trace `CarbonSetDVFS` requests elect a new domain
+point (`elect_domains`), and an optional ondemand-style governor steps
+the V/f ladder on utilization thresholds at quantum boundaries
+(`governor_tick` — masked arithmetic only, zero host sync).
+
+Off-identity contract (same as telemetry/profile): `dvfs=None` attaches
+no carry leaves and every call site branches at PYTHON level, so the
+historical program lowers byte-identically — enforced by the `dvfs-off`
+audit rule.
+
+Chip-global simplification (documented divergence): the reference keeps
+per-tile domain clocks; here a domain's operating point is one value per
+sim.  When several tiles issue DVFS_SET to the same domain in the same
+engine iteration, the LOWEST successful request wins (a deterministic
+min-election — no scatter ordering), and the CORE domain's elected
+frequency broadcasts to every tile's `CoreState.freq_mhz`.  Voltage
+always follows AUTO (lowest voltage supporting the frequency); the
+per-tile HOLD path remains on the legacy `SimState.dvfs` table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from graphite_tpu.dvfs.levels import (
+    I32,
+    I64,
+    freq_at_level,
+    level_for_freq,
+    validate_levels,
+    voltage_for_freq,
+)
+
+# DvfsParams.module_domains index of each module the carried frequency
+# feeds back into (order: models/dvfs.DVFS_MODULES)
+MOD_CORE = 0
+MOD_L1I = 1
+MOD_L1D = 2
+MOD_L2 = 3
+MOD_DIRECTORY = 4
+MOD_NETWORK_USER = 5
+MOD_NETWORK_MEMORY = 6
+
+
+@dataclasses.dataclass(frozen=True)
+class GovernorSpec:
+    """Ondemand-style reactive governor (`cpufreq` semantics): every
+    `interval_ps` of simulated time, compare the chip's utilization over
+    the elapsed window (busy = clock minus sync+recv stall) against the
+    thresholds and step the governed domains one V/f level up (toward
+    level 0 = max frequency) or down.  Evaluated at quantum boundaries
+    with masked arithmetic only — no cond payload, no host callback."""
+
+    interval_ps: int
+    up_threshold_pct: int = 80
+    down_threshold_pct: int = 30
+    domains: tuple = ()        # governed domain indices; () = all
+
+
+@dataclasses.dataclass(frozen=True)
+class DvfsSpec:
+    """Opt-in runtime DVFS manager config (the `dvfs=` attach axis).
+
+    `scale_energy`: price each `energy_pj` event at its domain's current
+    V²·f operating point (Q16 integer factors; level 0 = the static
+    prices' reference point).  `governor`: optional reactive stage.
+    Hashable + frozen — joins the serve admission class key so jobs with
+    differing DVFS configs never co-batch."""
+
+    scale_energy: bool = True
+    governor: "GovernorSpec | None" = None
+
+    def resolve(self, params) -> "DvfsSpec":
+        """Validate against the simulator's static params; returns self.
+        Raises ValueError on a config that cannot host the runtime
+        manager (no [dvfs] tables, broken V/f monotonicity, bad governor
+        thresholds)."""
+        dvp = params.dvfs
+        if dvp is None:
+            raise ValueError(
+                "runtime DVFS needs [dvfs] tables in the config "
+                "(params.dvfs is None)")
+        validate_levels(dvp.voltages_mv, dvp.max_freq_mhz)
+        if len(dvp.module_domains) == 0:
+            raise ValueError(
+                "params.dvfs.module_domains is empty — DvfsParams "
+                "predates the runtime manager; rebuild via from_config")
+        g = self.governor
+        if g is not None:
+            if int(g.interval_ps) <= 0:
+                raise ValueError("governor interval_ps must be positive")
+            if not (0 <= g.down_threshold_pct < g.up_threshold_pct
+                    <= 100):
+                raise ValueError(
+                    f"governor thresholds must satisfy 0 <= down < up "
+                    f"<= 100 (got down={g.down_threshold_pct}, "
+                    f"up={g.up_threshold_pct})")
+            for d in g.domains:
+                if not (0 <= int(d) < dvp.n_domains):
+                    raise ValueError(
+                        f"governor domain {d} out of range "
+                        f"(n_domains={dvp.n_domains})")
+        return self
+
+
+@struct.dataclass
+class DvfsRtState:
+    """The carried operating point: chip-global, per domain, per sim."""
+
+    domain_mhz: "object"       # int32[ND] — current frequency
+    domain_mv: "object"        # int32[ND] — current voltage
+    # governor cursors (carried even without a governor — 4 scalars)
+    next_ps: "object"          # int64[] — next evaluation time
+    prev_clock_ps: "object"    # int64[] — clock sum at last evaluation
+    prev_busy_ps: "object"     # int64[] — busy sum at last evaluation
+
+
+def init_dvfs_rt(dvp, spec: DvfsSpec, domain_mhz=None) -> DvfsRtState:
+    """Fresh carry seeded from the config's initial domain frequencies,
+    or from a per-sim override (`dvfs_domain_mhz` sweep knob — may be a
+    traced int32[ND])."""
+    if domain_mhz is None:
+        mhz = jnp.asarray(np.asarray(dvp.domain_freq_mhz, np.int32))
+    else:
+        mhz = jnp.asarray(domain_mhz, I32)
+    interval = (int(spec.governor.interval_ps)
+                if spec.governor is not None else 0)
+    return DvfsRtState(
+        domain_mhz=mhz,
+        domain_mv=voltage_for_freq(dvp, mhz),
+        next_ps=jnp.asarray(interval, I64),
+        prev_clock_ps=jnp.zeros((), I64),
+        prev_busy_ps=jnp.zeros((), I64),
+    )
+
+
+def apply_rt_mem(dvp, mem_p, rt: DvfsRtState):
+    """MemParams with the constant-folded domain frequencies replaced by
+    the carried ones — the memory engines' cycles<->ps conversions and
+    the memory-network/DRAM models then track DVFS transitions in-trace.
+    Domain indices are static, so this is two traced-scalar field swaps
+    (the same dataclasses.replace lift the round-7 knobs use)."""
+    return dataclasses.replace(
+        mem_p,
+        net_freq_mhz=rt.domain_mhz[dvp.module_domains[MOD_NETWORK_MEMORY]],
+        dir_freq_mhz=rt.domain_mhz[dvp.module_domains[MOD_DIRECTORY]],
+    )
+
+
+def elect_domains(dvp, rt: DvfsRtState, req_mhz, dmask) -> DvfsRtState:
+    """Fold this iteration's successful DVFS_SET requests into the
+    carry.  `req_mhz` int32[T] (requested frequency per tile), `dmask`
+    bool[T, ND] (request succeeded AND targeted that domain).  Election:
+    per-domain min over successful requests — deterministic regardless
+    of lane order.  Voltage follows AUTO."""
+    big = jnp.asarray(np.iinfo(np.int32).max, I32)
+    reqs = jnp.where(dmask, req_mhz.astype(I32)[:, None], big)
+    won = jnp.min(reqs, axis=0)                      # [ND]
+    any_d = jnp.any(dmask, axis=0)                   # [ND]
+    new_mhz = jnp.where(any_d, won, rt.domain_mhz)
+    new_mv = jnp.where(any_d, voltage_for_freq(dvp, new_mhz),
+                       rt.domain_mv)
+    return rt.replace(domain_mhz=new_mhz, domain_mv=new_mv)
+
+
+def core_freq_tiles(dvp, rt: DvfsRtState, freq_mhz):
+    """The CORE domain's carried frequency broadcast over the per-tile
+    `CoreState.freq_mhz` array (chip-global semantics)."""
+    return jnp.broadcast_to(
+        rt.domain_mhz[dvp.core_domain].astype(freq_mhz.dtype),
+        freq_mhz.shape)
+
+
+def governor_tick(gov: GovernorSpec, dvp, rt: DvfsRtState,
+                  state) -> DvfsRtState:
+    """One quantum-boundary governor evaluation (masked arithmetic only
+    — the telemetry_tick pattern, so the host-sync lint stays clean).
+
+    Utilization over the window since the last evaluation:
+    busy = Δ(Σ clock) − Δ(Σ sync_stall + recv_stall), util% = busy/Δclock.
+    util ≥ up_threshold → one level toward level 0 (faster);
+    util ≤ down_threshold → one level toward the table bottom (slower);
+    in between holds.  All governed domains step on the same chip-wide
+    signal (chip-global simplification)."""
+    core = state.core
+    clock = jnp.sum(core.clock_ps)
+    busy = clock - jnp.sum(core.sync_stall_ps + core.recv_stall_ps)
+    sim_time = jnp.max(core.clock_ps)
+    do = sim_time >= rt.next_ps
+
+    d_clock = jnp.maximum(clock - rt.prev_clock_ps, 1)
+    d_busy = jnp.clip(busy - rt.prev_busy_ps, 0, None)
+    util = (d_busy * 100) // d_clock                  # int64 scalar
+
+    lvl = level_for_freq(dvp, rt.domain_mhz)          # [ND]
+    up = util >= gov.up_threshold_pct
+    down = util <= gov.down_threshold_pct
+    n_levels = len(dvp.max_freq_mhz)
+    new_lvl = jnp.clip(
+        jnp.where(up, lvl - 1, jnp.where(down, lvl + 1, lvl)),
+        0, n_levels - 1)
+
+    nd = int(rt.domain_mhz.shape[0])
+    governed = np.zeros(nd, bool)
+    if gov.domains:
+        governed[list(gov.domains)] = True
+    else:
+        governed[:] = True
+    apply = do & jnp.asarray(governed)
+
+    new_mhz = jnp.where(apply, freq_at_level(dvp, new_lvl),
+                        rt.domain_mhz)
+    new_mv = jnp.where(apply, voltage_for_freq(dvp, new_mhz),
+                       rt.domain_mv)
+    interval = int(gov.interval_ps)
+    return rt.replace(
+        domain_mhz=new_mhz,
+        domain_mv=new_mv,
+        next_ps=jnp.where(do, (sim_time // interval + 1) * interval,
+                          rt.next_ps),
+        prev_clock_ps=jnp.where(do, clock, rt.prev_clock_ps),
+        prev_busy_ps=jnp.where(do, busy, rt.prev_busy_ps),
+    )

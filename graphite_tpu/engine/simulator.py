@@ -358,6 +358,7 @@ class Simulator:
         telemetry=None,
         profile=None,
         base_consolidate: bool | None = None,
+        dvfs=None,
     ):
         """`dir_stage`: force the directory write-staging path on/off
         (None = auto: on for single-device private-L2 runs whose sharers
@@ -402,6 +403,13 @@ class Simulator:
         simulated-time boundaries as telemetry; read back via
         `Simulator.profile` / `SimResults.profile`).  Same None
         bit-identity contract, enforced by the `profile-off` lint.
+
+        `dvfs`: a `dvfs.DvfsSpec` attaching the runtime DVFS manager —
+        the chip-global per-domain operating point rides the carry
+        (`SimState.dvfs_rt`), in-trace DVFS_SET events and the optional
+        governor retune it, and the memory/network timing conversions
+        read the carried frequencies.  Same None bit-identity contract,
+        enforced by the `dvfs-off` lint.
 
         `donate=True` gives the input state's device buffers to XLA each
         run (halves big-state HBM residency — required for the 1024-tile
@@ -809,10 +817,15 @@ class Simulator:
         # device-resident per-tile profile ring (graphite_tpu/obs/
         # profile.py): same attach/resolve/None-contract as telemetry
         self.profile_spec = None
+        # runtime DVFS manager (graphite_tpu/dvfs): same attach/resolve/
+        # None-contract — None carries no DvfsRtState leaves
+        self.dvfs_spec = None
         if telemetry is not None:
             self.attach_telemetry(telemetry)
         if profile is not None:
             self.attach_profile(profile)
+        if dvfs is not None:
+            self.attach_dvfs(dvfs)
 
     def attach_telemetry(self, spec) -> None:
         """Attach (or replace) a telemetry spec on a not-yet-run
@@ -883,6 +896,40 @@ class Simulator:
                     self.residency_breakdown(profile_spec=spec)))
         self.profile_spec = spec
         self.state = self.state.replace(profile=init_profile(spec))
+        self._runner = None
+        self._runner_max_quanta = None
+        self._hb_runner = None
+        self._lowered = {}   # the spec is baked into the lowering too
+        self.lower_gen += 1
+
+    def attach_dvfs(self, spec, domain_mhz=None) -> None:
+        """Attach (or replace) a runtime-DVFS spec on a not-yet-run
+        instance: validates it against this program's [dvfs] tables,
+        seeds the per-domain carry (`SimState.dvfs_rt`) from the
+        config's initial domain frequencies — or `domain_mhz`, an
+        int32[n_domains] override — and invalidates any compiled runner
+        (the spec is baked into the lowering).  The CORE domain's seed
+        broadcasts into `CoreState.freq_mhz` (chip-global semantics)."""
+        from graphite_tpu.dvfs.runtime import (
+            DvfsSpec, core_freq_tiles, init_dvfs_rt,
+        )
+
+        if not isinstance(spec, DvfsSpec):
+            raise TypeError("dvfs must be a dvfs.DvfsSpec")
+        spec = spec.resolve(self.params)
+        if self.mesh is not None or self.stream:
+            raise ValueError(
+                "the runtime DVFS manager supports single-device "
+                "resident runs and batched sweeps only (the carry is "
+                "not threaded through the Simulator's own multi-chip "
+                "exchange or the streaming window loop); serve the sim "
+                "as a batched campaign under SweepRunner instead")
+        rt = init_dvfs_rt(self.params.dvfs, spec, domain_mhz)
+        self.dvfs_spec = spec
+        self.state = self.state.replace(
+            dvfs_rt=rt,
+            core=self.state.core.replace(freq_mhz=core_freq_tiles(
+                self.params.dvfs, rt, self.state.core.freq_mhz)))
         self._runner = None
         self._runner_max_quanta = None
         self._hb_runner = None
@@ -1011,7 +1058,8 @@ class Simulator:
                     self.params, self.device_trace, self.quantum_ps,
                     max_quanta, donate=self.donate,
                     telemetry=self.telemetry_spec,
-                    profile=self.profile_spec)
+                    profile=self.profile_spec,
+                    dvfs=self.dvfs_spec)
             self._runner_max_quanta = max_quanta
         return self._runner
 
@@ -1057,6 +1105,7 @@ class Simulator:
         params = self.params
         tel = self.telemetry_spec
         prof = self.profile_spec
+        dv = self.dvfs_spec
         if self.barrier_host:
             from graphite_tpu.engine.step import barrier_host_batch
 
@@ -1065,7 +1114,7 @@ class Simulator:
             def fn(st, tr, prev_qend, budget):
                 return barrier_host_batch(params, tr, st, prev_qend,
                                           qps, budget, telemetry=tel,
-                                          profile=prof)
+                                          profile=prof, dvfs=dv)
 
             args = (self.state, self.device_trace,
                     jnp.asarray(0, jnp.int64),
@@ -1077,7 +1126,8 @@ class Simulator:
 
             def fn(st, tr):
                 return run_simulation(params, tr, st, qps, max_quanta,
-                                      telemetry=tel, profile=prof)
+                                      telemetry=tel, profile=prof,
+                                      dvfs=dv)
 
             args = (self.state, self.device_trace)
         return fn, args
@@ -1134,11 +1184,12 @@ class Simulator:
             qps = int(self.quantum_ps)
             tel = self.telemetry_spec
             prof = self.profile_spec
+            dv = self.dvfs_spec
 
             def qrun(st, prev_qend, budget):
                 return barrier_host_batch(params, trace, st, prev_qend,
                                           qps, budget, telemetry=tel,
-                                          profile=prof)
+                                          profile=prof, dvfs=dv)
 
             self._hb_runner = jax.jit(
                 qrun, donate_argnums=(0,) if self.donate else ())
@@ -1436,6 +1487,7 @@ class Simulator:
                 # record nothing (or retrace) instead of refusing
                 or other.telemetry_spec != self.telemetry_spec
                 or other.profile_spec != self.profile_spec
+                or other.dvfs_spec != self.dvfs_spec
                 or other.trace_batch is not self.trace_batch):
             raise ValueError(
                 "adopt_runner needs the same trace batch and identical "

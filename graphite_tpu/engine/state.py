@@ -155,6 +155,11 @@ class SimState:
     # when the run records the spatial profiler; None (no pytree leaves
     # — same bit-identity contract as telemetry) otherwise
     profile: "object" = None
+    # runtime DVFS manager carry (dvfs/runtime.DvfsRtState): chip-global
+    # per-domain operating point + governor cursors when a DvfsSpec is
+    # attached; None (no pytree leaves — same bit-identity contract as
+    # telemetry/profile) otherwise
+    dvfs_rt: "object" = None
 
 
 @struct.dataclass

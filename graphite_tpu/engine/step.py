@@ -156,8 +156,16 @@ def subquantum_iteration(
     trace_base: jax.Array | None = None,
     px: ParallelCtx = IDENT,
     knobs=None,
+    dvfs=None,
 ) -> tuple[SimState, jax.Array]:
     """Process one trace record per tile; returns (state, tiles_advanced).
+
+    With `dvfs` (a resolved `dvfs.DvfsSpec`) and the `SimState.dvfs_rt`
+    carry attached, the memory/network/DRAM timing conversions read the
+    CARRIED per-domain frequencies instead of the constant-folded
+    MemParams values, and in-trace DVFS_SET events elect new chip-global
+    operating points (dvfs/runtime.py).  None — the default — keeps the
+    historical program bit-identical (the `dvfs-off` audit rule).
 
     With `knobs` (a sweep.Knobs pytree) set, the memory engines read
     their timing scalars — DRAM latency, directory access cycles, NoC
@@ -284,6 +292,12 @@ def subquantum_iteration(
         # knob lifting: swap the timing-scalar fields for the (traced)
         # sweep knobs; geometry and every other static field untouched
         mem_p = params.mem if knobs is None else knobs.apply_mem(params.mem)
+        if dvfs is not None and state.dvfs_rt is not None:
+            # runtime DVFS: the memory-network and directory frequencies
+            # come from the carried operating point (same replace lift)
+            from graphite_tpu.dvfs.runtime import apply_rt_mem
+
+            mem_p = apply_rt_mem(params.dvfs, mem_p, state.dvfs_rt)
         addr0, addr1 = fetched[6], fetched[7]
         rec = RecView(op=op, flags=flags, pc=pc, addr0=addr0, addr1=addr1,
                       aux0=aux0, aux1=aux1)
@@ -899,6 +913,12 @@ def subquantum_iteration(
     # voltage's maximum; invalid requests count into dvfs errors and leave
     # state unchanged (`dvfs.h` rc codes -2/-4/-5).
     is_dvfs_set = op == Op.DVFS_SET
+    # runtime DVFS (round 19): with a spec + carry attached, successful
+    # DVFS_SET requests additionally elect the chip-global per-domain
+    # operating point — the dmask cond output exists ONLY then (python-
+    # level gate), so dvfs=None lowers the historical cond byte-identically
+    want_rt = dvfs is not None and state.dvfs_rt is not None
+    new_rt = state.dvfs_rt
     if params.dvfs is not None and state.dvfs is not None:
         dvp = params.dvfs
         ND = dvp.n_domains
@@ -931,20 +951,39 @@ def subquantum_iteration(
             volt2 = jnp.where(dmask, new_v[:, None], state.dvfs.voltage_mv)
             errs2 = state.dvfs.errors + err.astype(I64)
             core_set = ok & (dom == dvp.core_domain)
-            return freq2, volt2, errs2, core_set, req
+            out = (freq2, volt2, errs2, core_set, req)
+            if want_rt:
+                out = out + (dmask,)
+            return out
 
         def _dvfs_skip(_):
-            return (state.dvfs.freq_mhz, state.dvfs.voltage_mv,
-                    state.dvfs.errors, jnp.zeros((T,), jnp.bool_),
-                    jnp.zeros((T,), aux1.dtype))
+            out = (state.dvfs.freq_mhz, state.dvfs.voltage_mv,
+                   state.dvfs.errors, jnp.zeros((T,), jnp.bool_),
+                   jnp.zeros((T,), aux1.dtype))
+            if want_rt:
+                out = out + (jnp.zeros((T, ND), jnp.bool_),)
+            return out
 
-        (dv_freq, dv_volt, dv_errs, dvfs_core_set, dvfs_req) = lax.cond(
+        dvfs_out = lax.cond(
             jnp.any(active & is_dvfs_set), _dvfs_block, _dvfs_skip, None)
+        (dv_freq, dv_volt, dv_errs, dvfs_core_set, dvfs_req) = dvfs_out[:5]
         new_dvfs = state.dvfs.replace(
             freq_mhz=dv_freq, voltage_mv=dv_volt, errors=dv_errs)
-        freq_mhz = jnp.where(
-            dvfs_core_set, dvfs_req.astype(core.freq_mhz.dtype),
-            core.freq_mhz)
+        if want_rt:
+            from graphite_tpu.dvfs.runtime import (
+                core_freq_tiles, elect_domains,
+            )
+
+            new_rt = elect_domains(dvp, state.dvfs_rt, dvfs_req,
+                                   dvfs_out[5])
+            # chip-global CORE domain: the elected frequency broadcasts
+            # to every tile (the per-tile table above stays the legacy
+            # get/set view)
+            freq_mhz = core_freq_tiles(dvp, new_rt, core.freq_mhz)
+        else:
+            freq_mhz = jnp.where(
+                dvfs_core_set, dvfs_req.astype(core.freq_mhz.dtype),
+                core.freq_mhz)
     else:
         new_dvfs = state.dvfs
         dvfs_set_now = active & is_dvfs_set & (aux0 == 0) & (aux1 > 0)
@@ -1121,12 +1160,13 @@ def subquantum_iteration(
         # obs.profile_tick) — None adds no leaves
         telemetry=state.telemetry,
         profile=state.profile,
+        dvfs_rt=new_rt,
     )
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 
 
 def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT,
-                  knobs=None):
+                  knobs=None, dvfs=None):
     """Blocks of `inner_block` iterations until no tile makes progress.
     Returns (state, total_progress, n_iterations)."""
 
@@ -1143,7 +1183,8 @@ def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT,
         def body(carry):
             st, prog, i = carry
             st, adv = subquantum_iteration(params, trace, st, qend,
-                                           trace_base, px=px, knobs=knobs)
+                                           trace_base, px=px, knobs=knobs,
+                                           dvfs=dvfs)
             return st, prog + adv, i + 1
 
         state, progress, _ = lax.while_loop(
@@ -1213,6 +1254,7 @@ def run_simulation(
     knobs=None,
     telemetry=None,
     profile=None,
+    dvfs=None,
 ):
     """The whole simulation as ONE compiled region: an outer while_loop over
     lax-barrier quanta (the MCP barrier loop, `lax_barrier_sync_server.h`)
@@ -1246,11 +1288,24 @@ def run_simulation(
     spatial profile ring on the SAME simulated-time boundaries — the
     second ring of the round-16 spatial profiler.  None (the default)
     lowers a bit-identical program (the `profile-off` audit lint).
+
+    `dvfs` (a RESOLVED dvfs.DvfsSpec; state.dvfs_rt must hold the
+    matching DvfsRtState) turns on the runtime DVFS manager: carried
+    per-domain frequencies feed the timing conversions, in-trace
+    DVFS_SET events retune, the optional governor steps the V/f ladder
+    at quantum boundaries, and (with scale_energy) the energy series
+    prices each domain at its current V²·f operating point.  None (the
+    default) lowers a bit-identical program (the `dvfs-off` audit lint).
     """
     if telemetry is not None:
         from graphite_tpu.obs.telemetry import telemetry_tick
     if profile is not None:
         from graphite_tpu.obs.profile import profile_tick
+    if dvfs is not None:
+        from graphite_tpu.dvfs.runtime import core_freq_tiles, governor_tick
+    # energy terms price at the carried operating point only when asked
+    dvfs_energy = (params.dvfs
+                   if dvfs is not None and dvfs.scale_energy else None)
     INF_QEND = jnp.asarray(2**61, I64)
     if quantum_ps is None:
         qps = None
@@ -1283,17 +1338,29 @@ def run_simulation(
             qend = jnp.maximum(prev_qend + qps, next_boundary(min_pending))
         st2, progress, blk_iters = _quantum_loop(params, trace, st, qend,
                                                  trace_base, px=px,
-                                                 knobs=knobs)
+                                                 knobs=knobs, dvfs=dvfs)
+        if dvfs is not None and dvfs.governor is not None:
+            # reactive governor: step the governed domains' V/f level on
+            # the utilization window — masked arithmetic only (the
+            # telemetry_tick pattern), evaluated at the quantum boundary
+            rt2 = governor_tick(dvfs.governor, params.dvfs,
+                                st2.dvfs_rt, st2)
+            st2 = st2.replace(
+                dvfs_rt=rt2,
+                core=st2.core.replace(freq_mhz=core_freq_tiles(
+                    params.dvfs, rt2, st2.core.freq_mhz)))
         if telemetry is not None:
             st2 = st2.replace(telemetry=telemetry_tick(
-                telemetry, st2, progress=progress, blk_iters=blk_iters))
+                telemetry, st2, progress=progress, blk_iters=blk_iters,
+                dvfs=dvfs_energy))
         if profile is not None:
             # same boundary arithmetic as the telemetry tick — with
             # equal intervals XLA CSEs the shared scalar reductions, so
             # the two rings cost one boundary test per quantum; under a
             # tile-sharded px the [S, T, m] ring is block-local and the
             # tick appends only this device's lanes (obs/profile.py)
-            st2 = st2.replace(profile=profile_tick(profile, st2, px=px))
+            st2 = st2.replace(profile=profile_tick(profile, st2, px=px,
+                                                   dvfs=dvfs_energy))
         # Zero progress: if some non-done tile sits beyond qend (it crossed
         # the boundary executing one long record), jump the window up to it
         # — blocked peers may wait on its future sends.  Only when every
@@ -1339,6 +1406,7 @@ def barrier_host_batch(
     max_quanta: jax.Array,    # int32[] quanta budget for THIS dispatch
     telemetry=None,
     profile=None,
+    dvfs=None,
 ):
     """Up to `max_quanta` lax_barrier quanta as ONE compiled region — the
     batched form of the host-driven barrier loop (Simulator.barrier_host).
@@ -1366,6 +1434,10 @@ def barrier_host_batch(
         from graphite_tpu.obs.telemetry import telemetry_tick
     if profile is not None:
         from graphite_tpu.obs.profile import profile_tick
+    if dvfs is not None:
+        from graphite_tpu.dvfs.runtime import core_freq_tiles, governor_tick
+    dvfs_energy = (params.dvfs
+                   if dvfs is not None and dvfs.scale_energy else None)
     qps = int(quantum_ps)
 
     def next_boundary(clock):
@@ -1386,12 +1458,22 @@ def barrier_host_batch(
         min_pending = jnp.min(jnp.where(~st.done, clocks,
                                         jnp.asarray(2**62, I64)))
         qend = jnp.maximum(prev + qps, next_boundary(min_pending))
-        st2, progress, blk_iters = _quantum_loop(params, trace, st, qend)
+        st2, progress, blk_iters = _quantum_loop(params, trace, st, qend,
+                                                 dvfs=dvfs)
+        if dvfs is not None and dvfs.governor is not None:
+            rt2 = governor_tick(dvfs.governor, params.dvfs,
+                                st2.dvfs_rt, st2)
+            st2 = st2.replace(
+                dvfs_rt=rt2,
+                core=st2.core.replace(freq_mhz=core_freq_tiles(
+                    params.dvfs, rt2, st2.core.freq_mhz)))
         if telemetry is not None:
             st2 = st2.replace(telemetry=telemetry_tick(
-                telemetry, st2, progress=progress, blk_iters=blk_iters))
+                telemetry, st2, progress=progress, blk_iters=blk_iters,
+                dvfs=dvfs_energy))
         if profile is not None:
-            st2 = st2.replace(profile=profile_tick(profile, st2))
+            st2 = st2.replace(profile=profile_tick(profile, st2,
+                                                   dvfs=dvfs_energy))
         zero = (progress == 0) & jnp.any(~st2.done)
         ahead_clock = jnp.min(jnp.where(
             ~st2.done & (st2.core.clock_ps >= qend),
@@ -1414,13 +1496,14 @@ def barrier_host_batch(
 def make_simulation_runner(params: EngineParams, trace: DeviceTrace,
                            quantum_ps: int | None, max_quanta: int,
                            donate: bool = False, telemetry=None,
-                           profile=None):
+                           profile=None, dvfs=None):
     """`donate=True` hands the input state's buffers to XLA (halves the
     protocol state's HBM residency — the 1024-tile directory is 2.4 GB,
     and without donation input + output + scatter staging exceeds the
     chip; see PERF.md).  The caller's old state object is consumed."""
     def run(state: SimState):
         return run_simulation(params, trace, state, quantum_ps, max_quanta,
-                              telemetry=telemetry, profile=profile)
+                              telemetry=telemetry, profile=profile,
+                              dvfs=dvfs)
 
     return jax.jit(run, donate_argnums=(0,) if donate else ())

@@ -68,6 +68,10 @@ class GoldenResult:
     # per-tile memory-hierarchy counters ({name: np.ndarray[T]}), None
     # when the run had no memory model
     mem_counters: dict | None = None
+    # per-tile rejected DVFS_SET requests (engine: `dvfs.errors`)
+    dvfs_errors: np.ndarray | None = None
+    # per-tile final CORE-domain frequency after in-trace retunes
+    core_freq_mhz: np.ndarray | None = None
 
 
 class _Net:
@@ -394,9 +398,21 @@ def run_golden(sim_config, batch: TraceBatch,
     T = batch.n_tiles
     # per-tile core frequency comes from the CORE DVFS domain, exactly as
     # the simulator initializes it (`simulator.py` core_freq)
-    from graphite_tpu.models.dvfs import module_freq_mhz
+    from graphite_tpu.models.dvfs import DvfsParams, module_freq_mhz
 
     freq_mhz = int(module_freq_mhz(cfg, "CORE"))
+    # per-tile V/f state for in-trace DVFS_SET (mirrors the engine's
+    # legacy per-tile table: AUTO picks the minimum voltage for the
+    # frequency, HOLD keeps the current voltage and fails above its
+    # maximum, invalid requests count and leave state unchanged; the
+    # retune itself is zero-cost).  Core instruction costs read the
+    # issuing tile's CORE-domain frequency.
+    dvp = DvfsParams.from_config(cfg)
+    dvfs_freq = [[int(f) for f in dvp.domain_freq_mhz] for _ in range(T)]
+    dvfs_volt = [[int(dvp.min_voltage_mv(int(f)))
+                  for f in dvp.domain_freq_mhz] for _ in range(T)]
+    dvfs_errors = [0] * T
+    core_freq = [freq_mhz] * T
 
     # static cost table
     from graphite_tpu.trace.schema import STATIC_COST_KEYS
@@ -571,7 +587,7 @@ def run_golden(sim_config, batch: TraceBatch,
         if op < Op.DYNAMIC_MISC and op != Op.BRANCH:   # static instr
             acc = mem_acc()
             if enabled[0]:
-                t.clock += cycles_to_ps(costs[op], freq_mhz) + acc
+                t.clock += cycles_to_ps(costs[op], core_freq[t.tid]) + acc
                 t.counts["instr"] += 1
         elif op == Op.BRANCH:
             pc = rec(t, "pc") % bp_size
@@ -581,7 +597,7 @@ def run_golden(sim_config, batch: TraceBatch,
             cycles = 1 if ok else bp_penalty
             acc = mem_acc()
             if enabled[0]:
-                t.clock += cycles_to_ps(cycles, freq_mhz) + acc
+                t.clock += cycles_to_ps(cycles, core_freq[t.tid]) + acc
                 t.counts["instr"] += 1
                 t.counts["bp_ok" if ok else "bp_bad"] += 1
         elif op < 20:                                   # dynamic
@@ -595,7 +611,7 @@ def run_golden(sim_config, batch: TraceBatch,
         elif op == Op.BBLOCK:
             acc = mem_acc()
             if enabled[0]:
-                t.clock += cycles_to_ps(aux1, freq_mhz) + acc
+                t.clock += cycles_to_ps(aux1, core_freq[t.tid]) + acc
                 t.counts["instr"] += aux0
         elif op == Op.SEND:
             if isinstance(net, _HbhNet):
@@ -705,7 +721,30 @@ def run_golden(sim_config, batch: TraceBatch,
             if enabled[0]:
                 t.clock += syscall_rt_ps
         elif op == Op.DVFS_SET:
-            pass  # fixed-frequency scope (v1)
+            # zero-cost retune; mirrors the engine's `_dvfs_block`
+            # validation exactly (legacy per-tile table).  aux1 < 0 is
+            # the HOLD encoding: keep the current voltage, the request
+            # must fit under its max frequency.  AUTO picks the minimum
+            # voltage for the frequency.  An invalid domain or an
+            # unachievable frequency counts one error, state untouched.
+            req = abs(aux1)
+            dom = min(max(aux0, 0), dvp.n_domains - 1)
+            valid_dom = 0 <= aux0 < dvp.n_domains
+            auto_mv = dvp.min_voltage_mv(req) if req > 0 else -1
+            if aux1 < 0:  # HOLD: current voltage caps the frequency
+                cap = dvp.max_freq_at_mv(dvfs_volt[t.tid][dom])
+                ok = valid_dom and auto_mv >= 0 and req <= cap
+                new_mv = dvfs_volt[t.tid][dom]
+            else:
+                ok = valid_dom and auto_mv >= 0
+                new_mv = auto_mv
+            if ok:
+                dvfs_freq[t.tid][dom] = req
+                dvfs_volt[t.tid][dom] = new_mv
+                if dom == dvp.core_domain:
+                    core_freq[t.tid] = req
+            else:
+                dvfs_errors[t.tid] += 1
         else:
             raise NotImplementedError(f"golden: op {op}")
         if advance:
@@ -741,4 +780,6 @@ def run_golden(sim_config, batch: TraceBatch,
         mem_counters=(
             {k: np.asarray(v, np.int64) for k, v in mem.counters.items()}
             if mem is not None else None),
+        dvfs_errors=np.asarray(dvfs_errors, np.int64),
+        core_freq_mhz=np.asarray(core_freq, np.int64),
     )

@@ -152,6 +152,10 @@ class DvfsParams:
     core_domain: int            # index of the domain containing CORE
     sync_delay_cycles: int
     domain_freq_mhz: tuple      # initial frequency per domain
+    # domain index per DVFS_MODULES entry (unlisted modules fold into
+    # domain 0) — lets the runtime DVFS manager map a counter/price term
+    # to its operating point without re-parsing the config
+    module_domains: tuple = ()
 
     @classmethod
     def from_config(cls, cfg: ConfigFile) -> "DvfsParams":
@@ -173,6 +177,8 @@ class DvfsParams:
             core_domain=core_dom,
             sync_delay_cycles=synchronization_delay_cycles(cfg),
             domain_freq_mhz=tuple(f for f, _ in domains),
+            module_domains=tuple(
+                max(module_domain_index(cfg, m), 0) for m in DVFS_MODULES),
         )
 
     def min_voltage_mv(self, freq_mhz: int) -> int:

@@ -50,7 +50,7 @@ _BIG = 2**62
 # since-last-sample DELTA of a monotone cumulative per-tile counter
 # (differenced on device against the `prev` snapshot in ProfileState,
 # so ring wraparound never corrupts — exactly the round-9 discipline).
-PROFILE_LEVEL_SERIES = ("clock_skew_ps",)
+PROFILE_LEVEL_SERIES = ("clock_skew_ps", "freq_mhz")
 
 # Always-available per-tile series (state the core carry already holds
 # as [T] lanes).  Names shared with the scalar telemetry ring
@@ -81,6 +81,12 @@ PROFILE_MEM_SERIES = (
 # scalar series — never part of the dense default, so locked programs
 # are untouched).
 PROFILE_ENERGY_SERIES = ("energy_pj",)
+
+# Per-tile operating frequency (round 19, opt-in via ProfileSpec.dvfs —
+# same never-in-the-dense-default rule, so locked programs with
+# series=None resolve unchanged).  A LEVEL series: the sampled MHz, not
+# a delta.
+PROFILE_DVFS_SERIES = ("freq_mhz",)
 
 
 def available_tile_series(params) -> "tuple[str, ...]":
@@ -113,6 +119,11 @@ class ProfileSpec:
     series: "tuple[str, ...] | None" = None
     # per-event pJ prices enabling the per-tile energy_pj series
     energy_prices: "EnergyPrices | None" = None
+    # True makes the per-tile freq_mhz series available (round 19 —
+    # pair with a Simulator dvfs= spec to watch transitions; the core
+    # carry always holds the [T] frequency, so the flag only gates the
+    # series offering, keeping series=None resolutions unchanged)
+    dvfs: bool = False
     # filled by resolve(): the program's tile count (the ring's T axis)
     n_tiles: int = 0
 
@@ -143,6 +154,12 @@ class ProfileSpec:
             raise ValueError(
                 "the per-tile energy_pj series needs "
                 "ProfileSpec.energy_prices (an obs.EnergyPrices)")
+        if self.dvfs:
+            avail = avail + PROFILE_DVFS_SERIES
+        elif self.series is not None \
+                and any(s in PROFILE_DVFS_SERIES for s in self.series):
+            raise ValueError(
+                "the per-tile freq_mhz series needs ProfileSpec.dvfs=True")
         if self.series is None:
             sel = avail
         else:
@@ -237,7 +254,7 @@ def init_profile(spec: ProfileSpec) -> ProfileState:
     )
 
 
-def _tile_series_values(spec: ProfileSpec, state) -> jax.Array:
+def _tile_series_values(spec: ProfileSpec, state, dvfs=None) -> jax.Array:
     """The CUMULATIVE value of every selected series, int64[T, m].
     Delta series are differenced against `ProfileState.prev` by the
     tick."""
@@ -245,6 +262,8 @@ def _tile_series_values(spec: ProfileSpec, state) -> jax.Array:
     clocks = core.clock_ps
     vals = {}
     sel = set(spec.series)
+    if "freq_mhz" in sel:
+        vals["freq_mhz"] = core.freq_mhz.astype(I64)
     if "clock_skew_ps" in sel:
         # skew vs the laggard: the same jnp.min baseline the scalar
         # ring's clock_min_ps level records, so max-over-tiles of this
@@ -289,14 +308,15 @@ def _tile_series_values(spec: ProfileSpec, state) -> jax.Array:
             raise ValueError("energy_pj selected without energy_prices")
         # the ONE energy ladder (obs/telemetry.tile_energy_pj): the
         # scalar series is jnp.sum of exactly this vector
-        vals["energy_pj"] = tile_energy_pj(ep, state)
+        vals["energy_pj"] = tile_energy_pj(ep, state, dvfs)
     missing = [s for s in spec.series if s not in vals]
     if missing:
         raise ValueError(f"series {missing} unavailable in this program")
     return jnp.stack([vals[s].astype(I64) for s in spec.series], axis=1)
 
 
-def profile_tick(spec: ProfileSpec, state, px=None) -> ProfileState:
+def profile_tick(spec: ProfileSpec, state, px=None, dvfs=None
+                 ) -> ProfileState:
     """One outer-loop quantum's profile update (device-side, traced).
 
     The boundary test is the SAME arithmetic as `telemetry_tick` —
@@ -329,7 +349,7 @@ def profile_tick(spec: ProfileSpec, state, px=None) -> ProfileState:
                                     jnp.asarray(_BIG, I64)))
     sim_time = jnp.where(all_done, jnp.max(clocks), pending_min)
 
-    cur = _tile_series_values(spec, state)                 # [T, m]
+    cur = _tile_series_values(spec, state, dvfs)           # [T, m]
     if px is not None and px.sharded:
         cur = px.lo(cur)                                   # [Tl, m]
     do = (sim_time >= ps.next_ps) | all_done

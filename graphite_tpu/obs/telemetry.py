@@ -159,39 +159,62 @@ class EnergyPrices:
         )
 
 
-def tile_energy_pj(ep: EnergyPrices, state) -> jax.Array:
+def tile_energy_pj(ep: EnergyPrices, state, dvfs=None) -> jax.Array:
     """Cumulative per-tile event energy int64[T] — THE definition of
     the energy ladder, shared by the scalar `energy_pj` series (which
     reduces it with jnp.sum) and the round-16 per-tile profile series
     (which records it as-is), so the per-tile column sums over T to
     the scalar column exactly and a new price term cannot land in one
     ring but not the other.  Integer pJ prices fold as literals into a
-    few multiply-adds; zero-priced terms add no ops at all."""
+    few multiply-adds; zero-priced terms add no ops at all.
+
+    With `dvfs` (a `models.dvfs.DvfsParams`) and a runtime DVFS carry
+    attached (`SimState.dvfs_rt`), each term is scaled by its module's
+    domain V²·f factor (Q16 integer, level 0 = the prices' reference
+    point): events-to-date priced at the domain's CURRENT operating
+    point — exact whenever the domain's frequency is constant over the
+    measurement window (the campaign case), an at-current-point
+    approximation across in-window transitions.  `dvfs=None` (the
+    default) traces the identical jaxpr as before round 19."""
     core = state.core
     T = core.clock_ps.shape[0]
+    if dvfs is not None and getattr(state, "dvfs_rt", None) is not None:
+        from graphite_tpu.dvfs.levels import energy_scale_q16
+
+        rt = state.dvfs_rt
+        sc = energy_scale_q16(dvfs, rt.domain_mhz, rt.domain_mv)
+        dom = dvfs.module_domains
+
+        def _at_point(val, module):
+            return (val * sc[dom[module]]) >> 16
+    else:
+        def _at_point(val, module):
+            return val
+    # term -> models.dvfs.DVFS_MODULES index (CORE, L1_ICACHE, L1_DCACHE,
+    # L2_CACHE, DIRECTORY, NETWORK_USER, NETWORK_MEMORY)
     e = jnp.zeros((T,), I64)
     if ep.instruction_pj:
-        e = e + core.instruction_count * ep.instruction_pj
+        e = e + _at_point(core.instruction_count * ep.instruction_pj, 0)
     if ep.packet_pj:
-        e = e + state.net.packets_sent * ep.packet_pj
+        e = e + _at_point(state.net.packets_sent * ep.packet_pj, 5)
     if state.mem is not None:
         mc = state.mem.counters
         terms = (
-            (ep.l1i_access_pj, (mc.l1i_hits, mc.l1i_misses)),
-            (ep.l1d_access_pj, (mc.l1d_read_hits, mc.l1d_read_misses,
-                                mc.l1d_write_hits, mc.l1d_write_misses)),
-            (ep.l2_access_pj, (mc.l2_hits, mc.l2_misses)),
-            (ep.l2_miss_pj, (mc.l2_misses,)),
-            (ep.invalidation_pj, (mc.invalidations,)),
-            (ep.eviction_pj, (mc.evictions,)),
-            (ep.dram_access_pj, (mc.dram_reads, mc.dram_writes)),
+            (ep.l1i_access_pj, 1, (mc.l1i_hits, mc.l1i_misses)),
+            (ep.l1d_access_pj, 2, (mc.l1d_read_hits, mc.l1d_read_misses,
+                                   mc.l1d_write_hits, mc.l1d_write_misses)),
+            (ep.l2_access_pj, 3, (mc.l2_hits, mc.l2_misses)),
+            (ep.l2_miss_pj, 3, (mc.l2_misses,)),
+            (ep.invalidation_pj, 4, (mc.invalidations,)),
+            (ep.eviction_pj, 3, (mc.evictions,)),
+            (ep.dram_access_pj, 6, (mc.dram_reads, mc.dram_writes)),
         )
-        for price, arrs in terms:
+        for price, module, arrs in terms:
             if price:
                 n = arrs[0]
                 for a in arrs[1:]:
                     n = n + a
-                e = e + n * price
+                e = e + _at_point(n * price, module)
     elif ep.needs_mem():
         raise ValueError(
             "energy_prices price memory events but this program has no "
@@ -353,7 +376,7 @@ def init_telemetry(spec: TelemetrySpec) -> TelemetryState:
 
 
 def _series_values(spec: TelemetrySpec, state, ts: TelemetryState,
-                   sim_time: jax.Array) -> jax.Array:
+                   sim_time: jax.Array, dvfs=None) -> jax.Array:
     """The CUMULATIVE value of every selected series, int64[n_series].
     Delta series are differenced against `ts.prev` by the tick."""
     core = state.core
@@ -393,7 +416,7 @@ def _series_values(spec: TelemetrySpec, state, ts: TelemetryState,
         ep = spec.energy_prices
         if ep is None:
             raise ValueError("energy_pj selected without energy_prices")
-        vals["energy_pj"] = jnp.sum(tile_energy_pj(ep, state))
+        vals["energy_pj"] = jnp.sum(tile_energy_pj(ep, state, dvfs))
     skip_names = [s for s in spec.series if s.startswith(SKIP_PREFIX)]
     if skip_names:
         if state.mem is None:
@@ -410,8 +433,8 @@ def _series_values(spec: TelemetrySpec, state, ts: TelemetryState,
 
 
 def telemetry_tick(spec: TelemetrySpec, state, *,
-                   progress: jax.Array, blk_iters: jax.Array
-                   ) -> TelemetryState:
+                   progress: jax.Array, blk_iters: jax.Array,
+                   dvfs=None) -> TelemetryState:
     """One outer-loop quantum's telemetry update (device-side, traced).
 
     Advances the cumulative loop counters, then — when simulated time
@@ -440,7 +463,7 @@ def telemetry_tick(spec: TelemetrySpec, state, *,
         stall_quanta=ts.stall_quanta + zero.astype(I64),
     )
 
-    cur = _series_values(spec, state, ts, sim_time)
+    cur = _series_values(spec, state, ts, sim_time, dvfs)
     # the completing quantum records a final row (the chunked sampler's
     # sample-at-done), making the last cumulative state always visible
     do = (sim_time >= ts.next_ps) | all_done

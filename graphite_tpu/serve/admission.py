@@ -6,7 +6,10 @@ entirely from host-side arithmetic (no tracing, no compile):
  - *which program class* does it belong to?  Jobs co-batch only when
    they provably share one compiled program: same config digest, same
    tile count, same memory-ness, same telemetry spec, same per-tile
-   profile spec, the same
+   profile spec, the same runtime-DVFS spec (the carried-frequency
+   reads are baked into the program — differing domain configurations
+   never co-batch, while `dvfs_domain_mhz` knob points of ONE spec
+   do), the same
    bucketed mailbox depth / trace length (lengths and depths round up
    to powers of two so successive batches share one [B, T, L] shape —
    and therefore one program-cache entry), and — round 18 — the same
@@ -222,6 +225,7 @@ class JobClass:
                  n_devices: int = 1, measure: "JobMeasure | None" = None):
         self.key = key
         self.config = job.resolved_config()
+        self.dvfs = job.dvfs
         self.mailbox_depth = int(mailbox_depth)
         self.pad_length = int(pad_length)
         self.fifo: "collections.deque[Pending]" = collections.deque()
@@ -327,8 +331,14 @@ class AdmissionController:
         prof_key = None if prof is None else (
             int(prof.sample_interval_ps), int(prof.n_samples),
             prof.series, prof.energy_prices)
+        # the runtime-DVFS spec splits classes the same way: a DvfsSpec
+        # (frozen, hashable) bakes the carried-frequency reads and the
+        # governor into the lowering; dvfs=None jobs keep the historical
+        # program.  The per-point dvfs_domain_mhz knob is absent here on
+        # purpose — points of one spec share the compiled program.
         base = (config_digest(job.resolved_config()), job.n_tiles,
-                job.has_mem_trace(), depth, length, tel_key, prof_key)
+                job.has_mem_trace(), depth, length, tel_key, prof_key,
+                job.dvfs)
         # round 18: the DEVICE LAYOUT axis.  A 2D batch x tile class
         # lowers a different program than a solo class (the shard_map
         # mesh, specs and exchange are part of the artifact), so the

@@ -83,10 +83,15 @@ class Job:
     this job (jobs with different specs never co-batch — the ring is
     baked into the program).  `profile`: an `obs.ProfileSpec` to record
     the per-tile spatial profile ring (same never-co-batch rule — the
-    [S, T, m] ring is baked in too).  `clock_scheme`: override the
-    config's clock-skew management scheme (CLOCK_SCHEMES); None keeps
-    the config's own.  `seed`: metadata echoed into the result
-    envelope.
+    [S, T, m] ring is baked in too).  `dvfs`: a `dvfs.DvfsSpec`
+    attaching the runtime DVFS manager (per-domain carried frequencies;
+    same never-co-batch rule — the carried-frequency reads are baked
+    into the program, so jobs with differing specs split classes); a
+    `dvfs_domain_mhz` knob then seeds this job's operating point and
+    co-batches with other points of the same spec.  `clock_scheme`:
+    override the config's clock-skew management scheme (CLOCK_SCHEMES);
+    None keeps the config's own.  `seed`: metadata echoed into the
+    result envelope.
     """
 
     job_id: str
@@ -95,6 +100,7 @@ class Job:
     knobs: dict = dataclasses.field(default_factory=dict)
     telemetry: object = None     # obs.TelemetrySpec | None
     profile: object = None       # obs.ProfileSpec | None
+    dvfs: object = None          # dvfs.DvfsSpec | None
     seed: "int | None" = None
     clock_scheme: "str | None" = None
 
@@ -124,7 +130,9 @@ class Job:
     def validate(self, *, validate_trace: bool = True) -> None:
         """Every statically provable admission check; raises ValueError
         (or `trace.validate.TraceValidationError`) naming the problem."""
-        from graphite_tpu.sweep.knobs import KNOB_FIELDS
+        from graphite_tpu.sweep.knobs import (
+            ALL_KNOB_FIELDS, DVFS_KNOB_FIELD,
+        )
 
         if self.clock_scheme is not None \
                 and self.clock_scheme not in CLOCK_SCHEMES:
@@ -136,11 +144,11 @@ class Job:
             raise ValueError(
                 f"job {self.job_id!r}: trace has {self.n_tiles} tiles "
                 f"but the config expects {sc.application_tiles}")
-        unknown = set(self.knobs) - set(KNOB_FIELDS)
+        unknown = set(self.knobs) - set(ALL_KNOB_FIELDS)
         if unknown:
             raise ValueError(
                 f"job {self.job_id!r}: unknown knob(s) {sorted(unknown)} "
-                f"(valid: {', '.join(KNOB_FIELDS)})")
+                f"(valid: {', '.join(ALL_KNOB_FIELDS)})")
         if "quantum_ps" in self.knobs:
             if self.effective_scheme() != "lax_barrier":
                 raise ValueError(
@@ -151,7 +159,19 @@ class Job:
                 raise ValueError(
                     f"job {self.job_id!r}: quantum_ps must be positive")
         for k, v in self.knobs.items():
+            if k == DVFS_KNOB_FIELD:
+                vals = [int(x) for x in v]   # a per-domain int vector
+                if not vals or any(x <= 0 for x in vals):
+                    raise ValueError(
+                        f"job {self.job_id!r}: {DVFS_KNOB_FIELD} must "
+                        "be a non-empty vector of positive MHz values")
+                continue
             int(v)  # raises if not int-coercible
+        if DVFS_KNOB_FIELD in self.knobs and self.dvfs is None:
+            raise ValueError(
+                f"job {self.job_id!r}: the {DVFS_KNOB_FIELD} knob needs "
+                "dvfs=DvfsSpec(...) on the job (the carried-frequency "
+                "program is opt-in)")
         if self.telemetry is not None:
             from graphite_tpu.obs.telemetry import TelemetrySpec
 
@@ -166,6 +186,12 @@ class Job:
                 raise ValueError(
                     f"job {self.job_id!r}: profile must be an "
                     f"obs.ProfileSpec")
+        if self.dvfs is not None:
+            from graphite_tpu.dvfs.runtime import DvfsSpec
+
+            if not isinstance(self.dvfs, DvfsSpec):
+                raise ValueError(
+                    f"job {self.job_id!r}: dvfs must be a dvfs.DvfsSpec")
         if validate_trace:
             from graphite_tpu.trace.validate import validate_batch
 
@@ -216,7 +242,10 @@ class JobResult:
         if self.seed is not None:
             row["seed"] = int(self.seed)
         if self.knob_point:
-            row.update({k: int(v) for k, v in self.knob_point.items()})
+            row.update({
+                k: (tuple(int(x) for x in v) if isinstance(
+                    v, (tuple, list)) else int(v))
+                for k, v in self.knob_point.items()})
         if self.ok and self.results is not None:
             r = self.results
             row.update({
@@ -228,6 +257,14 @@ class JobResult:
             })
             if self.telemetry is not None:
                 row["telemetry_samples"] = len(self.telemetry)
+                if "energy_pj" in getattr(self.telemetry, "series", ()):
+                    col = self.telemetry.col("energy_pj")
+                    if len(col) and not self.telemetry.wrapped:
+                        # a delta series: the unwrapped sum is the job's
+                        # total energy at its operating point(s) — the
+                        # trade-curve's y-axis (wrapped rings undercount,
+                        # so the field is omitted rather than wrong)
+                        row["energy_pj"] = int(col.sum())
             if self.profile is not None:
                 row["profile_samples"] = len(self.profile)
         if self.timings:

@@ -639,6 +639,7 @@ class CampaignService:
         digest = cls.key[0][:8]
         tel = "-tel" if cls.telemetry is not None else ""
         tel += "-prof" if cls.profile is not None else ""
+        tel += "-dvfs" if getattr(cls, "dvfs", None) is not None else ""
         # round 18: 2D classes carry their mesh in the name — the
         # layout tag is in the key (injective hash below), but a
         # readable "-2d2x2" names the program a human greps for
@@ -678,6 +679,17 @@ class CampaignService:
         traces = [j.trace for j in jobs] + [jobs[0].trace] * (B - n)
         points = [dict(j.knobs) for j in jobs] \
             + [dict(jobs[0].knobs)] * (B - n)
+        if getattr(cls, "dvfs", None) is not None:
+            from graphite_tpu.sweep.knobs import DVFS_KNOB_FIELD
+
+            if any(DVFS_KNOB_FIELD in p for p in points):
+                # jobs of one DVFS class co-batch whether or not they
+                # sweep the operating point; absent points run at the
+                # config's default domain frequencies
+                default = tuple(int(f)
+                                for f in cls.params.dvfs.domain_freq_mhz)
+                for p in points:
+                    p.setdefault(DVFS_KNOB_FIELD, default)
         pack = pack_traces(traces, validate=False,
                            pad_length=cls.pad_length)
         # the budget is passed as an INT always: 0 explicitly disables
@@ -696,7 +708,7 @@ class CampaignService:
             mailbox_depth=cls.mailbox_depth,
             hbm_budget_bytes=self.hbm_budget_bytes,
             telemetry=cls.telemetry,
-            profile=cls.profile, **layout_kw)
+            profile=cls.profile, dvfs=cls.dvfs, **layout_kw)
         self._last_layout = runner.layout_name
         self._last_residency = int(
             runner.residency_breakdown()["total"])

@@ -28,6 +28,10 @@ Knob semantics (all integers):
                       handoffs, `cache.cc:559-567`)
   quantum_ps          lax_barrier quantum (`carbon_sim.cfg:92-97`);
                       ignored under the lax / lax_p2p schemes
+  dvfs_domain_mhz     optional [n_domains] vector: per-point seed for the
+                      runtime DVFS carry (dvfs/runtime.py) — requires a
+                      DvfsSpec on the sweep's Simulator; never applied
+                      onto MemParams
 """
 
 from __future__ import annotations
@@ -50,6 +54,13 @@ MEM_KNOB_FIELDS = (
     "sync_delay_cycles",
 )
 KNOB_FIELDS = MEM_KNOB_FIELDS + ("quantum_ps",)
+# optional per-domain frequency vector (runtime DVFS manager): an extra
+# [n_domains] / [B, n_domains] leaf that seeds SimState.dvfs_rt per sweep
+# point (sweep/runner.py) instead of being applied onto MemParams — the
+# engines then read the carried frequencies, so one compiled program
+# serves a whole domain-frequency grid
+DVFS_KNOB_FIELD = "dvfs_domain_mhz"
+ALL_KNOB_FIELDS = KNOB_FIELDS + (DVFS_KNOB_FIELD,)
 
 
 @struct.dataclass
@@ -62,6 +73,9 @@ class Knobs:
     hop_latency_cycles: jax.Array
     sync_delay_cycles: jax.Array
     quantum_ps: jax.Array
+    # [n_domains] ([B, n_domains] batched) per-domain MHz, or None (no
+    # pytree leaf — sweeps without a DVFS axis lower bit-identically)
+    dvfs_domain_mhz: "jax.Array | None" = None
 
     @classmethod
     def from_params(cls, params, quantum_ps: "int | None" = None) -> "Knobs":
@@ -92,15 +106,34 @@ class Knobs:
         Each dict maps knob-field name -> int; absent fields take the
         baseline's value.  Row b of every leaf is point b."""
         cols = {f: [] for f in KNOB_FIELDS}
+        dv_rows = []
         for i, p in enumerate(points):
-            unknown = set(p) - set(KNOB_FIELDS)
+            unknown = set(p) - set(ALL_KNOB_FIELDS)
             if unknown:
                 raise ValueError(
                     f"point {i}: unknown knob(s) {sorted(unknown)} "
-                    f"(valid: {', '.join(KNOB_FIELDS)})")
+                    f"(valid: {', '.join(ALL_KNOB_FIELDS)})")
             for f in KNOB_FIELDS:
                 cols[f].append(int(p.get(f, getattr(base, f))))
-        return cls(**{f: jnp.asarray(cols[f], I64) for f in KNOB_FIELDS})
+            dv_rows.append(p.get(DVFS_KNOB_FIELD, base.dvfs_domain_mhz))
+        dv = None
+        if any(r is not None for r in dv_rows):
+            rows = []
+            for i, r in enumerate(dv_rows):
+                if r is None:
+                    raise ValueError(
+                        f"point {i}: missing {DVFS_KNOB_FIELD} — once any "
+                        "point sweeps the domain-frequency vector, every "
+                        "point (or the baseline) must carry one")
+                rows.append(tuple(int(x) for x in jnp.asarray(r).reshape(-1)))
+            widths = {len(r) for r in rows}
+            if len(widths) != 1:
+                raise ValueError(
+                    f"{DVFS_KNOB_FIELD} rows disagree on n_domains: "
+                    f"{sorted(widths)}")
+            dv = jnp.asarray(rows, I64)
+        return cls(**{f: jnp.asarray(cols[f], I64) for f in KNOB_FIELDS},
+                   dvfs_domain_mhz=dv)
 
     @property
     def batch(self) -> "int | None":
@@ -110,19 +143,24 @@ class Knobs:
 
     def point(self, b: int) -> dict:
         """Host dict of point b's values (for reports / JSON lines)."""
-        return {f: int(jnp.asarray(getattr(self, f)).reshape(-1)[b])
-                for f in KNOB_FIELDS}
+        out = {f: int(jnp.asarray(getattr(self, f)).reshape(-1)[b])
+               for f in KNOB_FIELDS}
+        if self.dvfs_domain_mhz is not None:
+            dv = jnp.asarray(self.dvfs_domain_mhz)
+            row = dv[b] if dv.ndim == 2 else dv
+            out[DVFS_KNOB_FIELD] = tuple(int(x) for x in row)
+        return out
 
 
 def grid_points(**axes) -> "list[dict]":
     """Cross product of knob axes into override dicts, row-major in the
     given keyword order: grid_points(dram_latency_ns=[50, 100],
     hop_latency_cycles=[1, 2]) -> 4 points."""
-    unknown = set(axes) - set(KNOB_FIELDS)
+    unknown = set(axes) - set(ALL_KNOB_FIELDS)
     if unknown:
         raise ValueError(
             f"unknown knob axis(es) {sorted(unknown)} "
-            f"(valid: {', '.join(KNOB_FIELDS)})")
+            f"(valid: {', '.join(ALL_KNOB_FIELDS)})")
     names = list(axes)
     return [dict(zip(names, vals))
             for vals in itertools.product(*(axes[n] for n in names))]

@@ -105,7 +105,11 @@ class SweepRunner:
     into `SweepOutcome.timelines` / each result's `.telemetry`;
     `profile=obs.ProfileSpec(...)` likewise records one per-tile ring
     PER SIM ([B, S, T, m] total), demuxed into `SweepOutcome.profiles`
-    / each result's `.profile` — under both vmap and batch shard_map.
+    / each result's `.profile` — under both vmap and batch shard_map;
+    `dvfs=dvfs.DvfsSpec(...)` attaches the runtime DVFS manager to
+    every sim, and a `dvfs_domain_mhz` knob axis then seeds each
+    point's per-domain operating frequencies so ONE compiled program
+    sweeps a whole domain-frequency grid (the race-to-idle study).
 
     Four batching programs, chosen by `layout` (or the legacy
     `shard_batch` kwarg):
@@ -238,6 +242,30 @@ class SweepRunner:
                     "scheme has no lax_barrier quantum (the knob would "
                     "be reported yet never enter the program)")
         self.knobs = Knobs.stack(base, points)
+        if self.knobs.dvfs_domain_mhz is not None:
+            # the domain-frequency axis seeds the runtime DVFS carry, so
+            # a DvfsSpec must be attached (it bakes the carried-frequency
+            # reads into the program); validate the grid host-side — the
+            # traced seed path clamps instead of raising
+            if self.sim.dvfs_spec is None:
+                raise ValueError(
+                    "dvfs_domain_mhz knob points need dvfs=DvfsSpec(...) "
+                    "on the campaign (the carried-frequency program is "
+                    "opt-in; without it the knob would never enter the "
+                    "lowering)")
+            dvp = self.sim.params.dvfs
+            grid = np.asarray(jax.device_get(self.knobs.dvfs_domain_mhz))
+            if grid.shape[-1] != dvp.n_domains:
+                raise ValueError(
+                    f"dvfs_domain_mhz rows have {grid.shape[-1]} "
+                    f"entries but the config defines {dvp.n_domains} "
+                    "domain(s)")
+            top = int(dvp.max_freq_mhz[0])
+            if (grid <= 0).any() or (grid > top).any():
+                raise ValueError(
+                    "dvfs_domain_mhz points must be in (0, "
+                    f"{top}] MHz (the V/f table's top level); got "
+                    f"{sorted(set(grid.reshape(-1).tolist()) - set(range(1, top + 1)))}")
         if self.sim.quantum_ps is not None:
             q = np.asarray(jax.device_get(self.knobs.quantum_ps))
             if (q <= 0).any():
@@ -291,6 +319,12 @@ class SweepRunner:
                         self.sim = self._build_sim(layout)
                         self._sim_lower_gen = self.sim.lower_gen
         self.layout_spec = layout
+        if self.sim.dvfs_spec is not None and isinstance(layout, tuple):
+            raise ValueError(
+                "the runtime DVFS manager does not support tile-sharded "
+                "layouts: the governor and the chip-global election "
+                "reduce over ALL tiles, which a tile shard cannot see "
+                "(use layout='solo' or 'batch')")
         self.shard_batch = layout == "batch"
         self._sims_per_dev = self._sims_per_cell(layout)
         self.layout_name = self._layout_name(layout)
@@ -537,13 +571,36 @@ class SweepRunner:
         unbounded = self.sim.quantum_ps is None
         tel = self.sim.telemetry_spec
         prof = self.sim.profile_spec
+        dv = self.sim.dvfs_spec
 
         def one(state, trace, kn, px=None):
             q = None if unbounded else kn.quantum_ps
             kw = {} if px is None else {"px": px}
+            if dv is not None and kn.dvfs_domain_mhz is not None:
+                # per-point operating seed: rebuild the DVFS carry from
+                # this row's [n_domains] frequencies (AUTO voltage) and
+                # re-broadcast the CORE domain into the tile clocks, so
+                # one compiled program serves the whole frequency grid
+                from graphite_tpu.dvfs.runtime import (
+                    core_freq_tiles, init_dvfs_rt,
+                )
+
+                rt = init_dvfs_rt(params.dvfs, dv,
+                                  domain_mhz=kn.dvfs_domain_mhz)
+                state = state.replace(
+                    dvfs_rt=rt,
+                    core=state.core.replace(freq_mhz=core_freq_tiles(
+                        params.dvfs, rt, state.core.freq_mhz)),
+                    dvfs=state.dvfs.replace(
+                        freq_mhz=jnp.broadcast_to(
+                            rt.domain_mhz[None],
+                            state.dvfs.freq_mhz.shape),
+                        voltage_mv=jnp.broadcast_to(
+                            rt.domain_mv[None],
+                            state.dvfs.voltage_mv.shape)))
             return run_simulation(params, trace, state, q, max_quanta,
                                   knobs=kn, telemetry=tel, profile=prof,
-                                  **kw)
+                                  dvfs=dv, **kw)
 
         if isinstance(self.layout_spec, tuple):
             # the 2D batch x tile mesh: each device holds a tile block

@@ -32,7 +32,11 @@ round-18 2D batch x tile campaign must be bit-identical — results,
 timelines, per-tile profile rings — to the 1D batch layout and to
 sequential solo runs on forced host devices, with the admission
 controller bin-packing a too-big-for-one-device sim across devices
-(rung 12; standalone via --smoke-mesh2d).
+(rung 12; standalone via --smoke-mesh2d), and the round-19 runtime
+DVFS manager must be invisible at the config's own frequencies
+(carried-frequency engines and the B=4 campaign bit-identical to the
+constant-folded ones), match the hand-stepped golden interpreter on
+in-trace DVFS_SET retunes, and govern deterministically (rung 13).
 """
 
 from __future__ import annotations
@@ -583,6 +587,97 @@ def smoke(tiles: int = 16) -> int:
         print(f"{'mesh2d rung (forced 4-device subprocess)':44} "
               f"{'PASS' if rc == 0 else 'FAIL'}")
         failures += 0 if rc == 0 else 1
+
+    # 13) runtime DVFS manager (round 19, dvfs/): (a) attaching a
+    #     DvfsSpec at the config's own domain frequencies must be
+    #     bit-identical to the constant-folded engines — gated +
+    #     ungated MSI and the B=4 campaign (carried frequency is
+    #     mechanism, not policy); (b) an in-trace DVFS_SET retune must
+    #     match the hand-stepped golden interpreter exactly — clocks,
+    #     instruction counts, rejected-set counters — across an
+    #     up-retune, a down-retune, a rejected request and per-tile
+    #     divergence; (c) the reactive governor is deterministic: two
+    #     fresh engines agree bit-for-bit on results AND on the final
+    #     per-domain V/f state.
+    from graphite_tpu.dvfs import DvfsSpec, GovernorSpec
+
+    dv0 = DvfsSpec()
+    for gate, label in ((True, "gated"), (False, "ungated")):
+        r_dv = Simulator(sc, batch, phase_gate=gate, mem_gate_bytes=0,
+                         dvfs=dv0).run()
+        r_ref = Simulator(sc, batch, phase_gate=gate,
+                          mem_gate_bytes=0).run()
+        failures += _compare(f"dvfs at config freq vs folded ({label})",
+                             r_dv, r_ref)
+    out_dv = SweepRunner(sc, sweep_traces, dvfs=dv0).run()
+    for b, s in enumerate(seeds):
+        failures += _compare(f"dvfs-off sweep B=4 sim {b} vs plain",
+                             out_dv.results[b], out.results[b])
+
+    from graphite_tpu.golden.interpreter import run_golden
+    from graphite_tpu.trace.schema import Op, TraceBatch, TraceBuilder
+
+    sc_dv = SimConfig(ConfigFile.from_string("""
+[general]
+total_cores = 2
+mode = lite
+max_frequency = 2.0
+technology_node = 22
+[dvfs]
+synchronization_delay = 2
+domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
+<1.0, DIRECTORY, NETWORK_USER, NETWORK_MEMORY>"
+[network]
+user = magic
+memory = magic
+[core/static_instruction_costs]
+ialu = 1
+[clock_skew_management]
+scheme = lax
+"""))
+
+    def _dv_builders():
+        b0 = TraceBuilder()
+        for _ in range(4):
+            b0.instr(Op.IALU)
+        b0.dvfs_set(0, 2000)            # AUTO up-retune
+        for _ in range(4):
+            b0.instr(Op.IALU)
+        b1 = TraceBuilder()
+        b1.dvfs_set(0, 500)             # AUTO down-retune
+        b1.dvfs_set(0, 5000)            # above table max: rejected
+        for _ in range(3):
+            b1.instr(Op.IALU)
+        return [b0, b1]
+
+    batch_dv = TraceBatch.from_builders(_dv_builders())
+    sim_dv = Simulator(sc_dv, batch_dv)
+    r_eng = sim_dv.run()
+    g = run_golden(sc_dv, batch_dv)
+    ok = (np.array_equal(np.asarray(r_eng.clock_ps), g.clock_ps)
+          and np.array_equal(np.asarray(r_eng.instruction_count),
+                             g.instruction_count)
+          and np.array_equal(np.asarray(sim_dv.state.dvfs.errors),
+                             g.dvfs_errors))
+    print(f"{'in-trace DVFS_SET vs golden oracle':44} "
+          f"{'PASS' if ok else 'FAIL'}")
+    failures += 0 if ok else 1
+
+    gv = DvfsSpec(governor=GovernorSpec(interval_ps=2000, domains=(0,)))
+    gov_runs = []
+    for _ in range(2):
+        sim_g = Simulator(sc_dv, TraceBatch.from_builders(_dv_builders()),
+                          dvfs=gv)
+        r_g = sim_g.run()
+        gov_runs.append((r_g, np.asarray(sim_g.state.dvfs_rt.domain_mhz),
+                         np.asarray(sim_g.state.dvfs_rt.domain_mv)))
+    failures += _compare("governor determinism (results)",
+                         gov_runs[0][0], gov_runs[1][0])
+    ok = (np.array_equal(gov_runs[0][1], gov_runs[1][1])
+          and np.array_equal(gov_runs[0][2], gov_runs[1][2]))
+    print(f"{'governor determinism (final V/f state)':44} "
+          f"{'PASS' if ok else 'FAIL'}")
+    failures += 0 if ok else 1
 
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
     return 1 if failures else 0

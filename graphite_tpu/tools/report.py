@@ -23,7 +23,13 @@ Input kinds, one renderer:
                     occupancy trade curve: one scatter row per job
                     (queue_dwell_us vs its batch's occupancy) plus
                     occupancy-bucketed dwell aggregates — the
-                    measurement half of latency-aware batching;
+                    measurement half of latency-aware batching.  When
+                    the file holds per-job RESULT rows that carry
+                    `energy_pj` + `completion_time_ns` (a DVFS
+                    race-to-idle campaign), the same flag renders the
+                    energy-vs-wall trade instead: one scatter row per
+                    operating point (wall, energy, EDP) plus the
+                    Pareto frontier;
   --metrics FILE    a Prometheus text exposition written by
                     `tools/serve.py --metrics-out` — renders counters/
                     gauges and histogram summaries (count, sum,
@@ -245,10 +251,63 @@ def trade_curve_rows(rows: "list[dict]") -> "tuple[list, list]":
     return scatter, curve
 
 
+def energy_trade_rows(rows: "list[dict]") -> "tuple[list, list]":
+    """Per-job result rows (tools/serve.py output lines, or any JSON
+    lines carrying `energy_pj` + `completion_time_ns`) -> (per-config
+    scatter rows, Pareto frontier rows) of the energy-vs-wall trade —
+    the race-to-idle campaign's headline curve.  Each scatter row
+    carries the operating point (the `dvfs_domain_mhz` knob when
+    present), the simulated wall, the priced energy, and their product
+    (EDP, pJ·ns).  A point is on the frontier when no other point is
+    at least as good on BOTH axes and better on one."""
+    scatter = []
+    for r in rows:
+        if "energy_pj" not in r or "completion_time_ns" not in r:
+            continue
+        s = {"job": r.get("job"),
+             "wall_ns": int(r["completion_time_ns"]),
+             "energy_pj": int(r["energy_pj"])}
+        if "dvfs_domain_mhz" in r:
+            s["dvfs_domain_mhz"] = tuple(
+                int(x) for x in r["dvfs_domain_mhz"]) \
+                if isinstance(r["dvfs_domain_mhz"], (tuple, list)) \
+                else int(r["dvfs_domain_mhz"])
+        s["edp_pj_ns"] = s["wall_ns"] * s["energy_pj"]
+        scatter.append(s)
+    scatter.sort(key=lambda s: (s["wall_ns"], s["energy_pj"]))
+    frontier = []
+    for s in scatter:
+        dominated = any(
+            o is not s
+            and o["wall_ns"] <= s["wall_ns"]
+            and o["energy_pj"] <= s["energy_pj"]
+            and (o["wall_ns"] < s["wall_ns"]
+                 or o["energy_pj"] < s["energy_pj"])
+            for o in scatter)
+        if not dominated:
+            frontier.append({**s, "pareto": True})
+    return scatter, frontier
+
+
 def render_trade_curve(path: str, fmt: str) -> "list[str]":
     from graphite_tpu.obs.trace import load_jsonl
 
-    scatter, curve = trade_curve_rows(load_jsonl(path))
+    rows = load_jsonl(path)
+    if any("energy_pj" in r and "completion_time_ns" in r for r in rows):
+        # energy-vs-wall mode: per-job result rows from a DVFS campaign
+        scatter, frontier = energy_trade_rows(rows)
+        if fmt == "json":
+            return [json.dumps(r) for r in scatter + frontier]
+        cols = ["job", "dvfs_domain_mhz", "wall_ns", "energy_pj",
+                "edp_pj_ns"]
+        frontier_keys = {(f["wall_ns"], f["energy_pj"], f["job"])
+                         for f in frontier}
+        body = [[str(r.get(c, "-")) for c in cols]
+                + ["*" if (r["wall_ns"], r["energy_pj"],
+                           r["job"]) in frontier_keys else ""]
+                for r in scatter]
+        return _align(cols + ["pareto"], body)
+    scatter, curve = trade_curve_rows(rows)
     if fmt == "json":
         return [json.dumps(r) for r in scatter + curve]
     cols = ["job", "batch", "queue_dwell_us", "occupancy", "n_jobs",
@@ -334,7 +393,9 @@ def main(argv=None) -> int:
     ap.add_argument("--trade-curve", metavar="FILE",
                     help="render a span JSON-lines file as the "
                     "latency/occupancy trade curve (per-job queue "
-                    "dwell vs batch occupancy + bucketed aggregates)")
+                    "dwell vs batch occupancy + bucketed aggregates); "
+                    "per-job result rows with energy_pj render as the "
+                    "energy-vs-wall trade + Pareto frontier instead")
     ap.add_argument("--metrics", metavar="FILE",
                     help="render a Prometheus text exposition "
                     "(tools/serve.py --metrics-out) as metric "

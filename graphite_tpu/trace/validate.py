@@ -42,7 +42,7 @@ class TraceValidationError(ValueError):
 @dataclasses.dataclass
 class TraceFinding:
     severity: str
-    kind: str       # "op-range" | "send-recv" | "barrier"
+    kind: str       # "op-range" | "send-recv" | "barrier" | "dvfs"
     message: str
     data: dict = dataclasses.field(default_factory=dict)
 
@@ -241,8 +241,46 @@ def _check_barriers(batch, out, n_barriers=None):
                 data={"id": bar, "arrivals": arrivals, "count": cnt}))
 
 
+def _check_dvfs(batch, out, n_domains=None):
+    """DVFS_SET/DVFS_GET static checks.  aux0 is the domain index (the
+    engine clips it, so an out-of-range domain silently retunes another
+    one — same aliasing hazard as barrier ids); DVFS_SET's aux1 encodes
+    the frequency in MHz, negated for HOLD-voltage requests, so only
+    aux1 == 0 (no frequency at all) is statically malformed — positive
+    out-of-table frequencies are a RUNTIME rejection the engine counts
+    in `dvfs.errors`."""
+    op, aux0, aux1 = batch.op, batch.aux0, batch.aux1
+    dset = op == int(Op.DVFS_SET)
+    dget = op == int(Op.DVFS_GET)
+    if not (dset.any() or dget.any()):
+        return
+    any_d = dset | dget
+    doms = aux0[any_d]
+    bad = doms < 0
+    if n_domains is not None:
+        bad = bad | (doms >= n_domains)
+    if bad.any():
+        vals = sorted({int(v) for v in doms[bad]})[:8]
+        hi = f", {n_domains})" if n_domains is not None else ")"
+        out.append(TraceFinding(
+            SEV_ERROR, "dvfs",
+            f"{int(bad.sum())} DVFS record(s) name domain(s) {vals} "
+            f"outside [0{hi} — the engine clips domain indices, "
+            f"silently retuning another domain",
+            data={"domains": vals}))
+    zero = aux1[dset] == 0
+    if zero.any():
+        out.append(TraceFinding(
+            SEV_ERROR, "dvfs",
+            f"{int(zero.sum())} DVFS_SET record(s) request frequency 0 "
+            f"— a retune must name a positive MHz value (negated for "
+            f"HOLD)",
+            data={"count": int(zero.sum())}))
+
+
 def validate_batch(batch, *, raise_on_error: bool = True,
                    n_barriers: "int | None" = None,
+                   n_domains: "int | None" = None,
                    ) -> "list[TraceFinding]":
     """Static validation of one TraceBatch; returns all findings.
 
@@ -251,11 +289,13 @@ def validate_batch(batch, *, raise_on_error: bool = True,
     raise.  `n_barriers` (the Simulator's barrier-table size, default
     64) tightens the barrier-id range check; negative ids are rejected
     unconditionally (the engine clips ids, so out-of-range ones alias
-    another barrier)."""
+    another barrier).  `n_domains` (the config's DVFS domain count)
+    likewise tightens the DVFS domain-index check."""
     out: "list[TraceFinding]" = []
     _check_op_range(batch, out)
     _check_send_recv(batch, out)
     _check_barriers(batch, out, n_barriers)
+    _check_dvfs(batch, out, n_domains)
     errors = [f for f in out if f.severity == SEV_ERROR]
     if errors and raise_on_error:
         more = f" (+{len(errors) - 1} more)" if len(errors) > 1 else ""

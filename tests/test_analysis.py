@@ -512,13 +512,14 @@ def test_scatter_determinism_const_tables_and_row_axis_limits():
 def test_audit_default_programs_clean():
     """The acceptance gate: gated, ungated, shl2, sweep B=4, the
     telemetry-recording gated engine, the combined sweep+telemetry
-    campaign AND the 2D batch x tile campaign (round 18) all pass
-    every rule — the same call `tools/regress.py --smoke` and
+    campaign, the 2D batch x tile campaign (round 18) AND the
+    multi-domain DVFS campaign (round 19) all pass every rule — the
+    same call `tools/regress.py --smoke` and
     `python -m graphite_tpu.tools.audit` make."""
     report = audit(tiles=8)
     assert {r.program for r in report.results} == {
         "gated-msi", "ungated-msi", "shl2-mesi", "sweep-b4",
-        "gated-msi-tel", "sweep-b4-tel", "sweep-b4-2d"}
+        "gated-msi-tel", "sweep-b4-tel", "sweep-b4-2d", "sweep-b4-dvfs"}
     # the sweep programs must get the knob-fold rule, the others not
     by_prog = {}
     for r in report.results:
@@ -528,6 +529,12 @@ def test_audit_default_programs_clean():
     # the 2D campaign's knobs must stay live THROUGH the shard_map
     # call boundary — knob-fold runs (and passes) on the composition
     assert "knob-fold" in by_prog["sweep-b4-2d"]
+    # the round-19 multi-domain campaign keeps sync_delay_cycles AND
+    # dvfs_domain_mhz live — knob-fold runs (and passes) on it, and
+    # the dvfs-off lint covers every default program WITHOUT a spec
+    assert "knob-fold" in by_prog["sweep-b4-dvfs"]
+    assert "dvfs-off" in by_prog["sweep-b4"]
+    assert "dvfs-off" not in by_prog["sweep-b4-dvfs"]
     assert "knob-fold" not in by_prog["gated-msi"]
     # the combined campaign records telemetry, so the telemetry-off
     # lint must NOT run on it (the ring is policed via cond-payload)
