@@ -36,10 +36,12 @@ from graphite_tpu.analysis.registry import (  # noqa: F401
     record_from_spec, save_lock,
 )
 from graphite_tpu.analysis.rules import (  # noqa: F401
-    Finding, cond_payload, host_sync, knob_fold, phase_conds,
-    scatter_determinism, time_dtype, vmap_gate,
+    Finding, LaneWrite, cond_payload, host_sync, knob_fold,
+    lane_summary, lane_writes, phase_conds, scatter_determinism,
+    time_dtype, vmap_gate, write_race,
 )
 from graphite_tpu.analysis.walk import (  # noqa: F401
     aval_bytes, aval_sig, find_eqns, invar_path_strings, iter_eqns,
-    iter_eqns_with_site, subjaxprs, taint_narrowing, used_invar_mask,
+    iter_eqns_with_site, scatter_row_axes, scatter_writer_proof,
+    subjaxprs, taint_narrowing, used_invar_mask,
 )

@@ -487,8 +487,8 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
 # ---------------------------------------------------------------------------
 
 RULE_NAMES = ("cond-payload", "knob-fold", "time-dtype", "vmap-gate",
-              "host-sync", "scatter-determinism", "telemetry-off",
-              "profile-off", "dvfs-off")
+              "host-sync", "scatter-determinism", "write-race",
+              "telemetry-off", "profile-off", "dvfs-off")
 
 
 @dataclasses.dataclass
@@ -572,6 +572,11 @@ def audit_program(spec: ProgramSpec, *,
     add("host-sync", rules.host_sync(spec.closed))
     add("scatter-determinism", rules.scatter_determinism(
         spec.closed, batched=spec.batched))
+    # the standing gate for the [T, k] mailbox compaction: no rewrite
+    # may turn a req-lane or mailbox-matrix scatter into an
+    # ordered-multi-writer one (analysis/protocol.py's model checker
+    # supplies the reachable fan-in bounds; the gate itself is static)
+    add("write-race", rules.write_race(spec.closed, spec.n_tiles))
     if not spec.expect_telemetry:
         # telemetry-OFF programs must carry no trace of the timeline
         # machinery (ON programs instead police the ring via the

@@ -355,11 +355,17 @@ class _DiffWalker:
                 inner_phase = self._phase_label(self.phase_seen)
                 self.phase_seen += 1
             if len(subs_a) != len(subs_b):
+                # a sub-program count divergence IS a divergence of
+                # this region's program list — report it as eqn-count
+                # and attribute it to the phase the region belongs to
+                # (for a phase cond, its OWN label: `phase` here is the
+                # ENCLOSING phase — None at top level — which loses the
+                # attribution the recursion below would have carried)
                 return DiffEntry(
-                    here, i, "params",
+                    here, i, "eqn-count",
                     f"{ea.primitive.name} has {len(subs_a)} sub-"
                     f"program(s) in A but {len(subs_b)} in B",
-                    phase, str(len(subs_a)), str(len(subs_b)))
+                    inner_phase, str(len(subs_a)), str(len(subs_b)))
             for (tag, sa), (_, sb) in zip(subs_a, subs_b):
                 d = self.walk(sa, sb, f"{here}/{tag}", inner_phase)
                 if d is not None:

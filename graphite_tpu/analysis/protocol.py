@@ -1,0 +1,925 @@
+"""Bounded model checking of the coherence protocols.
+
+The golden interpreters (`golden/memory_model.py`,
+`golden/memory_model_shl2.py`) are the readable, sequential statement of
+the MSI/MOSI/shl2-MESI semantics.  This module drives them as a
+*transition relation*: a configuration is a quiescent protocol state
+(no transaction in flight), and each (tile, line, read|write) access is
+one atomic transition.  BFS over the induced abstract state graph
+exhaustively enumerates every reachable
+
+    (directory entry, per-tile L1/L2 line state, data-freshness)
+
+configuration for small geometries (2-4 tiles, 1-2 lines), checking the
+classic coherence invariants at every state and along every transition:
+
+  - ``single-writer-multiple-reader``: at most one tile holds a writable
+    (M/E) copy, and a writable copy excludes every other copy (MOSI's O
+    is read-only and may coexist with S).
+  - ``data-value``: a read returns the value of the last write.  Checked
+    with a version map evolved from the golden models' event stream
+    (every write bumps a per-line global version; fills take the version
+    of their actual data source — DRAM, the home's cdata buffer, a
+    cache-to-cache supplier, or the shared-L2 slice).
+  - ``directory-cache-agreement``: the directory entry's (dstate, owner,
+    sharers) matches the actual cached copies, and L1 contents stay
+    within L2 (private hierarchy).  The golden models' own internal
+    asserts (a FWD to a non-holder) report under this invariant too.
+  - ``bounded-in-flight``: the number of simultaneously outstanding
+    protocol messages within a transition never exceeds the fan-out
+    bound (T forwards + T acks + request + reply).
+  - ``progress``: every transition completes within the event bound (no
+    deadlock/livelock inside the exploration bound), and the BFS itself
+    closes within ``max_states``.
+
+Violations carry a named counterexample: the action path from reset plus
+the violating transition's event sequence, rendered through the engines'
+round-6 phase names (`engine.PHASE_NAMES` / `engine_shl2.SHL2_PHASE_NAMES`).
+
+On top of the same exploration, the checker measures the per-matrix
+fan-in actually reachable — the max simultaneous occupancy of the
+fwd/ack/evict ``[T, T]`` mailbox matrices per home — which is the input
+the planned ``[T, k]`` bounded-fanin compaction needs (ROADMAP).
+
+Differential mode (`differential`) closes the loop on the *shipped*
+kernels: every explored transition is replayed through the vectorized
+engines (`memory/engine.py`, `memory/engine_shl2.py`) as a
+barrier-serialized trace (the BFS path prefix plus the transition's
+access), asserting bit-equality of clocks and all memory counters
+against `golden.run_golden`, and agreement of the engines' final packed
+state (via `engine.line_census` / `engine_shl2.shl2_line_census`) with
+the model checker's successor configuration.  All replay traces are
+padded to one uniform record count so a single jitted step function
+serves every transition.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from collections import deque
+
+import numpy as np
+
+PROTOCOLS = {
+    "msi": "pr_l1_pr_l2_dram_directory_msi",
+    "mosi": "pr_l1_pr_l2_dram_directory_mosi",
+    "shl2_mesi": "pr_l1_sh_l2_mesi",
+}
+
+INVARIANTS = (
+    "single-writer-multiple-reader",
+    "data-value",
+    "directory-cache-agreement",
+    "bounded-in-flight",
+    "progress",
+)
+
+# line numbers used by the checker: stride 192 keeps every tracked line
+# in the SAME L1 set (16 sets), L2/slice set (64 sets), directory set
+# (8 sets) and home tile for 2-4 tiles, so multi-line exploration
+# exercises victim eviction and directory NULLIFY on a 1-way geometry
+BASE_LINE = 256
+LINE_STRIDE = 192
+LINE_BYTES = 64
+
+# cache_array state names (INVALID/SHARED/MODIFIED/EXCLUSIVE/OWNED) +
+# the shl2 slice's transient DATA_INVALID
+_ST = {0: "I", 1: "S", 2: "M", 3: "E", 4: "O", 5: "DV"}
+_DIRN = {0: "U", 1: "Sh", 2: "M", 3: "O", 4: "E"}
+
+# event kind -> phase-name index (round-6 names; validated against the
+# engines' PHASE_NAMES tuples in tests/test_protocol_mc.py)
+_PRIV_PHASE = {"hit": 0, "evict": 1, "req": 2, "fwd": 2, "serve": 3,
+               "reply": 4, "fill": 5}
+_SHL2_PHASE = {"hit": 0, "serve": 1, "evict": 2, "slice_kill": 2,
+               "reply": 3, "req": 4, "fwd": 4, "slice_fill": 4, "fill": 5}
+
+
+@dataclasses.dataclass(frozen=True)
+class Action:
+    """One transition label: tile `tile` reads or writes `line`."""
+    tile: int
+    line: int
+    write: bool
+
+    def __str__(self):
+        return (f"t{self.tile} {'W' if self.write else 'R'} "
+                f"line {self.line:#x}")
+
+
+@dataclasses.dataclass
+class Violation:
+    invariant: str
+    message: str
+    path: tuple        # Actions from reset up to AND INCLUDING the bad one
+    events: tuple      # rendered event strings of the violating transition
+
+    def render(self) -> str:
+        lines = [f"invariant violated: {self.invariant}",
+                 f"  {self.message}",
+                 "  path from reset:"]
+        lines += [f"    {i}. {a}" for i, a in enumerate(self.path)]
+        lines.append("  events of the violating transition:")
+        lines += [f"    {e}" for e in self.events]
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class MCResult:
+    protocol: str
+    n_tiles: int
+    lines: tuple
+    states_explored: int
+    transitions: int
+    histogram: dict          # feature -> #states containing it
+    fan_in: dict             # matrix -> max reachable simultaneous fan-in
+    max_in_flight: int
+    violations: list
+    # every explored transition as (action sequence ending in it,
+    # successor protocol-state key) — the differential replay's worklist
+    transition_seqs: list = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+@dataclasses.dataclass
+class DiffResult:
+    protocol: str
+    n_transitions: int
+    n_ok: int
+    mismatches: list
+
+    @property
+    def ok(self) -> bool:
+        return self.n_ok == self.n_transitions and not self.mismatches
+
+
+# ---------------------------------------------------------------------------
+# geometry / model construction
+# ---------------------------------------------------------------------------
+
+
+def mc_lines(n_lines: int) -> tuple:
+    return tuple(BASE_LINE + i * LINE_STRIDE for i in range(n_lines))
+
+
+def mc_sim_config(protocol: str, n_tiles: int):
+    """Tiny-geometry SimConfig: 1-way 16-set L1s, 1-way 64-set L2, 2-way
+    8-set directory — small enough that 2 tracked lines collide
+    everywhere (evictions + NULLIFY reachable)."""
+    from graphite_tpu.config import ConfigFile, SimConfig
+
+    text = f"""
+[general]
+total_cores = {n_tiles}
+mode = lite
+max_frequency = 1.0
+enable_shared_mem = true
+[network]
+user = magic
+memory = magic
+[caching_protocol]
+type = {PROTOCOLS[protocol]}
+[core/static_instruction_costs]
+mov = 1
+ialu = 1
+[l1_icache/T1]
+cache_size = 1
+associativity = 1
+[l1_dcache/T1]
+cache_size = 1
+associativity = 1
+[l2_cache/T1]
+cache_size = 4
+associativity = 1
+[dram_directory]
+total_entries = 16
+associativity = 2
+"""
+    return SimConfig(ConfigFile.from_string(text))
+
+
+def make_model(sc, mutant: str | None = None):
+    """A fresh golden model for `sc` (optionally a seeded mutant)."""
+    from graphite_tpu.memory.params import MemParams
+    from graphite_tpu.models.dvfs import module_freq_mhz
+
+    mp = MemParams.from_config(sc)
+    freq = int(module_freq_mhz(sc.cfg, "CORE"))
+    if mp.protocol.startswith("pr_l1_sh_l2"):
+        if mutant is not None:
+            raise ValueError(f"mutant {mutant!r} targets the private"
+                             " protocols")
+        from graphite_tpu.golden.memory_model_shl2 import GoldenShL2
+
+        return GoldenShL2(mp, freq)
+    from graphite_tpu.golden.memory_model import GoldenMemory
+
+    if mutant is None:
+        return GoldenMemory(mp, freq)
+    if mutant not in _MUTANTS:
+        raise ValueError(f"unknown mutant {mutant!r} "
+                         f"(choose from {', '.join(MUTANT_NAMES)})")
+    return _MUTANTS[mutant]()(mp, freq)
+
+
+def _mutant_mosi_owner_skips_wb():
+    """MOSI O/M owner acks a WB fetch without supplying the line: the
+    home fetches stale data from DRAM — a data-value bug the mutant
+    self-test must catch."""
+    from graphite_tpu.golden.memory_model import GoldenMemory
+
+    class MosiOwnerSkipsWb(GoldenMemory):
+        def _serve_fwd(self, s, kind, line, ftime, home, enabled):
+            ack, supplies = super()._serve_fwd(s, kind, line, ftime,
+                                               home, enabled)
+            if kind == "wb":
+                supplies = False
+            return ack, supplies
+
+    return MosiOwnerSkipsWb
+
+
+_MUTANTS = {"mosi-owner-skips-wb": _mutant_mosi_owner_skips_wb}
+MUTANT_NAMES = tuple(_MUTANTS)
+
+
+# ---------------------------------------------------------------------------
+# version map (data-value invariant)
+# ---------------------------------------------------------------------------
+
+
+class _Versions:
+    """Per-line write-version bookkeeping.  `global_v` bumps on every
+    committed write; every physical copy (per-tile hierarchy, DRAM, the
+    private home's cdata buffer, the shl2 slice) carries the version of
+    the data it holds.  A read that observes a version != global_v read
+    stale data."""
+
+    def __init__(self, lines):
+        self.global_v = {ln: 0 for ln in lines}
+        self.dram_v = {ln: 0 for ln in lines}
+        self.cdata_v = {ln: 0 for ln in lines}
+        self.slice_v = {ln: 0 for ln in lines}
+        self.copy_v = {ln: {} for ln in lines}     # tile -> version
+
+
+# ---------------------------------------------------------------------------
+# the per-transition observer
+# ---------------------------------------------------------------------------
+
+
+class _TxnObserver:
+    """Attached as `model.event_cb` for exactly one transition: records
+    the event sequence, evolves the version map, counts in-flight
+    messages and per-matrix fan-in, and flags in-transition violations
+    (data-value, bounded-in-flight, progress)."""
+
+    def __init__(self, versions: _Versions, lines, n_tiles, is_shl2,
+                 is_mosi, event_bound):
+        self.v = versions
+        self.lines = set(lines)
+        self.n_tiles = n_tiles
+        self.is_shl2 = is_shl2
+        self.is_mosi = is_mosi
+        self.event_bound = event_bound
+        self.events = []           # (kind, kw)
+        self.violations = []       # (invariant, message)
+        self.supply_v = {}         # line -> version travelling with acks
+        self.fill_v = {}           # line -> version of the pending reply
+        self.cur_mtype = None      # mtype of the innermost "req"
+        self.outstanding_fwd = 0
+        self.fan = {"req": 0, "fwd": 0, "ack": 0, "evict": 0}
+        self.max_in_flight = 0
+        self._txn_fwd = 0
+        self._txn_ack = 0
+        self._evicts = {}          # home -> count
+
+    # -- helpers -----------------------------------------------------------
+
+    def _flag(self, invariant, message):
+        self.violations.append((invariant, message))
+
+    def _track(self, line):
+        return line in self.lines
+
+    # -- the callback ------------------------------------------------------
+
+    def __call__(self, kind, kw):
+        self.events.append((kind, kw))
+        if len(self.events) > self.event_bound:
+            if len(self.events) == self.event_bound + 1:
+                self._flag("progress",
+                           f"transition exceeded {self.event_bound} "
+                           "protocol events (livelock within bound)")
+            return
+        line = kw.get("line")
+        v = self.v
+        if kind == "req":
+            self.fan["req"] = max(self.fan["req"], 1)
+            self.cur_mtype = kw["mtype"]
+            self._txn_fwd = 0
+            self._txn_ack = 0
+        elif kind == "fwd":
+            self.outstanding_fwd += 1
+            self._txn_fwd = (self.n_tiles if kw.get("broadcast")
+                             else self._txn_fwd + 1)
+            self.fan["fwd"] = max(self.fan["fwd"], self._txn_fwd)
+            # request + outstanding forwards (+ the eventual reply)
+            self.max_in_flight = max(self.max_in_flight,
+                                     1 + self.outstanding_fwd)
+            if self.outstanding_fwd > self.n_tiles:
+                self._flag("bounded-in-flight",
+                           f"{self.outstanding_fwd} forwards in flight "
+                           f"for {self.n_tiles} tiles")
+        elif kind == "serve":
+            self.outstanding_fwd = max(0, self.outstanding_fwd - 1)
+            self._txn_ack += 1
+            self.fan["ack"] = max(self.fan["ack"], self._txn_ack)
+            if self._track(line):
+                t = kw["tile"]
+                held = v.copy_v[line].get(t, v.dram_v[line])
+                if kw["supplies"]:
+                    self.supply_v[line] = held
+                    if self.is_shl2:
+                        # dirty ack data lands in the home slice
+                        v.slice_v[line] = held
+                    elif kw["kind"] == "flush" \
+                            and self.cur_mtype == "nullify":
+                        # NULLIFY flush: the dying entry's dirty data
+                        # goes back to DRAM (`processNullifyReq`)
+                        v.dram_v[line] = held
+                if kw["kind"] in ("inv", "flush"):
+                    v.copy_v[line].pop(t, None)
+                elif kw["kind"] == "wb" and not self.is_shl2 \
+                        and not self.is_mosi:
+                    v.dram_v[line] = held           # MSI WB write-through
+        elif kind == "evict":
+            home = kw["home"]
+            self._evicts[home] = self._evicts.get(home, 0) + 1
+            self.fan["evict"] = max(self.fan["evict"], self._evicts[home])
+            if self._track(line):
+                src = kw["src"]
+                held = v.copy_v[line].pop(src, v.dram_v[line])
+                if kw["dirty"]:
+                    if self.is_shl2:
+                        v.slice_v[line] = held      # L1 flush -> slice
+                    else:
+                        v.cdata_v[line] = held      # parked in cdata
+                        v.dram_v[line] = held       # and written through
+        elif kind == "slice_fill":
+            if self._track(line):
+                v.slice_v[line] = v.dram_v[line]
+        elif kind == "slice_kill":
+            if self._track(line) and kw["dirty"]:
+                v.dram_v[line] = v.slice_v[line]
+        elif kind == "reply":
+            if self._track(line):
+                src = kw["source"]
+                if src == "c2c":
+                    self.fill_v[line] = self.supply_v.get(
+                        line, v.dram_v[line])
+                elif src == "cdata":
+                    self.fill_v[line] = v.cdata_v[line]
+                elif src == "slice":
+                    self.fill_v[line] = v.slice_v[line]
+                else:
+                    self.fill_v[line] = v.dram_v[line]
+        elif kind == "hit":
+            if self._track(line):
+                t = kw["tile"]
+                held = v.copy_v[line].get(t, -1)
+                if held != v.global_v[line]:
+                    self._flag(
+                        "data-value",
+                        f"t{t} {'write' if kw['write'] else 'read'} hit "
+                        f"observes version {held} of line {line:#x}, "
+                        f"last write is {v.global_v[line]}")
+                if kw["write"]:
+                    v.global_v[line] += 1
+                    v.copy_v[line][t] = v.global_v[line]
+        elif kind == "fill":
+            if self._track(line):
+                t = kw["tile"]
+                got = self.fill_v.get(line, v.dram_v[line])
+                if got != v.global_v[line]:
+                    self._flag(
+                        "data-value",
+                        f"t{t} {'write' if kw['write'] else 'read'} fill "
+                        f"receives version {got} of line {line:#x}, "
+                        f"last write is {v.global_v[line]}")
+                v.copy_v[line][t] = got
+                if kw["write"]:
+                    v.global_v[line] += 1
+                    v.copy_v[line][t] = v.global_v[line]
+
+
+def render_event(protocol: str, kind: str, kw: dict) -> str:
+    """One event line of a counterexample, named by its engine phase."""
+    if protocol == "shl2_mesi":
+        from graphite_tpu.memory.engine_shl2 import SHL2_PHASE_NAMES
+        phase = SHL2_PHASE_NAMES[_SHL2_PHASE[kind]]
+    else:
+        from graphite_tpu.memory.engine import PHASE_NAMES
+        phase = PHASE_NAMES[_PRIV_PHASE[kind]]
+    line = kw.get("line", -1)
+    if kind == "req":
+        desc = (f"{kw['mtype'].upper()} req t{kw['requester']} -> "
+                f"home t{kw['home']}, line {line:#x}")
+    elif kind == "fwd":
+        desc = (f"home t{kw['home']} -> t{kw['target']}: "
+                f"{kw['kind'].upper()} line {line:#x}"
+                + (" (broadcast)" if kw.get("broadcast") else ""))
+    elif kind == "serve":
+        desc = (f"t{kw['tile']} acks {kw['kind'].upper()} line {line:#x}"
+                + (", supplies data" if kw["supplies"] else ""))
+    elif kind == "evict":
+        desc = (f"t{kw['src']} evicts line {line:#x} -> home t{kw['home']}"
+                + (" (dirty)" if kw["dirty"] else ""))
+    elif kind == "slice_fill":
+        desc = (f"slice t{kw['home']} fills line {line:#x} "
+                f"from {kw['source']}")
+    elif kind == "slice_kill":
+        desc = (f"slice t{kw['home']} drops line {line:#x}"
+                + (", dirty -> DRAM" if kw["dirty"] else ""))
+    elif kind == "reply":
+        desc = (f"home t{kw['home']} replies to t{kw['requester']} "
+                f"({kw['source']} data), line {line:#x}")
+    elif kind == "hit":
+        desc = (f"t{kw['tile']} {'write' if kw['write'] else 'read'} "
+                f"{kw['level']} hit, line {line:#x}"
+                + (" (E->M)" if kw.get("promoted") else ""))
+    elif kind == "fill":
+        desc = (f"t{kw['tile']} fills line {line:#x} -> "
+                f"{_ST.get(kw['state'], '?')}")
+    else:
+        desc = repr(kw)
+    return f"{phase}: {desc}"
+
+
+# ---------------------------------------------------------------------------
+# abstraction + quiescent-state invariants
+# ---------------------------------------------------------------------------
+
+
+def _cstate(cache, line) -> int:
+    hit, _, st = cache.lookup(line)
+    return int(st) if hit else 0
+
+
+def _abstract_private(model, lines, v: _Versions, n_tiles):
+    ks = []
+    for line in lines:
+        home = model._home_of(line)
+        hm = model.homes[home]
+        e = model._dir_find(hm, line)
+        dent = (None if e is None
+                else (e.dstate, e.owner, frozenset(e.sharers)))
+        g = v.global_v[line]
+        fresh = (v.dram_v[line] == g,
+                 bool(hm.cdata_valid and hm.cdata_line == line
+                      and v.cdata_v[line] == g),
+                 tuple(v.copy_v[line].get(t, -1) == g
+                       for t in range(n_tiles)))
+        ks.append((
+            tuple(_cstate(model.l1d[t], line) for t in range(n_tiles)),
+            tuple(_cstate(model.l2[t], line) for t in range(n_tiles)),
+            dent,
+            bool(hm.cdata_valid and hm.cdata_line == line),
+            fresh,
+        ))
+    return tuple(ks)
+
+
+def _abstract_shl2(model, lines, v: _Versions, n_tiles):
+    ks = []
+    for line in lines:
+        home = model._home_of(line)
+        hit, way, slice_st = model.l2[home].lookup(line)
+        dent = None
+        if hit:
+            e = model.dir[home].get((line % model.l2[home].sets, way))
+            if e is not None:
+                dent = (e.dstate, e.owner, frozenset(e.sharers))
+        g = v.global_v[line]
+        fresh = (v.dram_v[line] == g,
+                 bool(hit and v.slice_v[line] == g),
+                 tuple(v.copy_v[line].get(t, -1) == g
+                       for t in range(n_tiles)))
+        ks.append((
+            tuple(_cstate(model.l1d[t], line) for t in range(n_tiles)),
+            int(slice_st) if hit else 0,
+            dent,
+            fresh,
+        ))
+    return tuple(ks)
+
+
+def _check_private(model, lines, v: _Versions, n_tiles):
+    """Quiescent-state invariants for the private-L2 protocols."""
+    from graphite_tpu.memory.cache_array import (
+        EXCLUSIVE, MODIFIED, OWNED, SHARED)
+    from graphite_tpu.memory.state import (
+        DIR_MODIFIED, DIR_OWNED, DIR_SHARED, DIR_UNCACHED)
+
+    out = []
+    for line in lines:
+        l2 = [_cstate(model.l2[t], line) for t in range(n_tiles)]
+        l1 = [_cstate(model.l1d[t], line) for t in range(n_tiles)]
+        holders = {t for t in range(n_tiles) if l2[t]}
+        writers = {t for t in range(n_tiles)
+                   if l2[t] in (MODIFIED, EXCLUSIVE)}
+        desc = (f"line {line:#x}: l1d="
+                + "".join(_ST[s] for s in l1)
+                + " l2=" + "".join(_ST[s] for s in l2))
+        if len(writers) > 1:
+            out.append(("single-writer-multiple-reader",
+                        f"{desc}: {len(writers)} writable copies"))
+        if writers and len(holders) > 1:
+            out.append(("single-writer-multiple-reader",
+                        f"{desc}: writable copy coexists with other "
+                        "copies"))
+        for t in range(n_tiles):
+            if l1[t] and not l2[t]:
+                out.append(("directory-cache-agreement",
+                            f"{desc}: t{t} L1 copy outside L2"))
+        home = model._home_of(line)
+        e = model._dir_find(model.homes[home], line)
+        dstate = e.dstate if e is not None else DIR_UNCACHED
+        dsh = set(e.sharers) if e is not None else set()
+        downer = e.owner if e is not None else -1
+        dname = _DIRN.get(dstate, "?")
+        if dsh != holders:
+            out.append(("directory-cache-agreement",
+                        f"{desc}: dir {dname} sharers {sorted(dsh)} != "
+                        f"holders {sorted(holders)}"))
+        if dstate == DIR_UNCACHED and holders:
+            out.append(("directory-cache-agreement",
+                        f"{desc}: dir UNCACHED but line cached"))
+        if dstate == DIR_SHARED and any(
+                l2[t] not in (0, SHARED) for t in range(n_tiles)):
+            out.append(("directory-cache-agreement",
+                        f"{desc}: dir Sh with a non-S copy"))
+        if dstate == DIR_MODIFIED and (
+                downer not in writers or holders != {downer}):
+            out.append(("directory-cache-agreement",
+                        f"{desc}: dir M owner t{downer} mismatch"))
+        if dstate == DIR_OWNED and (
+                downer < 0 or l2[downer] != OWNED or any(
+                    l2[t] not in (0, SHARED) for t in range(n_tiles)
+                    if t != downer)):
+            out.append(("directory-cache-agreement",
+                        f"{desc}: dir O owner t{downer} mismatch"))
+    return out
+
+
+def _check_shl2(model, lines, v: _Versions, n_tiles):
+    from graphite_tpu.memory.cache_array import (
+        EXCLUSIVE, MODIFIED, SHARED)
+    from graphite_tpu.memory.engine_shl2 import DATA_INVALID, DIR_EXCLUSIVE
+    from graphite_tpu.memory.state import (
+        DIR_MODIFIED, DIR_SHARED, DIR_UNCACHED)
+
+    out = []
+    for line in lines:
+        l1 = [_cstate(model.l1d[t], line) for t in range(n_tiles)]
+        holders = {t for t in range(n_tiles) if l1[t]}
+        writers = {t for t in range(n_tiles)
+                   if l1[t] in (MODIFIED, EXCLUSIVE)}
+        desc = f"line {line:#x}: l1d=" + "".join(_ST[s] for s in l1)
+        if len(writers) > 1:
+            out.append(("single-writer-multiple-reader",
+                        f"{desc}: {len(writers)} writable copies"))
+        if writers and len(holders) > 1:
+            out.append(("single-writer-multiple-reader",
+                        f"{desc}: writable copy coexists with other "
+                        "copies"))
+        home = model._home_of(line)
+        hit, way, slice_st = model.l2[home].lookup(line)
+        if hit and slice_st == DATA_INVALID:
+            out.append(("progress",
+                        f"{desc}: slice stuck DATA_INVALID at rest"))
+        e = (model.dir[home].get((line % model.l2[home].sets, way))
+             if hit else None)
+        dstate = e.dstate if e is not None else DIR_UNCACHED
+        dsh = set(e.sharers) if e is not None else set()
+        downer = e.owner if e is not None else -1
+        dname = _DIRN.get(dstate, "?")
+        if not hit and holders:
+            out.append(("directory-cache-agreement",
+                        f"{desc}: L1 copies without a slice line"))
+        if dsh != holders:
+            out.append(("directory-cache-agreement",
+                        f"{desc}: dir {dname} sharers {sorted(dsh)} != "
+                        f"holders {sorted(holders)}"))
+        if dstate == DIR_SHARED and any(
+                l1[t] not in (0, SHARED) for t in range(n_tiles)):
+            out.append(("directory-cache-agreement",
+                        f"{desc}: dir Sh with a non-S copy"))
+        if dstate == DIR_MODIFIED and (
+                downer < 0 or l1[downer] != MODIFIED
+                or holders != {downer}):
+            out.append(("directory-cache-agreement",
+                        f"{desc}: dir M owner t{downer} mismatch"))
+        if dstate == DIR_EXCLUSIVE and (
+                downer < 0 or l1[downer] not in (EXCLUSIVE, MODIFIED)
+                or holders != {downer}):
+            # silent E->M promotion keeps dstate EXCLUSIVE (documented)
+            out.append(("directory-cache-agreement",
+                        f"{desc}: dir E owner t{downer} mismatch"))
+    return out
+
+
+def _histogram_add(hist, key, is_shl2):
+    feats = set()
+    for part in key:
+        if is_shl2:
+            l1, slice_st, dent, _fresh = part
+            if slice_st:
+                feats.add(f"slice:{_ST[slice_st]}")
+        else:
+            l1, l2, dent, cdata, _fresh = part
+            for s in l2:
+                if s:
+                    feats.add(f"l2:{_ST[s]}")
+            if cdata:
+                feats.add("cdata")
+        for s in l1:
+            if s:
+                feats.add(f"l1d:{_ST[s]}")
+        if dent is not None:
+            feats.add(f"dir:{_DIRN.get(dent[0], '?')}")
+    for f in feats:
+        hist[f] = hist.get(f, 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# exploration
+# ---------------------------------------------------------------------------
+
+
+def explore(protocol: str, n_tiles: int = 2, n_lines: int = 1, *,
+            mutant: str | None = None, max_states: int = 50000,
+            event_bound: int = 128, max_violations: int = 8) -> MCResult:
+    """BFS over the quiescent-configuration graph.  Exhaustive within
+    the abstraction (protocol state x data freshness) — terminates when
+    no new configuration is reachable or a bound trips (the latter is a
+    ``progress`` violation, not silent truncation)."""
+    if protocol not in PROTOCOLS:
+        raise ValueError(f"unknown protocol {protocol!r}; "
+                         f"one of {sorted(PROTOCOLS)}")
+    sc = mc_sim_config(protocol, n_tiles)
+    lines = mc_lines(n_lines)
+    is_shl2 = protocol == "shl2_mesi"
+    is_mosi = protocol == "mosi"
+    abstract = _abstract_shl2 if is_shl2 else _abstract_private
+    check = _check_shl2 if is_shl2 else _check_private
+
+    model0 = make_model(sc, mutant)
+    v0 = _Versions(lines)
+    key0 = abstract(model0, lines, v0, n_tiles)
+
+    reps = {key0: (model0, v0)}
+    paths = {key0: ()}
+    frontier = deque([key0])
+    hist: dict = {}
+    _histogram_add(hist, key0, is_shl2)
+    fan = {"req": 0, "fwd": 0, "ack": 0, "evict": 0}
+    max_in_flight = 0
+    violations: list = []
+    transition_seqs: list = []
+    transitions = 0
+
+    actions = [Action(t, ln, w) for t in range(n_tiles) for ln in lines
+               for w in (False, True)]
+
+    while frontier:
+        key = frontier.popleft()
+        model, vers = reps[key]
+        path = paths[key]
+        for a in actions:
+            if len(violations) >= max_violations:
+                frontier.clear()
+                break
+            m2 = copy.deepcopy(model)
+            v2 = copy.deepcopy(vers)
+            obs = _TxnObserver(v2, lines, n_tiles, is_shl2, is_mosi,
+                               event_bound)
+            m2.event_cb = obs
+            try:
+                m2._slot(a.tile, False, a.line * LINE_BYTES, a.write,
+                         clock_ps=0, enabled=True)
+            except AssertionError as exc:
+                obs._flag("directory-cache-agreement", str(exc))
+            except RecursionError:
+                obs._flag("progress",
+                          "unbounded protocol recursion (deadlock)")
+            m2.event_cb = None
+            transitions += 1
+            seq = path + (a,)
+
+            for mat in fan:
+                fan[mat] = max(fan[mat], obs.fan[mat])
+            max_in_flight = max(max_in_flight, obs.max_in_flight)
+
+            found = list(obs.violations)
+            if not found:
+                found = check(m2, lines, v2, n_tiles)
+            if found:
+                rendered = tuple(render_event(protocol, k, kw)
+                                 for k, kw in obs.events)
+                for inv, msg in found:
+                    violations.append(Violation(inv, msg, seq, rendered))
+                continue   # do not explore past a broken configuration
+
+            succ = abstract(m2, lines, v2, n_tiles)
+            transition_seqs.append((seq, succ))
+            if succ not in reps:
+                if len(reps) >= max_states:
+                    violations.append(Violation(
+                        "progress",
+                        f"state space exceeds max_states={max_states}",
+                        seq, ()))
+                    frontier.clear()
+                    break
+                reps[succ] = (m2, v2)
+                paths[succ] = seq
+                frontier.append(succ)
+                _histogram_add(hist, succ, is_shl2)
+
+    return MCResult(
+        protocol=protocol, n_tiles=n_tiles, lines=lines,
+        states_explored=len(reps), transitions=transitions,
+        histogram=dict(sorted(hist.items())), fan_in=fan,
+        max_in_flight=max_in_flight, violations=violations,
+        transition_seqs=transition_seqs)
+
+
+# ---------------------------------------------------------------------------
+# differential replay through the vectorized engines
+# ---------------------------------------------------------------------------
+
+
+def _replay_builders(seq, n_tiles):
+    from graphite_tpu.trace.schema import TraceBuilder
+
+    bs = [TraceBuilder() for _ in range(n_tiles)]
+    bs[0].barrier_init(9, n_tiles)
+    for a in seq:
+        for b in bs:
+            b.barrier_wait(9)
+        if a.write:
+            bs[a.tile].store(a.line * LINE_BYTES, 8)
+        else:
+            bs[a.tile].load(a.line * LINE_BYTES, 8)
+    return bs
+
+
+def differential(result: MCResult, *, max_quanta: int = 4096,
+                 max_transitions: int | None = None,
+                 progress_cb=None) -> DiffResult:
+    """Replay every explored transition through the shipped vectorized
+    engine and assert bit-equality with the golden oracle.
+
+    Each transition's action sequence (BFS path prefix + the step)
+    becomes a barrier-serialized trace: all tiles rendezvous before each
+    access, so the engine resolves the accesses in exactly the explored
+    order and the established serialized bit-exactness contract applies
+    (tests/test_memory_golden.py).  All traces are padded with IALU
+    filler to one uniform record count, so ONE jitted step function
+    serves every transition.  Checks, per transition:
+
+      - engine clock_ps and every memory counter == `run_golden`,
+      - engine completes (no deadlock flag) within `max_quanta`,
+      - the engine's final packed per-line state (census) matches the
+        model checker's successor configuration.
+    """
+    import jax
+
+    from graphite_tpu.engine.simulator import Simulator
+    from graphite_tpu.engine.state import DeviceTrace
+    from graphite_tpu.golden import run_golden
+    from graphite_tpu.memory.params import MemParams
+    from graphite_tpu.trace.schema import Op, TraceBatch
+
+    protocol = result.protocol
+    n_tiles = result.n_tiles
+    lines = result.lines
+    is_shl2 = protocol == "shl2_mesi"
+    sc = mc_sim_config(protocol, n_tiles)
+    mp = MemParams.from_config(sc)
+
+    seqs = result.transition_seqs
+    if max_transitions is not None:
+        seqs = seqs[:max_transitions]
+    if not seqs:
+        return DiffResult(protocol, 0, 0, [])
+
+    all_builders = [_replay_builders(seq, n_tiles) for seq, _ in seqs]
+    rmax = max(len(b._op) for bs in all_builders for b in bs)
+    batches = []
+    for bs in all_builders:
+        for b in bs:
+            while len(b._op) < rmax:
+                b.instr(Op.IALU)
+        batches.append(TraceBatch.from_builders(bs))
+
+    sim = Simulator(sc, batches[0])
+    fn, args = sim._auditable_fn(max_quanta)
+    st0 = args[0]
+    jfn = jax.jit(fn)
+
+    mismatches = []
+    n_ok = 0
+    for i, ((seq, succ), batch) in enumerate(zip(seqs, batches)):
+        out = jfn(st0, DeviceTrace.from_batch(batch))
+        state = out[0]
+        deadlock = bool(np.asarray(out[2]))
+        label = " ; ".join(str(a) for a in seq)
+        if deadlock or not bool(np.asarray(state.done).all()):
+            mismatches.append(f"[{label}] engine "
+                              + ("deadlock" if deadlock else
+                                 f"did not finish in {max_quanta} quanta"))
+            continue
+        gold = run_golden(sc, batch)
+        bad = False
+        eng_clock = np.asarray(state.core.clock_ps)
+        if not np.array_equal(eng_clock, gold.clock_ps):
+            mismatches.append(
+                f"[{label}] clock_ps {eng_clock.tolist()} != "
+                f"{np.asarray(gold.clock_ps).tolist()}")
+            bad = True
+        for name in gold.mem_counters:
+            e = np.asarray(getattr(state.mem.counters, name))
+            g = np.asarray(gold.mem_counters[name])
+            if not np.array_equal(e, g):
+                mismatches.append(
+                    f"[{label}] counter {f.name} {e.tolist()} != "
+                    f"{g.tolist()}")
+                bad = True
+        cen = _engine_census(state.mem, mp, lines, is_shl2)
+        want = _succ_census(succ, lines, n_tiles, is_shl2)
+        if cen != want:
+            mismatches.append(
+                f"[{label}] final state census {cen} != explored "
+                f"successor {want}")
+            bad = True
+        if not bad:
+            n_ok += 1
+        if progress_cb is not None:
+            progress_cb(i + 1, len(seqs))
+
+    return DiffResult(protocol, len(seqs), n_ok, mismatches)
+
+
+def _engine_census(mem_state, mp, lines, is_shl2):
+    """Normalized (hashable) engine-side view for comparison."""
+    from graphite_tpu.memory.state import DIR_UNCACHED
+
+    if is_shl2:
+        from graphite_tpu.memory.engine_shl2 import shl2_line_census
+
+        cen = shl2_line_census(mem_state, mp, lines)
+        out = []
+        for line in lines:
+            c = cen[line]
+            d = c["dir"]
+            if d is not None and d[0] == DIR_UNCACHED and not d[2]:
+                d = None
+            out.append((c["l1d"], c["slice"], d))
+        return tuple(out)
+    from graphite_tpu.memory.engine import line_census
+
+    cen = line_census(mem_state, mp, lines)
+    out = []
+    for line in lines:
+        c = cen[line]
+        d = c["dir"]
+        if d is not None and d[0] == DIR_UNCACHED and not d[2]:
+            d = None
+        out.append((c["l1d"], c["l2"], d, c["cdata"]))
+    return tuple(out)
+
+
+def _succ_census(succ_key, lines, n_tiles, is_shl2):
+    """The comparable protocol part of an explored successor key."""
+    from graphite_tpu.memory.state import DIR_UNCACHED
+
+    out = []
+    for part in succ_key:
+        if is_shl2:
+            l1, slice_st, dent, _fresh = part
+            if dent is not None and dent[0] == DIR_UNCACHED \
+                    and not dent[2]:
+                dent = None
+            out.append((l1, slice_st, dent))
+        else:
+            l1, l2, dent, cdata, _fresh = part
+            if dent is not None and dent[0] == DIR_UNCACHED \
+                    and not dent[2]:
+                dent = None
+            out.append((l1, l2, dent, cdata))
+    return tuple(out)

@@ -48,6 +48,17 @@ a Python-level abstraction:
                 no [S, T, m] per-tile ring equation; profile-ON
                 programs add that ring's aval to the cond-payload
                 forbidden set instead.
+  write-race    the round-20 [T, k]-compaction gate: every scatter is
+                classified single-writer / commutative-multi-writer /
+                ordered-multi-writer through the shared writer-proof
+                ladder (walk.scatter_writer_proof); an ORDERED write
+                into a req lane (uint8/int64 [.., T]) or a mailbox
+                matrix ([.., T, T]) is an error — a rewrite silently
+                made a deterministic protocol lane racy.  The model
+                checker (analysis/protocol.py) supplies the reachable
+                per-matrix fan-in bounds the compaction needs;
+                `lane_writes`/`lane_summary` expose the classification
+                table (`tools/audit.py --lanes`).
 
 Rules return `Finding` lists; `analysis/audit.py` assembles them into
 per-program reports and the `tools/audit.py` CLI emits them as JSON
@@ -58,13 +69,12 @@ from __future__ import annotations
 
 import dataclasses
 
-import jax
 import numpy as np
 
 from graphite_tpu.analysis.walk import (
-    aval_bytes, aval_sig, call_arg_maps, distinct_axes,
-    iter_eqns_with_site, make_scope, masked_index_select,
-    scope_from_closed, subjaxprs, taint_narrowing, used_invar_mask,
+    aval_bytes, aval_sig, call_arg_maps, iter_eqns_with_site,
+    make_scope, scatter_writer_proof, scope_from_closed, subjaxprs,
+    taint_narrowing, used_invar_mask,
 )
 
 SEV_ERROR = "error"
@@ -362,18 +372,6 @@ _COMMUTATIVE_SCATTERS = frozenset({
 })
 
 
-def _scatter_row_axes(eqn) -> "tuple[int, ...]":
-    """The index-row axes of a scatter's indices operand: everything
-    except the trailing index-vector dim and any vmap batching dims
-    (a batching dim addresses a DIFFERENT operand slice per position,
-    so it cannot alias across itself)."""
-    idx = eqn.invars[1]
-    rank = len(getattr(idx.aval, "shape", ()) or ())
-    dn = eqn.params.get("dimension_numbers")
-    batch = tuple(getattr(dn, "scatter_indices_batching_dims", ()) or ())
-    return tuple(a for a in range(rank - 1) if a not in batch)
-
-
 def scatter_determinism(jaxpr, *, batched: bool = False,
                         ) -> "list[Finding]":
     """No potentially-aliasing replace-scatter inside a batched region.
@@ -397,44 +395,32 @@ def scatter_determinism(jaxpr, *, batched: bool = False,
             name = eqn.primitive.name
             here = f"{site}.{name}" if site else name
             if name.startswith("scatter") and in_scope \
-                    and name not in _COMMUTATIVE_SCATTERS \
-                    and not eqn.params.get("unique_indices"):
-                idx = eqn.invars[1]
-                if not isinstance(idx, jax.core.Literal):
-                    idx_shape = tuple(
-                        getattr(idx.aval, "shape", ()) or ())
-                    # a size-1 row axis holds a single row, and an
-                    # empty row set (rank-1 indices, or every row axis
-                    # a vmap batching dim) means one row per addressed
-                    # operand slice — a lone row cannot collide with
-                    # itself, so only multi-row axes need provenance.
-                    # The per-axis proof is sound for AT MOST one such
-                    # axis: per-axis distinctness covers pairs that
-                    # differ in one axis, not rows differing in several
-                    # (a const table [[0,1],[1,0]] is distinct along
-                    # both axes yet rows (0,0) and (1,1) collide)
-                    rows = tuple(a for a in _scatter_row_axes(eqn)
-                                 if idx_shape[a] > 1)
-                    # the provenance walk only decides the one-axis
-                    # case: no rows is trivially safe, >= 2 unprovable
-                    proven = (not rows) if len(rows) != 1 \
-                        else rows[0] in distinct_axes(idx, scope)
-                    if not proven \
-                            and not masked_index_select(idx, scope):
-                        sig = aval_sig(eqn.outvars[0].aval) or ((), "?")
-                        out.append(Finding(
-                            "scatter-determinism", SEV_WARNING, here,
-                            f"replace-combiner scatter into {sig[0]} "
-                            f"{sig[1]} with potentially aliasing index "
-                            f"rows inside a batched region — colliding "
-                            f"rows have an implementation-defined "
-                            f"winner; use a masked add-scatter (the "
-                            f"round-9 ring-store contract), a scratch-"
-                            f"slot redirect, or unique_indices=True",
-                            data={"shape": list(sig[0]),
-                                  "dtype": sig[1],
-                                  "indices_shape": list(
-                                      getattr(idx.aval, "shape", ()))}))
+                    and name not in _COMMUTATIVE_SCATTERS:
+                # the proof ladder (walk.scatter_writer_proof):
+                # unique_indices / constant index rows / a single row
+                # per addressed slice / one multi-row axis proven
+                # pairwise-distinct by provenance / the masked
+                # scratch-redirect idiom.  Sound for at most one
+                # multi-row axis — per-axis distinctness covers pairs
+                # differing in one axis, not rows differing in several
+                # (a const table [[0,1],[1,0]] is distinct along both
+                # axes yet rows (0,0) and (1,1) collide)
+                if scatter_writer_proof(eqn, scope) is None:
+                    idx = eqn.invars[1]
+                    sig = aval_sig(eqn.outvars[0].aval) or ((), "?")
+                    out.append(Finding(
+                        "scatter-determinism", SEV_WARNING, here,
+                        f"replace-combiner scatter into {sig[0]} "
+                        f"{sig[1]} with potentially aliasing index "
+                        f"rows inside a batched region — colliding "
+                        f"rows have an implementation-defined "
+                        f"winner; use a masked add-scatter (the "
+                        f"round-9 ring-store contract), a scratch-"
+                        f"slot redirect, or unique_indices=True",
+                        data={"shape": list(sig[0]),
+                              "dtype": sig[1],
+                              "indices_shape": list(
+                                  getattr(idx.aval, "shape", ()))}))
             subs = call_arg_maps(eqn)
             if subs:
                 tags = [t for t, _ in subjaxprs(eqn)]
@@ -497,4 +483,170 @@ def telemetry_off(jaxpr, invar_paths=None, ring_sigs=(), *,
                                   "output": k, "shape": list(sig[0]),
                                   "dtype": sig[1]}))
                         break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 10: write-race
+# ---------------------------------------------------------------------------
+
+# Lane kinds, by scatter-target signature (modulo leading batch axes):
+#   req-lane  the round-12 compacted per-requester lanes — uint8[.., T]
+#             type vectors / int64[.., T] time vectors (one lane per
+#             requesting tile; the [T, k] compaction keeps this shape)
+#   matrix    the [.., T, T] fwd/ack/evict mailboxes (row per sender or
+#             receiver — the multi-writer surface the [T, k] compaction
+#             wants to shrink)
+#   state     everything else a phase writes: cache tag/state/data
+#             arrays, DRAM words, the next-event heap
+LANE_REQ = "req-lane"
+LANE_MATRIX = "matrix"
+LANE_STATE = "state"
+
+CLASS_SINGLE = "single-writer"
+CLASS_COMMUTATIVE = "commutative-multi-writer"
+CLASS_ORDERED = "ordered-multi-writer"
+
+
+@dataclasses.dataclass
+class LaneWrite:
+    """One scatter in the lowered program, classified for the
+    write-race lane analysis."""
+
+    site: str            # primitive path of the scatter eqn
+    primitive: str       # "scatter", "scatter-add", ...
+    kind: str            # LANE_REQ | LANE_MATRIX | LANE_STATE
+    classification: str  # CLASS_SINGLE | CLASS_COMMUTATIVE | CLASS_ORDERED
+    proof: str           # writer proof name, the combiner, or "-"
+    shape: "tuple[int, ...]"
+    dtype: str
+
+    def to_json(self) -> dict:
+        return {"site": self.site, "primitive": self.primitive,
+                "kind": self.kind,
+                "classification": self.classification,
+                "proof": self.proof, "shape": list(self.shape),
+                "dtype": self.dtype}
+
+
+def _lane_kind(sig, n_tiles: int) -> str:
+    shape, dtype = sig
+    if len(shape) >= 2 and shape[-2:] == (n_tiles, n_tiles):
+        return LANE_MATRIX
+    if shape[-1:] == (n_tiles,) \
+            and (len(shape) < 2 or shape[-2] != n_tiles) \
+            and dtype in ("uint8", "int64"):
+        return LANE_REQ
+    return LANE_STATE
+
+
+def lane_writes(jaxpr, n_tiles: int) -> "list[LaneWrite]":
+    """Every scatter in the program, classified.
+
+    The ladder: a scatter is SINGLE-WRITER when `walk.
+    scatter_writer_proof` proves each target cell is written at most
+    once (unique_indices, constant index rows, a single row per
+    addressed slice, a provenance-distinct row axis, or the masked
+    scratch-redirect); otherwise COMMUTATIVE-MULTI-WRITER when its
+    combiner is order-independent (add/mul/min/max); otherwise
+    ORDERED-MULTI-WRITER — the result depends on XLA's update order,
+    which the contract does not own.  Note the ladder tries the
+    single-writer proof even for commutative combiners: the round-12
+    req lanes are masked ADD-scatters, and the analysis should say
+    "single writer" about them, not merely "commutative"."""
+    out = []
+
+    def visit(scope, site):
+        for eqn in scope.jaxpr.eqns:
+            name = eqn.primitive.name
+            here = f"{site}.{name}" if site else name
+            if name.startswith("scatter"):
+                sig = aval_sig(eqn.outvars[0].aval) or ((), "?")
+                proof = scatter_writer_proof(eqn, scope)
+                if proof is not None:
+                    cls = CLASS_SINGLE
+                elif name in _COMMUTATIVE_SCATTERS:
+                    cls, proof = CLASS_COMMUTATIVE, name
+                else:
+                    cls, proof = CLASS_ORDERED, "-"
+                out.append(LaneWrite(here, name,
+                                     _lane_kind(sig, n_tiles), cls,
+                                     proof, tuple(sig[0]), sig[1]))
+            subs = call_arg_maps(eqn)
+            if subs:
+                tags = [t for t, _ in subjaxprs(eqn)]
+                for k, sc in enumerate(subs):
+                    tag = tags[k] if k < len(tags) else str(k)
+                    visit(make_scope(sc.jaxpr, scope, eqn, sc),
+                          f"{here}/{tag}")
+
+    visit(scope_from_closed(jaxpr), "")
+    return out
+
+
+def lane_summary(writes: "list[LaneWrite]") -> dict:
+    """{kind: {classification: count}} — the lane-classification table
+    the README documents and `tools/audit.py --lanes` emits."""
+    table = {}
+    for w in writes:
+        table.setdefault(w.kind, {}) \
+             .setdefault(w.classification, 0)
+    for w in writes:
+        table[w.kind][w.classification] += 1
+    return table
+
+
+def write_race(jaxpr, n_tiles: int, *,
+               fan_in: "dict | None" = None) -> "list[Finding]":
+    """The standing gate for the [T, k] mailbox compaction.
+
+    Classifies every scatter (`lane_writes`) and fails the audit when a
+    rewrite has made a protocol write RACY — an ordered-multi-writer
+    scatter into a req lane or a mailbox matrix.  The req lanes are
+    single-writer by construction (each tile writes its own lane); the
+    matrices are legitimately multi-writer but every current write is
+    either provably cell-unique or commutative, and the bit-identity
+    claims (sweep-vs-sequential, telemetry on/off, the differential
+    model-checker replay) assume exactly that.  A rewrite that turns
+    one of these into a replace-scatter with potentially aliasing rows
+    silently hands the winner to XLA's update order — this rule is the
+    error that stops it.  Ordered writes into other engine state get
+    warning severity (scatter-determinism already polices them inside
+    batched regions).
+
+    `fan_in`, when given, is the per-matrix reachable fan-in bound from
+    the model checker's exhaustive exploration
+    (`analysis.protocol.explore(...).fan_in` — e.g. {"req": 1, "fwd":
+    1, "ack": 1, "evict": 1}); it is attached to each finding so a
+    failure report carries the bound the compaction design needs."""
+    out = []
+    for w in lane_writes(jaxpr, n_tiles):
+        if w.classification != CLASS_ORDERED:
+            continue
+        gated = w.kind in (LANE_REQ, LANE_MATRIX)
+        data = dict(w.to_json())
+        if fan_in is not None:
+            data["fan_in"] = dict(fan_in)
+        if w.kind == LANE_REQ:
+            msg = (f"req-lane scatter into {w.shape} {w.dtype} is "
+                   f"ordered-multi-writer — the round-12 [T] request "
+                   f"lanes are single-writer by construction (each "
+                   f"tile owns its lane); this rewrite made the lane "
+                   f"racy.  Restore a writer proof: iota/distinct row "
+                   f"indices, the masked scratch-redirect, or "
+                   f"unique_indices=True")
+        elif w.kind == LANE_MATRIX:
+            msg = (f"mailbox-matrix scatter into {w.shape} {w.dtype} "
+                   f"is ordered-multi-writer — colliding rows hand "
+                   f"the winner to XLA's update order and break the "
+                   f"bit-identity contract.  Use a commutative "
+                   f"combiner (masked add-scatter) or prove the rows "
+                   f"distinct")
+        else:
+            msg = (f"engine-state scatter into {w.shape} {w.dtype} is "
+                   f"ordered-multi-writer (no writer proof, "
+                   f"non-commutative combiner)")
+        out.append(Finding(
+            "write-race", SEV_ERROR if gated else SEV_WARNING,
+            w.site, msg, data=data))
     return out

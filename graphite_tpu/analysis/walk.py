@@ -914,3 +914,55 @@ def masked_index_select(var, scope: Scope, _depth: int = 0) -> bool:
             return False
         got_masked = True
     return got_masked
+
+
+def scatter_row_axes(eqn) -> "tuple[int, ...]":
+    """The index-row axes of a scatter's indices operand: everything
+    except the trailing index-vector dim and any vmap batching dims
+    (a batching dim addresses a DIFFERENT operand slice per position,
+    so it cannot alias across itself)."""
+    idx = eqn.invars[1]
+    rank = len(getattr(idx.aval, "shape", ()) or ())
+    dn = eqn.params.get("dimension_numbers")
+    batch = tuple(getattr(dn, "scatter_indices_batching_dims", ()) or ())
+    return tuple(a for a in range(rank - 1) if a not in batch)
+
+
+def scatter_writer_proof(eqn, scope: Scope) -> "str | None":
+    """Name of the proof that this scatter writes every target cell at
+    most once (each cell has a SINGLE writer within the op), or None
+    when no proof holds.  The proof ladder, in order:
+
+      "unique-indices"  the op declares unique_indices=True — the
+                        caller asserts non-aliasing and XLA is allowed
+                        to exploit it, so a lie is already UB
+      "constant-index"  the index operand is a literal — a fixed,
+                        statically visible row set (treated as the
+                        author's explicit layout, like the old
+                        scatter-determinism literal skip)
+      "single-row"      every non-batching row axis has size 1 (or
+                        there are none): one row per addressed slice
+                        cannot collide with itself
+      "distinct-axes"   index provenance shows the one multi-row axis
+                        is pairwise-distinct (an iota column survives
+                        into every row — `distinct_axes`)
+      "masked-select"   the masked scratch-redirect idiom: disabled
+                        lanes all land on one spill slot
+                        (`masked_index_select`)
+
+    Sound for at most ONE multi-row axis, same as scatter-determinism:
+    per-axis distinctness covers pairs differing in one axis only."""
+    if eqn.params.get("unique_indices"):
+        return "unique-indices"
+    idx = eqn.invars[1]
+    if isinstance(idx, jax.core.Literal):
+        return "constant-index"
+    idx_shape = tuple(getattr(idx.aval, "shape", ()) or ())
+    rows = tuple(a for a in scatter_row_axes(eqn) if idx_shape[a] > 1)
+    if not rows:
+        return "single-row"
+    if len(rows) == 1 and rows[0] in distinct_axes(idx, scope):
+        return "distinct-axes"
+    if masked_index_select(idx, scope):
+        return "masked-select"
+    return None

@@ -237,6 +237,13 @@ class GoldenMemory:
         # engine's packed counter cell; histogram of totals on departure
         self.counters["line_util_hist"] = [[0] * 8 for _ in range(T)]
         self.l2_util = [dict() for _ in range(T)]
+        # optional protocol-event observer (analysis/protocol.py model
+        # checker); None in normal runs — zero semantic effect
+        self.event_cb = None
+
+    def _emit(self, etype: str, **kw) -> None:
+        if self.event_cb is not None:
+            self.event_cb(etype, kw)
 
     # -- L2 cache-line utilization (engine's _util_* counterparts) --------
 
@@ -375,6 +382,7 @@ class GoldenMemory:
             self.counters["evictions"][home] += 1
             if is_flush:
                 self.counters["dram_writes"][home] += 1
+        self._emit("evict", src=src, home=home, line=line, dirty=is_flush)
         if is_flush:
             # park the flushed line in the home's one-entry data buffer
             # (`_cached_data_list`): a later request skips the DRAM read
@@ -441,6 +449,8 @@ class GoldenMemory:
             self.l2[s].set_state(line, way, wb_state)
         ack_bits = mp.req_bits if kind == "inv" else mp.rep_bits
         supplies = kind in ("flush", "wb")
+        self._emit("serve", tile=s, home=home, line=line, kind=kind,
+                   supplies=supplies)
         return self._net_arrive(s, home, ack_bits, done, enabled), supplies
 
     # -- the directory transaction (`dram_directory_cntlr.cc:44-559`) ------
@@ -498,6 +508,8 @@ class GoldenMemory:
                       dstate, owner, sharers, entry, enabled):
         """The per-state FSM for one EX/SH/NULLIFY transaction."""
         mp = self.mp
+        self._emit("req", home=home, requester=requester, line=line,
+                   mtype=mtype, dstate=dstate)
         eff_time = rtime + self._dir_ps(mp.dir_access_cycles, enabled)
         is_ex = mtype == "ex"
         is_sh = mtype == "sh"
@@ -548,6 +560,9 @@ class GoldenMemory:
                 self.counters["dram_total_lat_ps"][home] += \
                     self._dram_ps(True)
             hm.last_line, hm.last_done_ps = line, rep_ready
+            self._emit("reply", home=home, requester=requester, line=line,
+                       mtype=mtype,
+                       source="cdata" if cdata_hit else "dram")
             return self._net_arrive(home, requester, mp.rep_bits,
                                     rep_ready, enabled)
 
@@ -631,6 +646,9 @@ class GoldenMemory:
             f_arrivals = self._net_fanout(home, list(targets), mp.req_bits,
                                           eff_time, enabled)
         for s in sorted(targets):
+            self._emit("fwd", home=home, target=s, line=line,
+                       kind=targets[s], broadcast=broadcast)
+        for s in sorted(targets):
             f_arrive = f_arrivals[s]
             ack_time, supplies = self._serve_fwd(
                 s, targets[s], line, f_arrive, home, enabled)
@@ -667,6 +685,10 @@ class GoldenMemory:
         hm.last_line, hm.last_done_ps = line, rep_ready
         if is_nullify:
             return None
+        self._emit("reply", home=home, requester=requester, line=line,
+                   mtype=mtype,
+                   source=("c2c" if got_data
+                           else "cdata" if cdata_hit else "dram"))
         return self._net_arrive(home, requester, mp.rep_bits, rep_ready,
                                 enabled)
 
@@ -704,6 +726,7 @@ class GoldenMemory:
                     c["l1d_write_hits"][t] += 1
                 else:
                     c["l1d_read_hits"][t] += 1
+            self._emit("hit", tile=t, line=line, write=write, level="l1")
             return sclock + l1_dat - clock_ps
 
         # L1 miss: invalidate the stale L1 line, try L2
@@ -727,6 +750,7 @@ class GoldenMemory:
                     + l1_dat)
             self._fill_l1(t, is_icache, line, l2_st, l2_way)
             l2.touch(line, l2_way)
+            self._emit("hit", tile=t, line=line, write=write, level="l2")
             return done - clock_ps
 
         if enabled:
@@ -774,6 +798,7 @@ class GoldenMemory:
         l2.insert_at(line, v_way, new_state)
         self._util_init(t, line, v_way, write, enabled)
         self._fill_l1(t, is_icache, line, new_state, v_way)
+        self._emit("fill", tile=t, line=line, write=write, state=new_state)
         done = fill_l2 + l1_dat
         return done - clock_ps
 

@@ -108,6 +108,13 @@ class GoldenShL2:
                       "dram_total_lat_ps", "l2_cold_misses",
                       "l2_capacity_misses", "l2_sharing_misses")
         }
+        # optional protocol-event observer (analysis/protocol.py model
+        # checker); None in normal runs — zero semantic effect
+        self.event_cb = None
+
+    def _emit(self, etype: str, **kw) -> None:
+        if self.event_cb is not None:
+            self.event_cb(etype, kw)
 
     # -- timing helpers ----------------------------------------------------
 
@@ -201,6 +208,8 @@ class GoldenShL2:
             # a FLUSH of a clean line carries no data: INV_REP
             ack_is_inv = kind == "inv" or (kind == "flush" and not was_dirty)
         bits = mp.req_bits if ack_is_inv else mp.rep_bits
+        self._emit("serve", tile=s, home=home, line=line, kind=kind,
+                   supplies=ack_dirty)
         return self._net_arrive(s, home, bits, done, enabled), ack_dirty
 
     # -- L1 eviction notices at the home -----------------------------------
@@ -209,6 +218,7 @@ class GoldenShL2:
         home = self._home_of(line)
         if enabled:
             self.counters["evictions"][home] += 1
+        self._emit("evict", src=src, home=home, line=line, dirty=is_flush)
         entry, way = self._entry(home, line)
         if entry is None:
             return
@@ -237,6 +247,8 @@ class GoldenShL2:
             rtime = max(rtime, self.last_done[home])
         if enabled:
             c["dir_accesses"][home] += 1
+        self._emit("req", home=home, requester=requester, line=line,
+                   mtype="ex" if is_write else "sh")
 
         hit, way, l2_state = l2.lookup(line)
         if not hit:
@@ -255,6 +267,8 @@ class GoldenShL2:
                 # clean UNCACHED victim: silent kill (dirty -> DRAM)
                 if v_state == MODIFIED and enabled:
                     c["dram_writes"][home] += 1
+                self._emit("slice_kill", home=home, line=v_line,
+                           dirty=v_state == MODIFIED)
                 self.dir[home].pop((v_line % l2.sets, v_way), None)
                 l2.invalidate(v_line)
             eff_time = rtime + l2_acc
@@ -267,6 +281,7 @@ class GoldenShL2:
                     (mp.dram_latency_ns + mp.dram_processing_ns) * 1000)
             txn_time = max(eff_time,
                            eff_time + self._dram_rt(home, enabled))
+            self._emit("slice_fill", home=home, line=line, source="dram")
             l2.set_state(line, v_way, SHARED)
             entry = self.dir[home][(line % l2.sets, v_way)]
             way, l2_state = v_way, SHARED
@@ -341,6 +356,9 @@ class GoldenShL2:
                         home, list(targets), mp.req_bits, eff_time,
                         enabled)
                 for s in sorted(targets):
+                    self._emit("fwd", home=home, target=s, line=line,
+                               kind=targets[s], broadcast=broadcast)
+                for s in sorted(targets):
                     ack_time, dirty = self._serve_fwd(
                         s, targets[s], line, f_arrivals[s], home, enabled)
                     txn_time = max(txn_time, ack_time + l2_acc)
@@ -375,6 +393,8 @@ class GoldenShL2:
                                           enabled)
         self.last_line[home] = line
         self.last_done[home] = rep_ready
+        self._emit("reply", home=home, requester=requester, line=line,
+                   mtype=rep, source="slice")
         return (self._net_arrive(home, requester, mp.rep_bits, rep_ready,
                                  enabled), rep)
 
@@ -395,6 +415,8 @@ class GoldenShL2:
         # dir_accesses counts request pops + resumes only (the engine's
         # `starting` — the nullify runs inside the pop's iteration)
         eff_time = rtime + l2_acc
+        self._emit("req", home=home, requester=requester, line=v_line,
+                   mtype="nullify")
         if entry.dstate in (DIR_MODIFIED, DIR_EXCLUSIVE):
             targets = {entry.owner: "flush"}
         else:
@@ -419,6 +441,9 @@ class GoldenShL2:
             f_arrivals = self._net_fanout(home, list(targets), mp.req_bits,
                                           eff_time, enabled)
         for s in sorted(targets):
+            self._emit("fwd", home=home, target=s, line=v_line,
+                       kind=targets[s], broadcast=broadcast)
+        for s in sorted(targets):
             ack_time, dirty = self._serve_fwd(
                 s, targets[s], line=v_line, ftime=f_arrivals[s],
                 home=home, enabled=enabled)
@@ -427,6 +452,8 @@ class GoldenShL2:
         _, _, v_state = l2.lookup(v_line)
         if (v_state == MODIFIED or got_flush) and enabled:
             c["dram_writes"][home] += 1
+        self._emit("slice_kill", home=home, line=v_line,
+                   dirty=v_state == MODIFIED or got_flush)
         l2.invalidate(v_line)
         self.dir[home].pop((v_line % l2.sets, v_way), None)
         rep_ready = txn_time + self._sync(home, MOD_L2, MOD_NET_MEM,
@@ -469,6 +496,8 @@ class GoldenShL2:
                     c["l1d_write_hits"][t] += 1
                 else:
                     c["l1d_read_hits"][t] += 1
+            self._emit("hit", tile=t, line=line, write=write, level="l1",
+                       promoted=write and st == EXCLUSIVE)
             return sclock + l1_dat - clock_ps
         if enabled:
             if is_icache:
@@ -506,6 +535,7 @@ class GoldenShL2:
                 self._apply_eviction(t, v_line, v_state == MODIFIED,
                                      e_arr, enabled)
             l1.insert_at(line, v_way, new_state)
+        self._emit("fill", tile=t, line=line, write=write, state=new_state)
         return fill_ps - clock_ps
 
     # -- record entry (same interface as GoldenMemory) ---------------------

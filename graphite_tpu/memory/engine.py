@@ -2675,3 +2675,64 @@ def _requester_fill(mp, ms: MemState, rec: RecView, clock_ps, fmhz, enabled,
         evictions=ms.counters.evictions + (evict_go & enabled).astype(I64))
     progress = progress + jnp.sum(fill, dtype=jnp.int32)
     return ms.replace(counters=counters), progress
+
+
+# ---------------------------------------------------------------------------
+# Host-side census (analysis/protocol.py differential mode)
+# ---------------------------------------------------------------------------
+
+
+def line_census(ms: MemState, mp: MemParams, lines) -> dict:
+    """Abstract per-line coherence view of a (fetched) MemState.
+
+    Pure host-side numpy over the packed arrays — the model checker
+    compares this against the golden interpreter's abstract state after
+    replaying the same access sequence.  Returns, per line:
+    ``{"l1d": (state per tile), "l2": (state per tile),
+       "dir": (dstate, owner, frozenset(sharers)) | None,
+       "cdata": bool}`` (states are cache_array constants, 0 = absent).
+    """
+    l1d_tag = np.asarray(ms.l1d.tags)
+    l1d_st = np.asarray(ms.l1d.state)
+    l2_tag = np.asarray(ms.l2.tags)
+    l2_st = np.asarray(ms.l2.state)
+    entry = np.asarray(ms.directory.entry)
+    sharers = np.asarray(ms.directory.sharers)
+    cdata_line = np.asarray(ms.txn.cdata_line)
+    cdata_valid = np.asarray(ms.txn.cdata_valid)
+    T = mp.n_tiles
+    sw = mp.sharer_words
+
+    def cache_state(tag, st, line):
+        out = []
+        for t in range(T):
+            s = line % tag.shape[1]
+            hit = tag[t, s, :] == line
+            out.append(int(st[t, s, hit.argmax()]) if hit.any() else 0)
+        return tuple(out)
+
+    out = {}
+    for line in lines:
+        home = mp.mc_tiles[line % len(mp.mc_tiles)]
+        dset = line % mp.dir_sets
+        dent = None
+        for w in range(mp.dir_ways):
+            word = int(entry[home, dset, w])
+            if (word & ((1 << DIR_TAG_BITS) - 1)) - 1 != line:
+                continue
+            dstate = (word >> DIR_STATE_SHIFT) & 7
+            owner = ((word >> DIR_OWNER_SHIFT) & ((1 << DIR_ID_BITS) - 1)) - 1
+            bits = sharers[home, dset, w * sw:(w + 1) * sw]
+            shset = frozenset(
+                i * 32 + b for i in range(sw) for b in range(32)
+                if (int(bits[i]) >> b) & 1)
+            dent = (int(dstate), int(owner), shset)
+            break
+        out[line] = {
+            "l1d": cache_state(l1d_tag, l1d_st, line),
+            "l2": cache_state(l2_tag, l2_st, line),
+            "dir": dent,
+            "cdata": bool(
+                cdata_valid[home] and int(cdata_line[home]) == line),
+        }
+    return out

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from graphite_tpu.memory import cache_array as ca
@@ -1218,3 +1219,61 @@ def _requester_fill(mp, ms: ShL2State, rec: RecView, clock_ps, fmhz,
         evictions=ms.counters.evictions + (evict_go & enabled).astype(I64))
     progress = progress + jnp.sum(fill, dtype=jnp.int32)
     return ms.replace(counters=counters), progress
+
+
+# ---------------------------------------------------------------------------
+# Host-side census (analysis/protocol.py differential mode)
+# ---------------------------------------------------------------------------
+
+
+def shl2_line_census(ms: ShL2State, mp: MemParams, lines) -> dict:
+    """Abstract per-line coherence view of a (fetched) ShL2State.
+
+    Shared-L2 counterpart of `engine.line_census`: per line, the per-tile
+    L1I/L1D states, the home slice's L2 data state, and the embedded
+    directory entry at the slice way holding the line.  Pure host-side
+    numpy; see `analysis/protocol.py`.
+    """
+    l1i_tag = np.asarray(ms.l1i.tags)
+    l1i_st = np.asarray(ms.l1i.state)
+    l1d_tag = np.asarray(ms.l1d.tags)
+    l1d_st = np.asarray(ms.l1d.state)
+    l2_tag = np.asarray(ms.l2.tags)
+    l2_st = np.asarray(ms.l2.state)
+    word = np.asarray(ms.dir.word)
+    sharers = np.asarray(ms.dir.sharers)
+    T = mp.n_tiles
+    sw = mp.sharer_words
+
+    def cache_state(tag, st, t, line):
+        s = line % tag.shape[1]
+        hit = tag[t, s, :] == line
+        return int(st[t, s, hit.argmax()]) if hit.any() else 0
+
+    out = {}
+    for line in lines:
+        home = line % T
+        sset = line % l2_tag.shape[1]
+        slice_st = 0
+        dent = None
+        hit = l2_tag[home, sset, :] == line
+        if hit.any():
+            way = int(hit.argmax())
+            slice_st = int(l2_st[home, sset, way])
+            w = int(word[home, sset, way])
+            dstate = (w >> SHL2_STATE_SHIFT) & 7
+            owner = ((w >> SHL2_OWNER_SHIFT) & _ID_MASK) - 1
+            bits = sharers[home, sset, way * sw:(way + 1) * sw]
+            shset = frozenset(
+                i * 32 + b for i in range(sw) for b in range(32)
+                if (int(bits[i]) >> b) & 1)
+            dent = (int(dstate), int(owner), shset)
+        out[line] = {
+            "l1i": tuple(cache_state(l1i_tag, l1i_st, t, line)
+                         for t in range(T)),
+            "l1d": tuple(cache_state(l1d_tag, l1d_st, t, line)
+                         for t in range(T)),
+            "slice": slice_st,
+            "dir": dent,
+        }
+    return out

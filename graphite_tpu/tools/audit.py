@@ -8,7 +8,10 @@ device-less AbstractMesh) — and runs every jaxpr invariant lint
 (analysis/rules.py) over
 each: cond-payload (with the telemetry/profile ring avals in the
 forbidden set for recording programs), knob-fold, time-dtype,
-vmap-gate, host-sync, telemetry-off, profile-off.  Each program's STATIC COST report (analysis/cost.py —
+vmap-gate, host-sync, scatter-determinism, write-race (the round-20
+[T, k]-compaction gate — no ordered-multi-writer scatter into a req
+lane or mailbox matrix; `--lanes` emits the full classification
+table), telemetry-off, profile-off.  Each program's STATIC COST report (analysis/cost.py —
 per-iteration kernel proxy with per-phase attribution, bytes moved,
 peak-live residency) is emitted as a JSON line alongside the lint rows.
 Pure static analysis over `jax.make_jaxpr` output: no compile, no
@@ -71,6 +74,13 @@ def main(argv=None) -> int:
                     "matched by signature at any size)")
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero on warnings too (e.g. vmap-gate)")
+    ap.add_argument("--lanes", action="store_true",
+                    help="emit each program's write-race lane-"
+                    "classification table (req-lane / mailbox-matrix / "
+                    "engine-state scatters broken down by single-writer "
+                    "/ commutative / ordered — the [T, k] compaction "
+                    "input; reachable fan-in bounds come from "
+                    "tools/mc.py)")
     ap.add_argument("--programs", default=None,
                     help="comma-separated subset of program names "
                     "(default: all seven)")
@@ -276,6 +286,15 @@ def main(argv=None) -> int:
                     f"--budget-update")
             budget_findings = cost.check_budgets(cost_reports, budgets,
                                                  registry=lock)
+
+    if args.lanes:
+        from graphite_tpu.analysis import rules
+        for s in specs:
+            writes = rules.lane_writes(s.closed, s.n_tiles)
+            print(json.dumps({
+                "lanes": True, "program": s.name,
+                "n_scatters": len(writes),
+                "table": rules.lane_summary(writes)}))
 
     for f in report.findings:
         print(json.dumps(f.to_json()))

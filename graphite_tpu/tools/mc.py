@@ -1,0 +1,165 @@
+"""Bounded model checker CLI: exhaust the coherence protocols.
+
+Extracts the transition relation from the golden interpreters
+(`analysis/protocol.py`) and enumerates EVERY reachable
+(directory-entry, per-tile L1/L2 line-state, data-freshness)
+configuration of a small geometry — 2-4 tiles, 1-2 lines — for the
+MSI, MOSI, and shared-L2 MESI protocols, checking the classic
+invariants at every quiescent state and inside every transition:
+
+  single-writer-multiple-reader   one M/E holder, no concurrent S
+  data-value                      a read returns the last write
+  directory-cache-agreement       dir entry == the caches' truth
+  bounded-in-flight               request fan-out stays bounded
+  progress                        every access quiesces in bounded
+                                  events; no deadlock/livelock
+
+A violation prints a named counterexample: the access path from reset
+plus the violating transition's event sequence, rendered through the
+round-6 phase names (home_start/sharer/home_finish/...), then exits
+nonzero.
+
+Differential mode (on by default) closes the loop with the SHIPPED
+kernels: every explored transition is replayed one access at a time
+through the vectorized engines (`memory/engine.py`,
+`memory/engine_shl2.py`) at the same geometry, asserting the golden
+clock, every memory counter, and the full per-line cache/directory
+census are bit-equal — the checker verifies the compiled engines, not
+just the oracle.
+
+`--mutant` is the CI self-test (mirroring audit's
+`--regression-fixture`/`--lock-fixture`): it checks a deliberately
+broken transition relation — by default `mosi-owner-skips-wb`, the
+MOSI owner acking a writeback-fwd without supplying data — and MUST
+exit nonzero naming the violated invariant.  A mutant that explores
+clean means the checker lost its teeth.
+
+Output is JSON lines: one `mc` line per (protocol, geometry), one
+`violation` line per counterexample (with the rendered trace), one
+`diff` line per differential replay, then one trailing overall line.
+Exit code 0 iff every exploration and replay is clean (so `--mutant`
+exits 1 on success-of-the-self-test).
+
+Usage:
+  python -m graphite_tpu.tools.mc [--protocols msi,mosi,shl2_mesi]
+                                  [--tiles N] [--lines N]
+                                  [--no-differential] [--max-quanta N]
+                                  [--max-states N] [--mutant [NAME]]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="exhaustive coherence model checking over the "
+        "golden transition relation + differential replay through the "
+        "vectorized engines")
+    ap.add_argument("--protocols", default=None,
+                    help="comma-separated subset of msi,mosi,shl2_mesi "
+                    "(default: all three)")
+    ap.add_argument("--tiles", type=int, default=2,
+                    help="tile count of the checked geometry (2-4; "
+                    "state count grows fast)")
+    ap.add_argument("--lines", type=int, default=1,
+                    help="number of distinct cache lines (1-2; all "
+                    "map to the same set so they contend)")
+    ap.add_argument("--max-states", type=int, default=50000,
+                    help="exploration bound — exceeding it is a "
+                    "progress violation, not silent truncation")
+    ap.add_argument("--no-differential", action="store_true",
+                    help="skip the vectorized-engine replay (pure "
+                    "golden-model exploration; much faster)")
+    ap.add_argument("--max-quanta", type=int, default=4096,
+                    help="quantum bound for each replayed trace")
+    ap.add_argument("--mutant", nargs="?", const="mosi-owner-skips-wb",
+                    default=None, metavar="NAME",
+                    help="CI self-test: explore the named broken "
+                    "transition relation (default "
+                    "'mosi-owner-skips-wb') — MUST find a violation "
+                    "and exit nonzero naming the invariant")
+    args = ap.parse_args(argv)
+
+    # model checking is host-side; the differential replay jits tiny
+    # 2-4 tile programs — never touch a real chip
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import graphite_tpu  # noqa: F401  (x64)
+
+    from graphite_tpu.analysis import protocol as P
+
+    names = list(P.PROTOCOLS)
+    if args.protocols:
+        names = [s.strip() for s in args.protocols.split(",")
+                 if s.strip()]
+        unknown = [n for n in names if n not in P.PROTOCOLS]
+        if unknown:
+            ap.error(f"unknown protocol(s) {unknown} "
+                     f"(choose from {', '.join(P.PROTOCOLS)})")
+    if args.mutant is not None:
+        if args.mutant not in P.MUTANT_NAMES:
+            ap.error(f"unknown mutant {args.mutant!r} "
+                     f"(choose from {', '.join(P.MUTANT_NAMES)})")
+        # every registered mutant breaks a private-L2 protocol; the
+        # self-test pins the protocol the mutation is meaningful for
+        names = ["mosi"]
+
+    t0 = time.perf_counter()
+    ok = True
+    n_violations = 0
+    for name in names:
+        res = P.explore(name, args.tiles, args.lines,
+                        mutant=args.mutant,
+                        max_states=args.max_states)
+        print(json.dumps({
+            "mc": True, "protocol": name, "mutant": args.mutant,
+            "tiles": args.tiles, "lines": list(res.lines),
+            "states_explored": res.states_explored,
+            "transitions": res.transitions,
+            "histogram": res.histogram,
+            "fan_in": res.fan_in,
+            "max_in_flight": res.max_in_flight,
+            "violations": len(res.violations),
+            "ok": res.ok}))
+        for v in res.violations:
+            n_violations += 1
+            print(json.dumps({
+                "violation": True, "protocol": name,
+                "mutant": args.mutant, "invariant": v.invariant,
+                "message": v.message,
+                "path": [str(a) for a in v.path],
+                "events": list(v.events),
+                "counterexample": v.render()}))
+            print(f"counterexample ({name}"
+                  + (f", mutant {args.mutant}" if args.mutant else "")
+                  + f"):\n{v.render()}", file=sys.stderr)
+        ok = ok and res.ok
+        if res.ok and not args.no_differential \
+                and args.mutant is None:
+            d = P.differential(res, max_quanta=args.max_quanta)
+            print(json.dumps({
+                "diff": True, "protocol": name,
+                "n_transitions": d.n_transitions, "n_ok": d.n_ok,
+                "mismatches": d.mismatches[:8], "ok": d.ok}))
+            ok = ok and d.ok
+
+    print(json.dumps({
+        "overall": True, "ok": ok, "mutant": args.mutant,
+        "protocols": names, "violations": n_violations,
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+    if args.mutant is not None and ok:
+        # the self-test's failure mode: the broken relation explored
+        # clean, so the checker would not catch a real regression
+        print(f"mutant {args.mutant!r} explored CLEAN — the checker "
+              f"failed to detect the seeded bug", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

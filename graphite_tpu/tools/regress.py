@@ -36,7 +36,12 @@ controller bin-packing a too-big-for-one-device sim across devices
 DVFS manager must be invisible at the config's own frequencies
 (carried-frequency engines and the B=4 campaign bit-identical to the
 constant-folded ones), match the hand-stepped golden interpreter on
-in-trace DVFS_SET retunes, and govern deterministically (rung 13).
+in-trace DVFS_SET retunes, and govern deterministically (rung 13),
+and the round-20 bounded model checker must exhaust the 2-tile/1-line
+MSI and MOSI state spaces with zero invariant violations, replay every
+explored transition bit-equal through the vectorized engines, and
+catch the seeded 'mosi-owner-skips-wb' mutant with a named data-value
+counterexample (rung 14).
 """
 
 from __future__ import annotations
@@ -676,6 +681,39 @@ scheme = lax
     ok = (np.array_equal(gov_runs[0][1], gov_runs[1][1])
           and np.array_equal(gov_runs[0][2], gov_runs[1][2]))
     print(f"{'governor determinism (final V/f state)':44} "
+          f"{'PASS' if ok else 'FAIL'}")
+    failures += 0 if ok else 1
+
+    # 14) bounded model checking (round 20, analysis/protocol.py): the
+    #     2-tile/1-line MSI and MOSI explorations must exhaust with
+    #     ZERO invariant violations, every explored transition must
+    #     replay bit-equal through the vectorized engine
+    #     (differential mode — the checker attests the SHIPPED
+    #     kernels), and the seeded 'mosi-owner-skips-wb' mutant must
+    #     be caught with a named data-value counterexample (the
+    #     checker's own self-test: a mutant that explores clean means
+    #     the rung lost its teeth).
+    from graphite_tpu.analysis import protocol as _P
+
+    for proto in ("msi", "mosi"):
+        res = _P.explore(proto, 2, 1)
+        ok = res.ok and res.states_explored > 0
+        print(f"{f'model check {proto} 2t/1l exhaustive':44} "
+              f"{'PASS' if ok else 'FAIL'}"
+              + ("" if ok else
+                 f"  ({[v.invariant for v in res.violations]})"))
+        failures += 0 if ok else 1
+        if ok:
+            d = _P.differential(res)
+            ok = d.ok and d.n_ok == res.transitions
+            print(f"{f'differential replay {proto} ({d.n_ok} trans)':44} "
+                  f"{'PASS' if ok else 'FAIL'}")
+            failures += 0 if ok else 1
+
+    mres = _P.explore("mosi", 2, 1, mutant="mosi-owner-skips-wb")
+    ok = (not mres.ok
+          and any(v.invariant == "data-value" for v in mres.violations))
+    print(f"{'mutant self-test names data-value':44} "
           f"{'PASS' if ok else 'FAIL'}")
     failures += 0 if ok else 1
 

@@ -135,6 +135,96 @@ class TestFingerprint:
 
 
 # ---------------------------------------------------------------------------
+# eqn-count divergences carry the containing phase (round-20 fix)
+# ---------------------------------------------------------------------------
+
+
+PHASE_NAMES = ("requester", "home_evict", "home_start", "sharer",
+               "home_finish", "requester_fill")
+PC_TILES = 4
+
+
+class TestEqnCountPhaseAttribution:
+    def test_deep_eqn_count_divergence_names_phase(self):
+        """An extra trailing equation deep inside a phase cond arm (a
+        nested jit region, mimicking the engine's lowering shape) is
+        reported as eqn-count WITH the phase whose gating cond encloses
+        it — here the third phase cond in program order."""
+        def mk(extra):
+            def phase(k, x, m, extra_here):
+                def inner(v):
+                    s = jnp.sum(v * (k + 1.0))
+                    if extra_here:
+                        s = s * 0.5
+                    return s
+
+                def t_arm(x, m):
+                    s = jax.jit(inner)(x)
+                    return (m + jnp.uint8(1),
+                            jnp.int32(k)
+                            + jnp.asarray(s, jnp.int32) * 0)
+
+                def f_arm(x, m):
+                    return (m, jnp.int32(0))
+                return jax.lax.cond(x[0] > k, t_arm, f_arm, x, m)
+
+            def body(c):
+                x, m, i = c
+                for k in range(4):
+                    m, _p = phase(k, x, m, extra and k == 2)
+                return (x * 0.99, m, i + 1)
+
+            def fn(x, m):
+                return jax.lax.while_loop(
+                    lambda c: c[2] < 3, body, (x, m, jnp.int32(0)))
+            return jax.make_jaxpr(fn)(
+                jnp.ones((8,)),
+                jnp.zeros((PC_TILES, PC_TILES), jnp.uint8))
+
+        d = identity.structural_diff(mk(False), mk(True),
+                                     n_tiles=PC_TILES,
+                                     phase_names=PHASE_NAMES)
+        assert d is not None and d.kind == "eqn-count"
+        assert d.phase == "home_start"
+        assert "cond/branches[1]" in d.site
+        assert "extra equation" in d.detail
+
+    def test_subprogram_count_divergence_names_owning_phase(self):
+        """The round-20 fix proper: a phase cond whose BRANCH LIST
+        changed length (the sub-jaxpr count divergence) must be
+        attributed to that cond's OWN phase and reported as eqn-count
+        — before the fix it reported kind 'params' with the ENCLOSING
+        phase (None at top level), losing the attribution."""
+        def t_arm(x, m):
+            return (m + jnp.uint8(1), jnp.int32(1))
+
+        def f_arm(x, m):
+            return (m, jnp.int32(0))
+
+        def fn(x, m):
+            return jax.lax.cond(x[0] > 0, t_arm, f_arm, x, m)
+
+        c = jax.make_jaxpr(fn)(
+            jnp.ones((8,)),
+            jnp.zeros((PC_TILES, PC_TILES), jnp.uint8))
+        j = c.jaxpr
+        k = next(i for i, e in enumerate(j.eqns)
+                 if e.primitive.name == "cond")
+        eqn = j.eqns[k]
+        br = tuple(eqn.params["branches"])
+        grown = j.replace(eqns=[
+            e if i != k else eqn.replace(
+                params={**eqn.params, "branches": br + (br[0],)})
+            for i, e in enumerate(j.eqns)])
+        c2 = jax.core.ClosedJaxpr(grown, c.consts)
+        d = identity.structural_diff(c, c2, n_tiles=PC_TILES,
+                                     phase_names=PHASE_NAMES)
+        assert d is not None and d.kind == "eqn-count"
+        assert d.phase == "requester"
+        assert "2 sub-program(s) in A but 3 in B" in d.detail
+
+
+# ---------------------------------------------------------------------------
 # real-program identity: the acceptance claims
 # ---------------------------------------------------------------------------
 

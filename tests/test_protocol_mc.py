@@ -1,0 +1,315 @@
+"""Bounded model checker + write-race lane analysis
+(analysis/protocol.py, tools/mc.py, the rules.write_race lint).
+
+Five layers under test: the EXHAUSTIVE exploration itself (the pinned
+reached-state census — states_explored, transitions, per-protocol
+state histograms — so a coverage regression is loud, with the MOSI
+O-state and shl2-MESI E-state corners asserted explicitly), the
+invariant checkers (the seeded mutant MUST produce a named data-value
+counterexample rendered through the round-6 phase names), the
+differential replay (every explored transition bit-equal through the
+vectorized engines), the write-race lane lint (every scatter in the
+registered programs classifies single-writer or commutative; a
+synthetic racy lane/matrix trips the error gate), and the `tools/mc.py`
+CLI (clean default run exits 0; `--mutant` exits 1 naming the
+invariant).
+
+The golden census values are the point, not incidental: if a protocol
+change legitimately shrinks or grows the reachable space, update them
+HERE with the change that did it.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from graphite_tpu.analysis import protocol as P
+from graphite_tpu.analysis import rules
+from graphite_tpu.memory.engine import PHASE_NAMES
+from graphite_tpu.memory.engine_shl2 import SHL2_PHASE_NAMES
+
+
+# ---------------------------------------------------------------------------
+# exhaustive exploration: the reached-state census
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def msi_2t_1l():
+    return P.explore("msi", 2, 1)
+
+
+@pytest.fixture(scope="module")
+def mosi_2t_1l():
+    return P.explore("mosi", 2, 1)
+
+
+@pytest.fixture(scope="module")
+def shl2_2t_1l():
+    return P.explore("shl2_mesi", 2, 1)
+
+
+class TestCensus:
+    def test_msi_2t_1l(self, msi_2t_1l):
+        r = msi_2t_1l
+        assert r.ok, [v.render() for v in r.violations]
+        assert r.states_explored == 6
+        assert r.transitions == 24
+        assert r.histogram == {"dir:M": 2, "dir:Sh": 3, "l1d:M": 2,
+                               "l1d:S": 3, "l2:M": 2, "l2:S": 3}
+
+    def test_mosi_2t_1l_covers_o_state(self, mosi_2t_1l):
+        """The MOSI corner enumeration surfaces: the OWNED state must
+        be reached in the directory AND both cache levels (a write
+        followed by another tile's read leaves the writer the owner)."""
+        r = mosi_2t_1l
+        assert r.ok, [v.render() for v in r.violations]
+        assert r.states_explored == 8
+        assert r.transitions == 32
+        assert r.histogram["dir:O"] == 2
+        assert r.histogram["l1d:O"] == 2
+        assert r.histogram["l2:O"] == 2
+
+    def test_shl2_2t_1l_covers_e_state(self, shl2_2t_1l):
+        """The shl2-MESI corner: EXCLUSIVE must be reached (first read
+        of an uncached line), including the silent E->M promotion the
+        directory only learns about later (dir:E with the holder's L1
+        already M is a legal reachable configuration)."""
+        r = shl2_2t_1l
+        assert r.ok, [v.render() for v in r.violations]
+        assert r.states_explored == 11
+        assert r.transitions == 44
+        assert r.histogram["dir:E"] == 4
+        assert r.histogram["l1d:E"] == 2
+
+    def test_fan_in_bounds_2t_1l(self, msi_2t_1l, mosi_2t_1l,
+                                 shl2_2t_1l):
+        """The [T, k] compaction input: at T=2 every mailbox matrix has
+        reachable fan-in 1 and at most one forwarded sharer is ever in
+        flight on top of the request itself."""
+        for r in (msi_2t_1l, mosi_2t_1l):
+            assert r.fan_in == {"req": 1, "fwd": 1, "ack": 1,
+                                "evict": 1}
+            assert r.max_in_flight == 2
+        assert shl2_2t_1l.fan_in == {"req": 1, "fwd": 1, "ack": 1,
+                                     "evict": 0}
+        assert shl2_2t_1l.max_in_flight == 2
+
+    @pytest.mark.parametrize("protocol,tiles,lines,states,transitions", [
+        ("msi", 2, 2, 39, 312),
+        ("mosi", 2, 2, 67, 536),
+        ("shl2_mesi", 2, 2, 21, 168),
+        ("mosi", 3, 1, 20, 120),
+        ("msi", 4, 1, 20, 160),
+    ])
+    def test_bigger_geometries_exhaust_clean(self, protocol, tiles,
+                                             lines, states,
+                                             transitions):
+        r = P.explore(protocol, tiles, lines)
+        assert r.ok, [v.render() for v in r.violations]
+        assert r.states_explored == states
+        assert r.transitions == transitions
+
+    def test_unknown_protocol_raises(self):
+        with pytest.raises(ValueError, match="unknown protocol"):
+            P.explore("mesif", 2, 1)
+
+
+# ---------------------------------------------------------------------------
+# phase-name rendering: counterexamples speak round-6 phases
+# ---------------------------------------------------------------------------
+
+
+class TestPhaseRendering:
+    def test_event_phase_maps_cover_engine_phases(self):
+        """Every event kind renders through a REAL engine phase name —
+        the maps index into PHASE_NAMES/SHL2_PHASE_NAMES, so a phase
+        reorder in the engines breaks this loudly."""
+        assert set(P._PRIV_PHASE.values()) <= set(range(len(PHASE_NAMES)))
+        assert set(P._SHL2_PHASE.values()) \
+            <= set(range(len(SHL2_PHASE_NAMES)))
+        assert P.render_event("msi", "req",
+                              {"home": 0, "requester": 1,
+                               "line": 256, "mtype": "SH",
+                               "dstate": 0}).startswith("home_start:")
+        assert P.render_event(
+            "shl2_mesi", "fill",
+            {"tile": 1, "line": 256, "write": True,
+             "state": 3}).startswith("requester_fill:")
+
+
+# ---------------------------------------------------------------------------
+# the seeded mutant: the checker's own self-test
+# ---------------------------------------------------------------------------
+
+
+class TestMutant:
+    def test_mutant_names_data_value_violation(self):
+        r = P.explore("mosi", 2, 1, mutant="mosi-owner-skips-wb")
+        assert not r.ok
+        v = r.violations[0]
+        assert v.invariant == "data-value"
+        text = v.render()
+        assert "invariant violated: data-value" in text
+        # the counterexample is rendered through round-6 phase names
+        for phase in ("home_start", "sharer", "home_finish",
+                      "requester_fill"):
+            assert phase + ":" in text
+        # and carries the access path from reset
+        assert "path from reset" in text and "W line" in text
+
+    def test_mutant_rejected_for_shl2(self):
+        with pytest.raises(ValueError):
+            P.explore("shl2_mesi", 2, 1, mutant="mosi-owner-skips-wb")
+
+    def test_unknown_mutant_rejected(self):
+        with pytest.raises(ValueError):
+            P.explore("mosi", 2, 1, mutant="no-such-mutant")
+
+
+# ---------------------------------------------------------------------------
+# differential replay: the shipped kernels, not just the oracle
+# ---------------------------------------------------------------------------
+
+
+class TestDifferential:
+    def test_msi_every_transition_bit_equal(self, msi_2t_1l):
+        d = P.differential(msi_2t_1l)
+        assert d.n_transitions == 24
+        assert d.n_ok == 24
+        assert d.ok, d.mismatches[:3]
+
+    def test_shl2_every_transition_bit_equal(self, shl2_2t_1l):
+        d = P.differential(shl2_2t_1l)
+        assert d.n_transitions == 44
+        assert d.ok, d.mismatches[:3]
+
+
+# ---------------------------------------------------------------------------
+# write-race lane lint
+# ---------------------------------------------------------------------------
+
+
+T = 4
+
+
+def _lane_closed():
+    """A racy [T] lane: replace-scatter whose rows come from an opaque
+    argument — no writer proof can hold."""
+    return jax.make_jaxpr(
+        lambda m, i, v: m.at[i].set(v))(
+        jnp.zeros((T,), jnp.uint8), jnp.zeros((3,), jnp.int32),
+        jnp.zeros((3,), jnp.uint8))
+
+
+def _matrix_closed():
+    return jax.make_jaxpr(
+        lambda m, i, v: m.at[i].set(v))(
+        jnp.zeros((T, T), jnp.uint8), jnp.zeros((3,), jnp.int32),
+        jnp.zeros((3, T), jnp.uint8))
+
+
+class TestWriteRaceLint:
+    def test_gated_msi_classifies_clean(self):
+        """Acceptance: every scatter in the registered engine program
+        classifies single-writer or commutative — and the req lanes
+        specifically are ALL single-writer."""
+        from graphite_tpu.analysis.audit import default_programs
+        spec = default_programs(T, 64, names=("gated-msi",))[0]
+        writes = rules.lane_writes(spec.closed, spec.n_tiles)
+        assert writes, "no scatters found — the walk is broken"
+        assert all(w.classification != rules.CLASS_ORDERED
+                   for w in writes)
+        # the round-12 request lanes proper are the uint8 [T] scatters
+        # (the int64 lane-shaped writes include the commutative event
+        # heap); every one must carry a writer PROOF, not just a
+        # commutative combiner
+        req = [w for w in writes if w.kind == rules.LANE_REQ
+               and w.dtype == "uint8"]
+        assert req and all(
+            w.classification == rules.CLASS_SINGLE for w in req)
+        mat = [w for w in writes if w.kind == rules.LANE_MATRIX]
+        assert mat, "no mailbox-matrix scatters found"
+        assert rules.write_race(spec.closed, spec.n_tiles) == []
+        table = rules.lane_summary(writes)
+        assert set(table) <= {rules.LANE_REQ, rules.LANE_MATRIX,
+                              rules.LANE_STATE}
+
+    def test_racy_req_lane_trips_gate(self):
+        fs = rules.write_race(_lane_closed(), T)
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.severity == rules.SEV_ERROR
+        assert f.rule == "write-race"
+        assert "req-lane" in f.message
+        assert f.data["classification"] == rules.CLASS_ORDERED
+
+    def test_racy_matrix_trips_gate_with_fan_in(self):
+        fan = {"req": 1, "fwd": 1, "ack": 1, "evict": 1}
+        fs = rules.write_race(_matrix_closed(), T, fan_in=fan)
+        assert len(fs) == 1
+        assert fs[0].severity == rules.SEV_ERROR
+        assert "mailbox-matrix" in fs[0].message
+        assert fs[0].data["fan_in"] == fan
+
+    def test_single_writer_lane_passes(self):
+        """An iota-indexed lane write (each tile writes its own lane —
+        the round-12 shape) must prove single-writer and pass."""
+        def fn(m, v):
+            return m.at[jnp.arange(T)].set(v)
+        closed = jax.make_jaxpr(fn)(jnp.zeros((T,), jnp.uint8),
+                                    jnp.zeros((T,), jnp.uint8))
+        assert rules.write_race(closed, T) == []
+        (w,) = rules.lane_writes(closed, T)
+        assert w.kind == rules.LANE_REQ
+        assert w.classification == rules.CLASS_SINGLE
+
+    def test_commutative_matrix_passes_as_commutative(self):
+        def fn(m, i, v):
+            return m.at[i].add(v)
+        closed = jax.make_jaxpr(fn)(jnp.zeros((T, T), jnp.int64),
+                                    jnp.zeros((3,), jnp.int32),
+                                    jnp.zeros((3, T), jnp.int64))
+        assert rules.write_race(closed, T) == []
+        (w,) = rules.lane_writes(closed, T)
+        assert w.classification == rules.CLASS_COMMUTATIVE
+
+
+# ---------------------------------------------------------------------------
+# the CLI
+# ---------------------------------------------------------------------------
+
+
+class TestCLI:
+    def test_default_exploration_exits_zero(self, capsys):
+        from graphite_tpu.tools.mc import main
+        assert main(["--no-differential"]) == 0
+        rows = [json.loads(ln) for ln in
+                capsys.readouterr().out.splitlines() if ln]
+        mc = [r for r in rows if r.get("mc")]
+        assert {r["protocol"] for r in mc} \
+            == {"msi", "mosi", "shl2_mesi"}
+        assert all(r["ok"] and r["violations"] == 0 for r in mc)
+        overall = next(r for r in rows if r.get("overall"))
+        assert overall["ok"]
+
+    def test_mutant_exits_nonzero_naming_invariant(self, capsys):
+        from graphite_tpu.tools.mc import main
+        assert main(["--mutant", "--no-differential"]) == 1
+        out = capsys.readouterr()
+        rows = [json.loads(ln) for ln in out.out.splitlines() if ln]
+        vio = [r for r in rows if r.get("violation")]
+        assert vio and vio[0]["invariant"] == "data-value"
+        assert "home_start:" in vio[0]["counterexample"]
+        overall = next(r for r in rows if r.get("overall"))
+        assert not overall["ok"]
+        assert overall["mutant"] == "mosi-owner-skips-wb"
+
+    def test_unknown_protocol_and_mutant_error(self):
+        from graphite_tpu.tools.mc import main
+        with pytest.raises(SystemExit):
+            main(["--protocols", "mesif"])
+        with pytest.raises(SystemExit):
+            main(["--mutant", "bogus"])
