@@ -457,6 +457,68 @@ scheme = lax
             "profile_traffic_gini": pf_summary.get("traffic_gini"),
         })
 
+    # Latency-histogram overhead (round 21, obs/hist.py): warm
+    # per-iteration cost of the DENSE commit-site scatter-add recording
+    # (every available source into the log2 bucket ladder — the worst
+    # case) vs the scalar telemetry ring alone vs recording nothing,
+    # on the same 16-tile coherence program, plus the deterministic
+    # miss-service-latency quantiles CI tracks.  MEDIANS of
+    # BENCH_HIST_REPS warm runs.  Skippable via BENCH_HIST=0.
+    if os.environ.get("BENCH_HIST", "1") != "0":
+        import statistics as _stats_h
+
+        from graphite_tpu.obs import HistSpec, TelemetrySpec
+        from graphite_tpu.tools._template import config_text
+
+        hs_tiles = int(os.environ.get("BENCH_HIST_TILES", "16"))
+        hs_reps = max(1, int(os.environ.get("BENCH_HIST_REPS", "3")))
+        sc_hs = SimConfig(ConfigFile.from_string(config_text(
+            hs_tiles, shared_mem=True, clock_scheme="lax_barrier")))
+        hs_trace = synthetic.memory_stress_trace(
+            hs_tiles, n_accesses=24, working_set_bytes=1 << 13,
+            write_fraction=0.4, shared_fraction=0.5, seed=7)
+
+        def _median_ms_iter_h(mk):
+            # fresh instance per rep adopting the warmed donor's
+            # runner — same shape as the profile block's sampler
+            donor = mk()
+            donor.warmup()
+            samples = []
+            res2 = sim2 = None
+            for _ in range(hs_reps):
+                sim2 = mk()
+                sim2.adopt_runner(donor)
+                t0 = time.perf_counter()
+                res2 = sim2.run()
+                wall = time.perf_counter() - t0
+                assert int(sim2.last_n_iterations) > 0
+                samples.append(
+                    1000 * wall / int(sim2.last_n_iterations))
+            return _stats_h.median(samples), res2, sim2
+
+        probe_h = Simulator(sc_hs, hs_trace)
+        tel_h = TelemetrySpec(
+            sample_interval_ps=int(probe_h.quantum_ps), n_samples=256)
+        ms_hs_off, _, _ = _median_ms_iter_h(
+            lambda: Simulator(sc_hs, hs_trace))
+        ms_hs_tel, _, _ = _median_ms_iter_h(
+            lambda: Simulator(sc_hs, hs_trace, telemetry=tel_h))
+        ms_hs_on, hs_res, hs_sim = _median_ms_iter_h(
+            lambda: Simulator(sc_hs, hs_trace, hist=HistSpec()))
+        hist = hs_res.hist
+        companions.update({
+            "ms_per_iter_hist_off": round(ms_hs_off, 4),
+            "ms_per_iter_hist_scalar_ring": round(ms_hs_tel, 4),
+            "ms_per_iter_hist": round(ms_hs_on, 4),
+            "hist_overhead_pct": round(
+                100 * (ms_hs_on / ms_hs_off - 1), 2),
+            "hist_ring_bytes": int(
+                hs_sim.residency_breakdown()["hist"]),
+            "miss_lat_p50_ps": hist.quantile("miss_lat_ps", 0.5),
+            "miss_lat_p95_ps": hist.quantile("miss_lat_ps", 0.95),
+            "miss_lat_p99_ps": hist.quantile("miss_lat_ps", 0.99),
+        })
+
     # Campaign-service throughput (round 13, serve/ subsystem): N
     # same-class jobs submitted through the admission-controlled
     # service, batched and served off the fingerprint-keyed compiled-

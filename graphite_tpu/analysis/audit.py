@@ -79,6 +79,14 @@ class ProgramSpec:
     expect_profile: bool = False
     profile_sig: "tuple | None" = None     # ((S, T, m), dtype)
     profile_extra_sigs: "tuple" = ()
+    # round 21: the latency-histogram bucket-count ring ([H, B]
+    # aggregate or [T, H, B] per-tile int64) — hist-ON programs forbid
+    # the ring as a cond payload; hist-OFF programs run the hist-off
+    # rule over the canonical dense (and per-tile / dense-plus-energy)
+    # ring sigs
+    expect_hist: bool = False
+    hist_sig: "tuple | None" = None        # ((H, B) | (T, H, B), dtype)
+    hist_extra_sigs: "tuple" = ()
     # round 19: the runtime DVFS manager.  dvfs-ON programs carry the
     # per-domain operating point in the carry (SimState.dvfs_rt);
     # dvfs-OFF programs run the dvfs-off rule — no dvfs_rt invar may
@@ -184,6 +192,28 @@ def _profile_fields(sim):
     return (), False, dense_sig, (energy_sig,)
 
 
+def _hist_fields(sim):
+    """The latency-histogram policing shared by both spec builders:
+    (extra forbidden cond avals, expect_hist, hist_sig,
+    hist_extra_sigs) — the round-21 twin of `_profile_fields`.
+    Hist-ON programs forbid the attached spec's actual bucket-count
+    ring as a cond payload; hist-OFF programs get the canonical dense
+    aggregate [H, B] ring sig plus the per-tile [T, H, B] and
+    dense-plus-energy variants, so the hist-off aval scan stays a live
+    check for every recording layout."""
+    hs = getattr(sim, "hist_spec", None)
+    if hs is not None:
+        return (hs.buffer_sig(),), True, hs.buffer_sig(), ()
+    from graphite_tpu.obs.hist import HistSpec
+    from graphite_tpu.obs.telemetry import EnergyPrices
+
+    dense_sig = HistSpec().resolve(sim.params).buffer_sig()
+    tile_sig = HistSpec(per_tile=True).resolve(sim.params).buffer_sig()
+    energy_sig = HistSpec(
+        energy_prices=EnergyPrices()).resolve(sim.params).buffer_sig()
+    return (), False, dense_sig, (tile_sig, energy_sig)
+
+
 def spec_from_simulator(name: str, sim,
                         max_quanta: int = 4096) -> ProgramSpec:
     """Lower a Simulator's single-device resident program into a spec."""
@@ -199,12 +229,14 @@ def spec_from_simulator(name: str, sim,
         _telemetry_fields(sim)
     prof_forbidden, expect_prof, prof_sig, prof_extra = \
         _profile_fields(sim)
+    hist_forbidden, expect_hist, hist_sig, hist_extra = \
+        _hist_fields(sim)
     return ProgramSpec(
         name=name, closed=closed, invar_paths=paths,
         n_tiles=sim.params.n_tiles, expect_gated=expect_gated,
         n_phases=n_phases,
         forbidden_cond_avals=(_mem_forbidden_avals(sim) + tel_forbidden
-                              + prof_forbidden),
+                              + prof_forbidden + hist_forbidden),
         clock_invars=clock_invar_indices(paths),
         expect_telemetry=expect_tel,
         telemetry_sig=tel_sig,
@@ -212,6 +244,9 @@ def spec_from_simulator(name: str, sim,
         expect_profile=expect_prof,
         profile_sig=prof_sig,
         profile_extra_sigs=prof_extra,
+        expect_hist=expect_hist,
+        hist_sig=hist_sig,
+        hist_extra_sigs=hist_extra,
         expect_dvfs=getattr(sim, "dvfs_spec", None) is not None,
         phase_names=phase_names)
 
@@ -265,12 +300,14 @@ def spec_from_sweep(name: str, runner,
         _telemetry_fields(sim)
     prof_forbidden, expect_prof, prof_sig, prof_extra = \
         _profile_fields(sim)
+    hist_forbidden, expect_hist, hist_sig, hist_extra = \
+        _hist_fields(sim)
     return ProgramSpec(
         name=name, closed=closed, invar_paths=paths,
         n_tiles=sim.params.n_tiles, expect_gated=expect_gated,
         n_phases=n_phases, knob_invars=knob_invars,
         forbidden_cond_avals=(_mem_forbidden_avals(sim) + tel_forbidden
-                              + prof_forbidden),
+                              + prof_forbidden + hist_forbidden),
         clock_invars=clock_invar_indices(paths),
         expect_telemetry=expect_tel,
         telemetry_sig=tel_sig,
@@ -278,6 +315,9 @@ def spec_from_sweep(name: str, runner,
         expect_profile=expect_prof,
         profile_sig=prof_sig,
         profile_extra_sigs=prof_extra,
+        expect_hist=expect_hist,
+        hist_sig=hist_sig,
+        hist_extra_sigs=hist_extra,
         expect_dvfs=getattr(sim, "dvfs_spec", None) is not None,
         phase_names=phase_names,
         batched=not runner.shard_batch or runner._sims_per_dev > 1)
@@ -290,7 +330,8 @@ def spec_from_sweep(name: str, runner,
 
 DEFAULT_PROGRAM_NAMES = ("gated-msi", "ungated-msi", "shl2-mesi",
                          "sweep-b4", "gated-msi-tel", "sweep-b4-tel",
-                         "sweep-b4-2d", "sweep-b4-dvfs")
+                         "sweep-b4-2d", "sweep-b4-dvfs",
+                         "gated-msi-hist")
 
 # cache/directory geometry chosen so the directory entry/sharers avals
 # are UNIQUE in the program (same trick as the phase-gating test) — a
@@ -337,7 +378,7 @@ def gated_msi_simulator(tiles: int = 8, extra_cfg: str = ""):
 
 def default_programs(tiles: int = 8, max_quanta: int = 4096,
                      names=None) -> "list[ProgramSpec]":
-    """The eight audited shapes: gated, ungated, shl2, sweep B=4, the
+    """The nine audited shapes: gated, ungated, shl2, sweep B=4, the
     telemetry-recording gated engine (round 9: the ring's aval joins
     the cond-payload forbidden set; telemetry-OFF programs additionally
     run the telemetry-off lint), the COMBINED sweep-B=4 + telemetry
@@ -350,7 +391,9 @@ def default_programs(tiles: int = 8, max_quanta: int = 4096,
     runtime-DVFS sweep campaign (round 19: a genuinely two-domain
     config sweeping a dvfs_domain_mhz grid — the carried-frequency
     program where both the sync-delay knob and the frequency grid must
-    prove live).
+    prove live), plus the latency-histogram gated engine (round 21: the
+    dense bucket-count ring joins the cond-payload forbidden set and
+    the commit-site scatters meet every structural lint).
 
     Small geometry on purpose — the lints are structural, so the
     8-tile lowering carries the same program shape the 1024-tile
@@ -448,6 +491,16 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
         runner_2d = SweepRunner(sc_sweep, sweep_traces, layout=(2, 2))
         specs.append(spec_from_sweep("sweep-b4-2d", runner_2d,
                                      max_quanta))
+    if "gated-msi-hist" in names:
+        # the round-21 latency-histogram program: the dense bucket-count
+        # ring in the carry — its [H, B] aval joins the cond-payload
+        # forbidden set, and the commit-site scatters must stay
+        # deterministic / host-sync-free like every other ring
+        from graphite_tpu.obs import HistSpec
+
+        specs.append(spec_from_simulator("gated-msi-hist", Simulator(
+            sc, batch, phase_gate=True, mem_gate_bytes=0,
+            hist=HistSpec()), max_quanta))
     if "sweep-b4-dvfs" in names:
         # the round-19 runtime-DVFS campaign: the SAME B=4 sweep with a
         # GENUINELY multi-domain [dvfs] table (note `domains =` under
@@ -488,7 +541,7 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
 
 RULE_NAMES = ("cond-payload", "knob-fold", "time-dtype", "vmap-gate",
               "host-sync", "scatter-determinism", "write-race",
-              "telemetry-off", "profile-off", "dvfs-off")
+              "telemetry-off", "profile-off", "hist-off", "dvfs-off")
 
 
 @dataclasses.dataclass
@@ -595,6 +648,15 @@ def audit_program(spec: ProgramSpec, *,
                         if spec.profile_sig is not None else ())
                        + tuple(spec.profile_extra_sigs)),
             state_key="profile", rule="profile-off"))
+    if not spec.expect_hist:
+        # hist-OFF programs must carry no trace of the latency
+        # histograms — same rule, hist state key + bucket-ring sigs
+        add("hist-off", rules.telemetry_off(
+            spec.closed, spec.invar_paths,
+            ring_sigs=(((spec.hist_sig,)
+                        if spec.hist_sig is not None else ())
+                       + tuple(spec.hist_extra_sigs)),
+            state_key="hist", rule="hist-off"))
     if not spec.expect_dvfs:
         # dvfs=None programs must carry no runtime-DVFS manager state:
         # no `dvfs_rt` invar may survive (the carried operating point

@@ -94,6 +94,7 @@ def trace_record_bytes(trace) -> int:
 
 def residency_breakdown(*, state=None, trace=None, batch: int = 1,
                         telemetry_spec=None, profile_spec=None,
+                        hist_spec=None,
                         stream_window_bytes: "int | None" = None,
                         ) -> "dict[str, int]":
     """Itemized HBM residency estimate, bytes per consumer.
@@ -104,7 +105,9 @@ def residency_breakdown(*, state=None, trace=None, batch: int = 1,
     NOT multiplied).  `telemetry_spec`: a resolved obs.TelemetrySpec
     whose ring rides each sim's carry (x batch).  `profile_spec`: a
     resolved obs.ProfileSpec whose [S, T, m] per-tile ring rides each
-    sim's carry (x batch).  `stream_window_bytes`:
+    sim's carry (x batch).  `hist_spec`: a resolved obs.HistSpec whose
+    [(T,) H, B] bucket-count ring rides each sim's carry (x batch).
+    `stream_window_bytes`:
     the host->HBM window bound of a streaming run.  Returns consumer ->
     bytes plus a "total" key.  The while-carry double-buffer is NOT
     applied here (it is program-dependent); `CostReport.peak_bytes` is
@@ -121,6 +124,8 @@ def residency_breakdown(*, state=None, trace=None, batch: int = 1,
     if profile_spec is not None:
         out["profile"] = int(profile_ring_bytes(profile_spec)) \
             * int(batch)
+    if hist_spec is not None:
+        out["hist"] = int(hist_ring_bytes(hist_spec)) * int(batch)
     if stream_window_bytes is not None:
         out["stream_window"] = int(stream_window_bytes)
     out["total"] = sum(out.values())
@@ -132,7 +137,8 @@ def device_residency_breakdown(*, state=None, state_split=None,
                                tile_shards: int = 1,
                                per_sim_trace_bytes: int = 0,
                                telemetry_spec=None,
-                               profile_spec=None) -> "dict[str, int]":
+                               profile_spec=None,
+                               hist_spec=None) -> "dict[str, int]":
     """Itemized PER-DEVICE residency of one mesh cell under the round-18
     2D batch x tile campaign layout: each device holds
     `sims_per_shard` sims' tile blocks.
@@ -168,6 +174,11 @@ def device_residency_breakdown(*, state=None, state_split=None,
     if profile_spec is not None:
         out["profile"] = sims * int(profile_spec.ring_bytes(
             tile_shards=dt))
+    if hist_spec is not None:
+        # the aggregate [H, B] ring is replicated (held in full per
+        # shard); only a per-tile [T, H, B] ring splits its tile axis
+        out["hist"] = sims * int(hist_spec.ring_bytes(
+            tile_shards=dt if hist_spec.per_tile else 1))
     out["total"] = sum(out.values())
     return out
 
@@ -185,6 +196,14 @@ def profile_ring_bytes(spec) -> int:
     (the [S, T, m] ring + prev snapshot + times + cursors) — delegates
     to obs.ProfileSpec.ring_bytes, the ONE size model the admission
     bill and the refusal messages share."""
+    return int(spec.ring_bytes())
+
+
+def hist_ring_bytes(spec) -> int:
+    """Per-sim bytes of a latency-histogram spec's device-resident state
+    (the int64 bucket-count ring + boundary counter + optional energy
+    snapshot) — delegates to obs.HistSpec.ring_bytes, the ONE size
+    model the admission bill and the refusal messages share."""
     return int(spec.ring_bytes())
 
 

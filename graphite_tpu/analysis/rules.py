@@ -48,6 +48,12 @@ a Python-level abstraction:
                 no [S, T, m] per-tile ring equation; profile-ON
                 programs add that ring's aval to the cond-payload
                 forbidden set instead.
+  hist-off      the same rule over the round-21 latency histograms
+                (telemetry_off with state_key="hist"): a hist=None
+                program carries no hist-state invar and no int64
+                [H, B] / [T, H, B] bucket-count ring equation; hist-ON
+                programs add that ring's aval to the cond-payload
+                forbidden set instead.
   write-race    the round-20 [T, k]-compaction gate: every scatter is
                 classified single-writer / commutative-multi-writer /
                 ordered-multi-writer through the shared writer-proof
@@ -68,6 +74,7 @@ lines.
 from __future__ import annotations
 
 import dataclasses
+import re
 
 import numpy as np
 
@@ -454,11 +461,16 @@ def telemetry_off(jaxpr, invar_paths=None, ring_sigs=(), *,
     historical program bit-identically" guarantee every overhead claim
     rests on.  The round-16 spatial profiler runs the same rule with
     `state_key="profile"` / `rule="profile-off"` over the [S, T, m]
+    ring signatures; the round-21 latency histograms with
+    `state_key="hist"` / `rule="hist-off"` over the int64 bucket-count
     ring signatures.
     """
     out = []
     for i, p in enumerate(invar_paths or ()):
-        if state_key in p:
+        # Match whole path segments, not substrings: state_key="hist"
+        # must flag "[0].hist.buf" but NOT the pre-existing counter
+        # "[0].mem.counters.line_util_hist".
+        if state_key in re.split(r"[.\[\]']+", p):
             out.append(Finding(
                 rule, SEV_ERROR, "jaxpr.invars",
                 f"{rule} program carries a {state_key}-state "

@@ -70,6 +70,10 @@ class SimResults:
     # was built with a ProfileSpec, else None.  Same pure-observability
     # contract as telemetry (pinned in tests/test_profile.py)
     profile: "object | None" = None
+    # device-recorded latency histograms (obs.Hist) when the run was
+    # built with a HistSpec, else None.  Same pure-observability
+    # contract (pinned in tests/test_hist.py)
+    hist: "object | None" = None
 
     @property
     def total_instructions(self) -> int:
@@ -359,6 +363,7 @@ class Simulator:
         profile=None,
         base_consolidate: bool | None = None,
         dvfs=None,
+        hist=None,
     ):
         """`dir_stage`: force the directory write-staging path on/off
         (None = auto: on for single-device private-L2 runs whose sharers
@@ -820,12 +825,17 @@ class Simulator:
         # runtime DVFS manager (graphite_tpu/dvfs): same attach/resolve/
         # None-contract — None carries no DvfsRtState leaves
         self.dvfs_spec = None
+        # device-resident latency histograms (graphite_tpu/obs/hist.py):
+        # same attach/resolve/None-contract as telemetry/profile
+        self.hist_spec = None
         if telemetry is not None:
             self.attach_telemetry(telemetry)
         if profile is not None:
             self.attach_profile(profile)
         if dvfs is not None:
             self.attach_dvfs(dvfs)
+        if hist is not None:
+            self.attach_hist(hist)
 
     def attach_telemetry(self, spec) -> None:
         """Attach (or replace) a telemetry spec on a not-yet-run
@@ -902,6 +912,41 @@ class Simulator:
         self._lowered = {}   # the spec is baked into the lowering too
         self.lower_gen += 1
 
+    def attach_hist(self, spec) -> None:
+        """Attach (or replace) a latency-histogram spec on a
+        not-yet-run instance: resolves the source selection against
+        this program, seeds the bucket-count ring into the state carry,
+        and invalidates any compiled runner (the spec is baked into the
+        lowering) — the distribution twin of `attach_profile`."""
+        from graphite_tpu.obs.hist import HistSpec, init_hist
+
+        if not isinstance(spec, HistSpec):
+            raise TypeError("hist must be an obs.HistSpec")
+        spec = spec.resolve(self.params)
+        if self.mesh is not None or self.stream:
+            from graphite_tpu.analysis.cost import (
+                ResidencyBudgetError, format_breakdown,
+            )
+
+            raise ResidencyBudgetError(
+                "latency histograms support single-device resident "
+                "runs and batched sweeps only (the ring is not threaded "
+                "through the Simulator's own multi-chip exchange or the "
+                "streaming window loop).  For a multi-device run, serve "
+                "the sim as a campaign under SweepRunner's 2D "
+                "batch x tile layout (layout='tile'/'2d'): a per-tile "
+                "ring's tile axis shards with the directory and "
+                "reassembles on fetch.  Refused residency: "
+                + format_breakdown(
+                    self.residency_breakdown(hist_spec=spec)))
+        self.hist_spec = spec
+        self.state = self.state.replace(hist=init_hist(spec))
+        self._runner = None
+        self._runner_max_quanta = None
+        self._hb_runner = None
+        self._lowered = {}   # the spec is baked into the lowering too
+        self.lower_gen += 1
+
     def attach_dvfs(self, spec, domain_mhz=None) -> None:
         """Attach (or replace) a runtime-DVFS spec on a not-yet-run
         instance: validates it against this program's [dvfs] tables,
@@ -937,13 +982,14 @@ class Simulator:
         self.lower_gen += 1
 
     def residency_breakdown(self, telemetry_spec=None,
-                            profile_spec=None) -> dict:
+                            profile_spec=None, hist_spec=None) -> dict:
         """Per-consumer HBM residency estimate of THIS sim's layout
         (analysis/cost.residency_breakdown): state pytree, resident
         device trace (or one streaming window bound), telemetry ring,
-        per-tile profile ring.  `telemetry_spec`/`profile_spec`
-        override the attached specs — the attach_* refusal paths price
-        the spec they are refusing before it is attached."""
+        per-tile profile ring, histogram ring.  `telemetry_spec`/
+        `profile_spec`/`hist_spec` override the attached specs — the
+        attach_* refusal paths price the spec they are refusing before
+        it is attached."""
         from graphite_tpu.analysis.cost import residency_breakdown
 
         spec = telemetry_spec if telemetry_spec is not None \
@@ -954,6 +1000,9 @@ class Simulator:
             else self.profile_spec
         if pspec is not None and not pspec.resolved:
             pspec = pspec.resolve(self.params)
+        hspec = hist_spec if hist_spec is not None else self.hist_spec
+        if hspec is not None and not hspec.resolved:
+            hspec = hspec.resolve(self.params)
         # the rings are itemized as their own consumers — strip them
         # from the state pytree so an attached spec is not counted twice
         state = self.state
@@ -961,6 +1010,8 @@ class Simulator:
             state = state.replace(telemetry=None)
         if state.profile is not None:
             state = state.replace(profile=None)
+        if state.hist is not None:
+            state = state.replace(hist=None)
         stream_bytes = None
         if self.stream:
             # run_streamed's default [T, W] window, double-buffered by
@@ -974,7 +1025,7 @@ class Simulator:
                             * trace_record_bytes(self.trace_batch))
         return residency_breakdown(
             state=state, trace=self.device_trace,
-            telemetry_spec=spec, profile_spec=pspec,
+            telemetry_spec=spec, profile_spec=pspec, hist_spec=hspec,
             stream_window_bytes=stream_bytes)
 
     @property
@@ -986,6 +1037,16 @@ class Simulator:
         from graphite_tpu.obs.profile import profile_from_state
 
         return profile_from_state(self.profile_spec, self.state.profile)
+
+    @property
+    def hist(self):
+        """The recorded latency histograms (obs.Hist) of everything
+        run so far, or None when the sim records none."""
+        if self.hist_spec is None:
+            return None
+        from graphite_tpu.obs.hist import hist_from_state
+
+        return hist_from_state(self.hist_spec, self.state.hist)
 
     @property
     def telemetry(self):
@@ -1059,7 +1120,8 @@ class Simulator:
                     max_quanta, donate=self.donate,
                     telemetry=self.telemetry_spec,
                     profile=self.profile_spec,
-                    dvfs=self.dvfs_spec)
+                    dvfs=self.dvfs_spec,
+                    hist=self.hist_spec)
             self._runner_max_quanta = max_quanta
         return self._runner
 
@@ -1106,6 +1168,7 @@ class Simulator:
         tel = self.telemetry_spec
         prof = self.profile_spec
         dv = self.dvfs_spec
+        hs = self.hist_spec
         if self.barrier_host:
             from graphite_tpu.engine.step import barrier_host_batch
 
@@ -1114,7 +1177,7 @@ class Simulator:
             def fn(st, tr, prev_qend, budget):
                 return barrier_host_batch(params, tr, st, prev_qend,
                                           qps, budget, telemetry=tel,
-                                          profile=prof, dvfs=dv)
+                                          profile=prof, dvfs=dv, hist=hs)
 
             args = (self.state, self.device_trace,
                     jnp.asarray(0, jnp.int64),
@@ -1127,7 +1190,7 @@ class Simulator:
             def fn(st, tr):
                 return run_simulation(params, tr, st, qps, max_quanta,
                                       telemetry=tel, profile=prof,
-                                      dvfs=dv)
+                                      dvfs=dv, hist=hs)
 
             args = (self.state, self.device_trace)
         return fn, args
@@ -1185,11 +1248,12 @@ class Simulator:
             tel = self.telemetry_spec
             prof = self.profile_spec
             dv = self.dvfs_spec
+            hs = self.hist_spec
 
             def qrun(st, prev_qend, budget):
                 return barrier_host_batch(params, trace, st, prev_qend,
                                           qps, budget, telemetry=tel,
-                                          profile=prof, dvfs=dv)
+                                          profile=prof, dvfs=dv, hist=hs)
 
             self._hb_runner = jax.jit(
                 qrun, donate_argnums=(0,) if self.donate else ())
@@ -1269,7 +1333,12 @@ class Simulator:
             (state.profile.buf, state.profile.times, state.profile.count)
             if state.profile is not None else None
         )
-        return net_part, mem_part, ioc_part, tel_part, prof_part
+        hist_part = (
+            (state.hist.buf, state.hist.boundaries)
+            if state.hist is not None else None
+        )
+        return (net_part, mem_part, ioc_part, tel_part, prof_part,
+                hist_part)
 
     def _timeline_host(self, tel_h):
         """Demux an already-fetched (buf, count) pair into a Timeline —
@@ -1296,18 +1365,33 @@ class Simulator:
             self.profile_spec, np.asarray(buf), np.asarray(times),
             int(count))
 
+    def _hist_host(self, hist_h):
+        """Demux an already-fetched (buf, boundaries) pair into a Hist —
+        rides run()'s ONE batched device→host fetch like the other
+        rings."""
+        if hist_h is None or self.hist_spec is None:
+            return None
+        from graphite_tpu.obs.hist import Hist
+
+        buf, boundaries = hist_h
+        return Hist(sources=tuple(self.hist_spec.sources),
+                    edges=self.hist_spec.bucket_edges(),
+                    counts=np.asarray(buf), boundaries=int(boundaries))
+
     def _results_from_state(self, n_quanta: int) -> SimResults:
         """SimResults from the CURRENT state (after run_chunk loops)."""
         state = self.state
-        (net_part, mem_part, ioc_part, tel_part,
-         prof_part) = self._result_parts(state)
-        core_h, net_h, mem_h, ioc_h, tel_h, prof_h = jax.device_get((
-            state.core, net_part, mem_part, ioc_part, tel_part,
-            prof_part,
-        ))
+        (net_part, mem_part, ioc_part, tel_part, prof_part,
+         hist_part) = self._result_parts(state)
+        core_h, net_h, mem_h, ioc_h, tel_h, prof_h, hist_h = \
+            jax.device_get((
+                state.core, net_part, mem_part, ioc_part, tel_part,
+                prof_part, hist_part,
+            ))
         return self._results_host(core_h, net_h, mem_h, n_quanta, ioc_h,
                                   telemetry=self._timeline_host(tel_h),
-                                  profile=self._profile_host(prof_h))
+                                  profile=self._profile_host(prof_h),
+                                  hist=self._hist_host(hist_h))
 
     def write_output(self, results: SimResults,
                      output_dir: str = "results") -> str:
@@ -1488,6 +1572,7 @@ class Simulator:
                 or other.telemetry_spec != self.telemetry_spec
                 or other.profile_spec != self.profile_spec
                 or other.dvfs_spec != self.dvfs_spec
+                or other.hist_spec != self.hist_spec
                 or other.trace_batch is not self.trace_batch):
             raise ValueError(
                 "adopt_runner needs the same trace batch and identical "
@@ -1526,15 +1611,15 @@ class Simulator:
         # ONE batched device→host fetch for control flags + all summary
         # counters + the telemetry ring (each separate read over a
         # tunneled chip costs ~100 ms).
-        (net_part, mem_part, ioc_part, tel_part,
-         prof_part) = self._result_parts(state)
+        (net_part, mem_part, ioc_part, tel_part, prof_part,
+         hist_part) = self._result_parts(state)
         host = jax.device_get((
             n_quanta_dev, deadlock_dev, state.net.overflow, state.done,
             state.core, net_part, mem_part, ioc_part, tel_part,
-            prof_part, n_iters,
+            prof_part, hist_part, n_iters,
         ))
         (n_quanta, deadlock, overflow, done, core_h, net_h, mem_h,
-         ioc_h, tel_h, prof_h, self.last_n_iterations) = host
+         ioc_h, tel_h, prof_h, hist_h, self.last_n_iterations) = host
         if bool(overflow):
             raise MailboxOverflowError(
                 "a (dst,src) mailbox ring overflowed; re-run with a "
@@ -1551,11 +1636,12 @@ class Simulator:
         self.state = state
         return self._results_host(core_h, net_h, mem_h, int(n_quanta), ioc_h,
                                   telemetry=self._timeline_host(tel_h),
-                                  profile=self._profile_host(prof_h))
+                                  profile=self._profile_host(prof_h),
+                                  hist=self._hist_host(hist_h))
 
     def _results_host(self, core, net_h, mem_h, n_quanta: int,
                       ioc_h=None, telemetry=None,
-                      profile=None) -> SimResults:
+                      profile=None, hist=None) -> SimResults:
         """Assemble SimResults from already-fetched host arrays."""
         clock = np.asarray(core.clock_ps)
         mem_counters = None
@@ -1594,5 +1680,6 @@ class Simulator:
                 if ioc_h is not None else None),
             telemetry=telemetry,
             profile=profile,
+            hist=hist,
         )
 

@@ -160,6 +160,10 @@ class SimState:
     # attached; None (no pytree leaves — same bit-identity contract as
     # telemetry/profile) otherwise
     dvfs_rt: "object" = None
+    # device-resident latency-histogram ring (obs/hist.HistState) when
+    # the run records distributions; None (no pytree leaves — same
+    # bit-identity contract as telemetry/profile) otherwise
+    hist: "object" = None
 
 
 @struct.dataclass

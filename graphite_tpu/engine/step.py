@@ -157,6 +157,7 @@ def subquantum_iteration(
     px: ParallelCtx = IDENT,
     knobs=None,
     dvfs=None,
+    hist=None,
 ) -> tuple[SimState, jax.Array]:
     """Process one trace record per tile; returns (state, tiles_advanced).
 
@@ -308,6 +309,10 @@ def subquantum_iteration(
         # Sharded px runs ungated: the engine's per-phase all-gathers must
         # not sit inside a lax.cond (and the sharded workloads are
         # coherence-dense, so the gate would rarely skip anyway).
+        # per-call miss-fill events only materialize when the histograms
+        # ask for them — fill_events=False keeps MemStepOut leaf-free and
+        # the hist-off trace byte-identical (PROGRAMS.lock fingerprints)
+        fill_ev = hist is not None
         if params.mem_gate and not px.sharded:
             need_mem = state.mem.live | jnp.any(
                 active & slots_present(mem_p, rec, enabled).any(axis=1))
@@ -315,13 +320,15 @@ def subquantum_iteration(
                 need_mem,
                 lambda _: engine_step(mem_p, state.mem, rec,
                                       core.clock_ps, core.freq_mhz,
-                                      active, enabled),
-                lambda _: mem_idle_out(mem_p, state.mem, rec, enabled),
+                                      active, enabled,
+                                      fill_events=fill_ev),
+                lambda _: mem_idle_out(mem_p, state.mem, rec, enabled,
+                                       fill_events=fill_ev),
                 None)
         else:
             mem_out = engine_step(
                 mem_p, state.mem, rec, core.clock_ps, core.freq_mhz,
-                active, enabled, px=px)
+                active, enabled, px=px, fill_events=fill_ev)
         mem_state = mem_out.ms
         mem_ok = mem_out.mem_complete
         mem_acc_ps = mem_out.acc_ps
@@ -1057,6 +1064,37 @@ def subquantum_iteration(
                     | (bsync_now & (bsync_wait_ps > 0))
                     | (cjoin_now & (cjoin_wait_ps > 0))) & enabled
 
+    # --- latency histograms (round 21): commit-site scatter-add ----------
+    # Python-level gate: hist=None adds zero ops and zero carry leaves,
+    # so the off program lowers byte-identically (the hist-off lint).
+    # The recording masks are the counter-increment masks above — the
+    # conservation invariant obs/hist.conservation_totals documents.
+    new_hist = state.hist
+    if hist is not None:
+        from graphite_tpu.obs.hist import hist_commit_update
+
+        mem_kw = {}
+        if params.mem is not None:
+            mem_kw = dict(
+                present=slots_present(mem_p, rec, enabled),
+                slot_lat_ps=mem_out.slot_lat_ps,
+                # per-call miss completions from the engine's phase-6
+                # fill delta (MemStepOut.fill_now) — an entry/exit phase
+                # comparison would miss transactions that start AND fill
+                # within one engine call
+                miss_now=mem_out.fill_now & enabled,
+                miss_lat_ps=mem_out.fill_lat_ps,
+            )
+        new_hist = hist_commit_update(
+            hist, state.hist,
+            advance=advance, enabled=enabled,
+            recv_now=recv_now, recv_lat_ps=recv_lat,
+            recv_charged=recv_charged, recv_wait_ps=recv_wait_ps,
+            sync_charged=sync_charged,
+            sync_wait_ps=(barrier_wait_ps + mutex_wait_ps
+                          + bsync_wait_ps + cjoin_wait_ps),
+            px=px, **mem_kw)
+
     new_core = core.replace(
         clock_ps=clock,
         freq_mhz=freq_mhz,
@@ -1161,12 +1199,13 @@ def subquantum_iteration(
         telemetry=state.telemetry,
         profile=state.profile,
         dvfs_rt=new_rt,
+        hist=new_hist,
     )
     return new_state, jnp.sum(advance, dtype=jnp.int32) + mem_progress
 
 
 def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT,
-                  knobs=None, dvfs=None):
+                  knobs=None, dvfs=None, hist=None):
     """Blocks of `inner_block` iterations until no tile makes progress.
     Returns (state, total_progress, n_iterations)."""
 
@@ -1184,7 +1223,7 @@ def _quantum_loop(params, trace, state, qend, trace_base=None, px=IDENT,
             st, prog, i = carry
             st, adv = subquantum_iteration(params, trace, st, qend,
                                            trace_base, px=px, knobs=knobs,
-                                           dvfs=dvfs)
+                                           dvfs=dvfs, hist=hist)
             return st, prog + adv, i + 1
 
         state, progress, _ = lax.while_loop(
@@ -1255,6 +1294,7 @@ def run_simulation(
     telemetry=None,
     profile=None,
     dvfs=None,
+    hist=None,
 ):
     """The whole simulation as ONE compiled region: an outer while_loop over
     lax-barrier quanta (the MCP barrier loop, `lax_barrier_sync_server.h`)
@@ -1296,11 +1336,20 @@ def run_simulation(
     at quantum boundaries, and (with scale_energy) the energy series
     prices each domain at its current V²·f operating point.  None (the
     default) lowers a bit-identical program (the `dvfs-off` audit lint).
+
+    `hist` (a RESOLVED obs.HistSpec; state.hist must hold the matching
+    HistState) records the latency histograms: the commit-site sources
+    scatter inside `subquantum_iteration` and the boundary sources
+    (clock skew, energy deltas) sample here every executed quantum.
+    None (the default) lowers a bit-identical program (the `hist-off`
+    audit lint).
     """
     if telemetry is not None:
         from graphite_tpu.obs.telemetry import telemetry_tick
     if profile is not None:
         from graphite_tpu.obs.profile import profile_tick
+    if hist is not None:
+        from graphite_tpu.obs.hist import hist_boundary_tick
     if dvfs is not None:
         from graphite_tpu.dvfs.runtime import core_freq_tiles, governor_tick
     # energy terms price at the carried operating point only when asked
@@ -1338,7 +1387,8 @@ def run_simulation(
             qend = jnp.maximum(prev_qend + qps, next_boundary(min_pending))
         st2, progress, blk_iters = _quantum_loop(params, trace, st, qend,
                                                  trace_base, px=px,
-                                                 knobs=knobs, dvfs=dvfs)
+                                                 knobs=knobs, dvfs=dvfs,
+                                                 hist=hist)
         if dvfs is not None and dvfs.governor is not None:
             # reactive governor: step the governed domains' V/f level on
             # the utilization window — masked arithmetic only (the
@@ -1361,6 +1411,13 @@ def run_simulation(
             # tick appends only this device's lanes (obs/profile.py)
             st2 = st2.replace(profile=profile_tick(profile, st2, px=px,
                                                    dvfs=dvfs_energy))
+        if hist is not None:
+            # boundary sources sample EVERY executed quantum (each one
+            # is a whole-fleet skew observation — the four-scheme
+            # study's instrument); under a tile-sharded px the per-tile
+            # ring appends only this device's lanes (obs/hist.py)
+            st2 = st2.replace(hist=hist_boundary_tick(hist, st2, px=px,
+                                                      dvfs=dvfs_energy))
         # Zero progress: if some non-done tile sits beyond qend (it crossed
         # the boundary executing one long record), jump the window up to it
         # — blocked peers may wait on its future sends.  Only when every
@@ -1407,6 +1464,7 @@ def barrier_host_batch(
     telemetry=None,
     profile=None,
     dvfs=None,
+    hist=None,
 ):
     """Up to `max_quanta` lax_barrier quanta as ONE compiled region — the
     batched form of the host-driven barrier loop (Simulator.barrier_host).
@@ -1434,6 +1492,8 @@ def barrier_host_batch(
         from graphite_tpu.obs.telemetry import telemetry_tick
     if profile is not None:
         from graphite_tpu.obs.profile import profile_tick
+    if hist is not None:
+        from graphite_tpu.obs.hist import hist_boundary_tick
     if dvfs is not None:
         from graphite_tpu.dvfs.runtime import core_freq_tiles, governor_tick
     dvfs_energy = (params.dvfs
@@ -1459,7 +1519,7 @@ def barrier_host_batch(
                                         jnp.asarray(2**62, I64)))
         qend = jnp.maximum(prev + qps, next_boundary(min_pending))
         st2, progress, blk_iters = _quantum_loop(params, trace, st, qend,
-                                                 dvfs=dvfs)
+                                                 dvfs=dvfs, hist=hist)
         if dvfs is not None and dvfs.governor is not None:
             rt2 = governor_tick(dvfs.governor, params.dvfs,
                                 st2.dvfs_rt, st2)
@@ -1474,6 +1534,9 @@ def barrier_host_batch(
         if profile is not None:
             st2 = st2.replace(profile=profile_tick(profile, st2,
                                                    dvfs=dvfs_energy))
+        if hist is not None:
+            st2 = st2.replace(hist=hist_boundary_tick(hist, st2,
+                                                      dvfs=dvfs_energy))
         zero = (progress == 0) & jnp.any(~st2.done)
         ahead_clock = jnp.min(jnp.where(
             ~st2.done & (st2.core.clock_ps >= qend),
@@ -1496,7 +1559,7 @@ def barrier_host_batch(
 def make_simulation_runner(params: EngineParams, trace: DeviceTrace,
                            quantum_ps: int | None, max_quanta: int,
                            donate: bool = False, telemetry=None,
-                           profile=None, dvfs=None):
+                           profile=None, dvfs=None, hist=None):
     """`donate=True` hands the input state's buffers to XLA (halves the
     protocol state's HBM residency — the 1024-tile directory is 2.4 GB,
     and without donation input + output + scatter staging exceeds the
@@ -1504,6 +1567,6 @@ def make_simulation_runner(params: EngineParams, trace: DeviceTrace,
     def run(state: SimState):
         return run_simulation(params, trace, state, quantum_ps, max_quanta,
                               telemetry=telemetry, profile=profile,
-                              dvfs=dvfs)
+                              dvfs=dvfs, hist=hist)
 
     return jax.jit(run, donate_argnums=(0,) if donate else ())

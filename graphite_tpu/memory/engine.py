@@ -581,6 +581,22 @@ class MemStepOut:
     acc_ps: jax.Array        # int64[T] memory latency of the record so far
     slot_lat_ps: jax.Array   # int64[T, 3] per-slot latency [icache, m0, m1]
     progress: jax.Array      # int32[] events this iteration
+    # miss-service completions THIS call (fills consumed by phase 6).
+    # A whole miss transaction can start and fill within one engine call
+    # (message timestamps model the latency, not iteration count), so
+    # callers observing only the entry/exit requester phase undercount;
+    # these carry the per-call events for the round-21 latency
+    # histograms.  fill_lat_ps is the filled slot's end-to-end latency
+    # (lookup + protocol round trip — the same value the slot_lat_ps
+    # algebra records).  Over a drained run, total fills == total miss
+    # starts (l2_misses for `msi`, the three L1 miss counters for
+    # `pr_l1_sh_l2*`) — the conservation pairing obs/hist checks.
+    # Opt-in via `fill_events=True`: None (the default) contributes no
+    # pytree leaves and no equations, so hist-off programs keep lowering
+    # the historical trace byte-identically (the `hist-off` audit lint
+    # and the pre-existing PROGRAMS.lock fingerprints).
+    fill_now: "jax.Array | None" = None      # bool[T] miss completed this call
+    fill_lat_ps: "jax.Array | None" = None   # int64[T] its slot latency
 
 
 def slots_present(mp: MemParams, rec: "RecView", enabled) -> jax.Array:
@@ -647,7 +663,8 @@ def dir_store_avals(ms) -> tuple:
     )
 
 
-def mem_idle_out(mp: MemParams, ms, rec: "RecView", enabled) -> MemStepOut:
+def mem_idle_out(mp: MemParams, ms, rec: "RecView", enabled,
+                 fill_events: bool = False) -> MemStepOut:
     """The engine step's result when there is provably nothing to do —
     no lane's record carries memory slots and no protocol state is live
     (`ms.live`).  Lets the caller skip the whole engine under a lax.cond
@@ -659,10 +676,13 @@ def mem_idle_out(mp: MemParams, ms, rec: "RecView", enabled) -> MemStepOut:
     mem_complete = (ms.req.phase == PHASE_IDLE) & (final_slot >= 3)
     if ms.phase_skips is not None:
         ms = ms.replace(phase_skips=ms.phase_skips + 1)
+    T = ms.req.phase.shape[0]
     return MemStepOut(
         ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
         slot_lat_ps=ms.req.slot_lat_ps,
-        progress=jnp.zeros((), jnp.int32))
+        progress=jnp.zeros((), jnp.int32),
+        fill_now=jnp.zeros((T,), jnp.bool_) if fill_events else None,
+        fill_lat_ps=jnp.zeros((T,), I64) if fill_events else None)
 
 
 # --------------------------------------------------------------------------
@@ -1298,6 +1318,7 @@ def memory_engine_step(
     active: jax.Array,        # bool[T] lane may start new work this iter
     enabled,                  # bool[] models enabled
     px: ParallelCtx = IDENT,  # shard_map exchange context (parallel/px.py)
+    fill_events: bool = False,  # emit per-call MemStepOut.fill_now/_lat_ps
 ) -> MemStepOut:
     T = mp.n_tiles
     tiles = np.arange(T, dtype=np.int32)
@@ -1783,6 +1804,11 @@ def memory_engine_step(
     # ======================================================================
     pred6 = ((ms.req.phase == PHASE_WAIT_REPLY)
              & (ms.mail.rep_type != MSG_NONE)).any()
+    # fill observability: only phase 6's fill advances req.slot / adds to
+    # req.acc_ps, so the pre/post delta IS the per-call fill event — exact
+    # even when the whole miss started in phase 1 of this same call
+    slot_pre6 = ms.req.slot
+    acc_pre6 = ms.req.acc_ps
     if gate:
         ms, p = _cond_nodir(
             pred6,
@@ -1809,6 +1835,8 @@ def memory_engine_step(
         ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
         slot_lat_ps=ms.req.slot_lat_ps,
         progress=progress,
+        fill_now=(ms.req.slot != slot_pre6) if fill_events else None,
+        fill_lat_ps=(ms.req.acc_ps - acc_pre6) if fill_events else None,
     )
 
 

@@ -374,6 +374,7 @@ def shl2_engine_step(
     active: jax.Array,
     enabled,
     px: ParallelCtx = IDENT,
+    fill_events: bool = False,
 ) -> MemStepOut:
     T = mp.n_tiles
     tiles = jnp.arange(T, dtype=jnp.int32)
@@ -600,6 +601,12 @@ def shl2_engine_step(
     # ======================================================================
     pred6 = ((ms.req.phase == PHASE_WAIT_REPLY)
              & (ms.mail.rep_type != MSG_NONE)).any()
+    # fill observability for the round-21 latency histograms: phase 6's
+    # fill is the only writer of req.slot / req.acc_ps in this block, so
+    # the pre/post delta is the exact per-call miss completion (see
+    # engine.MemStepOut.fill_now)
+    slot_pre6 = ms.req.slot
+    acc_pre6 = ms.req.acc_ps
     if gate:
         ms, p = _cond_nodir(
             pred6,
@@ -627,6 +634,8 @@ def shl2_engine_step(
     return MemStepOut(
         ms=ms, mem_complete=mem_complete, acc_ps=ms.req.acc_ps,
         slot_lat_ps=ms.req.slot_lat_ps, progress=progress,
+        fill_now=(ms.req.slot != slot_pre6) if fill_events else None,
+        fill_lat_ps=(ms.req.acc_ps - acc_pre6) if fill_events else None,
     )
 
 

@@ -37,6 +37,26 @@ Spatial profiler (round 16):
 `profile=None` (the default) lowers the same bit-identical program —
 enforced by the `profile-off` audit lint.
 
+Latency histograms (round 21):
+
+  HistSpec           — what to bucket (sources, edges, per_tile)
+  HistState          — the int64 [H, B] / [T, H, B] bucket-count ring
+                       riding SimState.hist
+  hist_commit_update — the commit site's masked scatter-add
+  hist_boundary_tick — the outer loop's per-quantum skew/energy sample
+  Hist               — one sim's fetched counts (+ deterministic
+                       p50/p95/p99 via the shared bucket_quantile)
+  demux_hists        — [B, ...] campaign state -> B Hists
+  conservation_totals — histogram total vs matching cumulative counter
+
+    hist = HistSpec()                 # dense: every available source
+    sim = Simulator(cfg, batch, hist=hist)
+    res = sim.run()
+    res.hist.quantile("miss_lat_ps", 0.99)
+
+`hist=None` (the default) lowers the same bit-identical program —
+enforced by the `hist-off` audit lint.
+
 Host side (round 14, consumed by serve/service.py):
 
   MetricsRegistry    — counters / gauges / fixed-bucket histograms with
@@ -51,10 +71,16 @@ on a fake clock; neither ever touches a traced program (tracing on/off
 serve results are bit-equal, regress-pinned).
 """
 
+from graphite_tpu.obs.hist import (  # noqa: F401
+    HIST_BOUNDARY_SOURCES, HIST_CORE_SOURCES, HIST_ENERGY_SOURCES,
+    HIST_MEM_SOURCES, Hist, HistSpec, HistState, available_hist_sources,
+    conservation_totals, demux_hists, hist_boundary_tick,
+    hist_commit_update, hist_from_state, init_hist,
+)
 from graphite_tpu.obs.metrics import (  # noqa: F401
     Counter, DEFAULT_COUNT_BUCKETS, DEFAULT_LATENCY_BUCKETS, Gauge,
     Histogram, MetricsError, MetricsRegistry, RATIO_BUCKETS,
-    parse_exposition,
+    bucket_quantile, parse_exposition,
 )
 from graphite_tpu.obs.telemetry import (  # noqa: F401
     CORE_SERIES, ENERGY_SERIES, EnergyPrices, LEVEL_SERIES, MEM_SERIES,
@@ -80,6 +106,13 @@ __all__ = [
     "ENERGY_SERIES",
     "EnergyPrices",
     "Gauge",
+    "HIST_BOUNDARY_SOURCES",
+    "HIST_CORE_SOURCES",
+    "HIST_ENERGY_SOURCES",
+    "HIST_MEM_SOURCES",
+    "Hist",
+    "HistSpec",
+    "HistState",
     "Histogram",
     "JOB_SPANS",
     "LEVEL_SERIES",
@@ -101,12 +134,20 @@ __all__ = [
     "TelemetryState",
     "TileProfile",
     "Tracer",
+    "available_hist_sources",
     "available_series",
     "available_tile_series",
+    "bucket_quantile",
+    "conservation_totals",
+    "demux_hists",
     "demux_profiles",
     "demux_timelines",
     "gini",
     "grid_shape",
+    "hist_boundary_tick",
+    "hist_commit_update",
+    "hist_from_state",
+    "init_hist",
     "init_profile",
     "init_telemetry",
     "job_breakdown",

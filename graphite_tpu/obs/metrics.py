@@ -61,6 +61,27 @@ class MetricsError(ValueError):
     """Registry misuse: name collision across types, unknown metric."""
 
 
+def bucket_quantile(counts, bounds, q, *, overflow):
+    """THE deterministic bucket-quantile definition, shared by host
+    histograms (`Histogram.quantile`) and device histograms
+    (`obs/hist.Hist.quantile`): the upper bound of the first bucket
+    whose cumulative count reaches `ceil(q * total)`; observations in
+    the trailing overflow bucket (counts has one more entry than
+    bounds) resolve to `overflow`.  Empty -> 0.0."""
+    if not 0.0 < q <= 1.0:
+        raise MetricsError(f"quantile {q} outside (0, 1]")
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = math.ceil(q * total)
+    cum = 0
+    for i, b in enumerate(bounds):
+        cum += counts[i]
+        if cum >= rank:
+            return b
+    return overflow
+
+
 @dataclasses.dataclass
 class Counter:
     """Monotone cumulative counter (float-valued so wall-clock sums can
@@ -153,17 +174,8 @@ class Histogram:
         return self.sum / self.count if self.count else 0.0
 
     def quantile(self, q: float) -> float:
-        if not 0.0 < q <= 1.0:
-            raise MetricsError(f"quantile {q} outside (0, 1]")
-        if self.count == 0:
-            return 0.0
-        rank = math.ceil(q * self.count)
-        cum = 0
-        for i, b in enumerate(self.bounds):
-            cum += self.counts[i]
-            if cum >= rank:
-                return b
-        return self._max
+        return bucket_quantile(self.counts, self.bounds, q,
+                               overflow=self.max)
 
     def to_snapshot(self) -> dict:
         out = {"count": self.count, "sum": self.sum,

@@ -274,6 +274,14 @@ TILE_AXIS_2D = "tile"
 # [T, m]); the [S] times ring and the scalar cursors stay replicated.
 _PROFILE_TILE_AXES = {"profile.buf": 1, "profile.prev": 0}
 
+# The round-21 latency-histogram ring: a PER-TILE [T, H, B] buffer
+# shards its tile axis (obs/hist._scatter lo()s the masks to local
+# lanes); the aggregate [H, B] buffer stays replicated — the commit
+# masks are the replicated full-[T] control vectors, so every shard
+# accumulates the identical fleet-wide counts.  Distinguished by ndim
+# (3 = per-tile) since both layouts share the leaf name.
+_HIST_TILE_AXES = {"hist.buf": 0}
+
 
 def make_batch_tile_mesh(batch_shards: int, tile_shards: int,
                          devices=None, abstract: bool = False):
@@ -318,6 +326,8 @@ def campaign_state_specs(state: SimState):
             return P(BATCH_AXIS, TILE_AXIS_2D,
                      *([None] * (leaf.ndim - 1)))
         t_axis = _PROFILE_TILE_AXES.get(name)
+        if t_axis is None and name in _HIST_TILE_AXES and leaf.ndim == 3:
+            t_axis = _HIST_TILE_AXES[name]
         if t_axis is not None:
             dims = [None] * leaf.ndim
             dims[t_axis] = TILE_AXIS_2D
@@ -338,7 +348,7 @@ def shard_split_bytes(state: SimState) -> "dict[str, int]":
     """Split one sim's state bytes into the 2D layout's residency
     classes: {'tile_local': bytes of the _SHARD_MAP_LOCAL arrays (each
     device holds 1/tile_shards of them), 'replicated': everything else
-    (every tile shard holds a full copy)}.  Telemetry/profile ring
+    (every tile shard holds a full copy)}.  Telemetry/profile/hist ring
     leaves are excluded — they are priced separately through their
     specs' own ring_bytes (the one size model)."""
     from graphite_tpu.analysis.walk import aval_bytes
@@ -347,7 +357,8 @@ def shard_split_bytes(state: SimState) -> "dict[str, int]":
 
     def visit(path, leaf):
         name = _path_name(path)
-        if name.startswith("telemetry.") or name.startswith("profile."):
+        if name.startswith("telemetry.") or name.startswith("profile.") \
+                or name.startswith("hist."):
             return
         b = aval_bytes(leaf)
         if name in _SHARD_MAP_LOCAL:

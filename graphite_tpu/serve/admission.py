@@ -9,7 +9,8 @@ entirely from host-side arithmetic (no tracing, no compile):
    profile spec, the same runtime-DVFS spec (the carried-frequency
    reads are baked into the program — differing domain configurations
    never co-batch, while `dvfs_domain_mhz` knob points of ONE spec
-   do), the same
+   do), the same latency-histogram spec (round 21 — the int64 bucket
+   ring is baked into the program too), the same
    bucketed mailbox depth / trace length (lengths and depths round up
    to powers of two so successive batches share one [B, T, L] shape —
    and therefore one program-cache entry), and — round 18 — the same
@@ -93,6 +94,7 @@ class JobMeasure:
     params: object
     telemetry: object          # resolved TelemetrySpec | None
     profile: object            # resolved ProfileSpec | None
+    hist: object               # resolved HistSpec | None
     pad_length: int
     per_sim_bytes: "dict[str, int]"    # whole-sim consumers (dt=1)
     state_replicated: int      # control state every tile shard holds
@@ -117,7 +119,8 @@ class JobMeasure:
             sims_per_shard=sims, tile_shards=tile_shards,
             per_sim_trace_bytes=self.per_sim_bytes["trace"],
             telemetry_spec=self.telemetry,
-            profile_spec=self.profile)
+            profile_spec=self.profile,
+            hist_spec=self.hist)
 
 
 def measure_job(job: Job, *, mailbox_depth: int,
@@ -141,6 +144,11 @@ def measure_job(job: Job, *, mailbox_depth: int,
     # budget instead of OOMing a compiled batch
     profile = (job.profile.resolve(params)
                if job.profile is not None else None)
+    # the int64 bucket ring joins the bill through the same size model
+    # (obs.HistSpec.ring_bytes) — a dense per-tile recording pays its
+    # way through the budget like the profile ring does
+    hist = (job.hist.resolve(params)
+            if job.hist is not None else None)
     per_sim = {
         "state": int(tree_bytes(probe.state)),
         "trace": (params.n_tiles * int(pad_length)
@@ -150,9 +158,12 @@ def measure_job(job: Job, *, mailbox_depth: int,
         per_sim["telemetry"] = int(telemetry.ring_bytes())
     if profile is not None:
         per_sim["profile"] = int(profile.ring_bytes())
+    if hist is not None:
+        per_sim["hist"] = int(hist.ring_bytes())
     split = shard_split_bytes(probe.state)
     return JobMeasure(params=params, telemetry=telemetry,
-                      profile=profile, pad_length=int(pad_length),
+                      profile=profile, hist=hist,
+                      pad_length=int(pad_length),
                       per_sim_bytes=per_sim,
                       state_replicated=int(split["replicated"]),
                       state_tile_local=int(split["tile_local"]))
@@ -236,6 +247,7 @@ class JobClass:
         self.params = measure.params
         self.telemetry = measure.telemetry
         self.profile = measure.profile
+        self.hist = measure.hist
         self.per_sim_bytes = dict(measure.per_sim_bytes)
         self.per_sim_total = measure.per_sim_total
         plan = plan_layout(measure, hbm_budget_bytes=hbm_budget_bytes,
@@ -336,9 +348,16 @@ class AdmissionController:
         # governor into the lowering; dvfs=None jobs keep the historical
         # program.  The per-point dvfs_domain_mhz knob is absent here on
         # purpose — points of one spec share the compiled program.
+        hs = job.hist
+        # the hist spec splits classes too: the int64 bucket ring (its
+        # edges, source selection, per-tile switch and prices) is baked
+        # into the lowering; hist=None jobs keep the historical program
+        hist_key = None if hs is None else (
+            hs.sources, hs.edges, int(hs.log2_buckets),
+            bool(hs.per_tile), hs.energy_prices)
         base = (config_digest(job.resolved_config()), job.n_tiles,
                 job.has_mem_trace(), depth, length, tel_key, prof_key,
-                job.dvfs)
+                job.dvfs, hist_key)
         # round 18: the DEVICE LAYOUT axis.  A 2D batch x tile class
         # lowers a different program than a solo class (the shard_map
         # mesh, specs and exchange are part of the artifact), so the

@@ -88,7 +88,10 @@ class Job:
     same never-co-batch rule — the carried-frequency reads are baked
     into the program, so jobs with differing specs split classes); a
     `dvfs_domain_mhz` knob then seeds this job's operating point and
-    co-batches with other points of the same spec.  `clock_scheme`:
+    co-batches with other points of the same spec.  `hist`: an
+    `obs.HistSpec` recording device-resident latency histograms (the
+    round-21 int64 bucket ring is baked in — same never-co-batch rule).
+    `clock_scheme`:
     override the config's clock-skew management scheme (CLOCK_SCHEMES);
     None keeps the config's own.  `seed`: metadata echoed into the
     result envelope.
@@ -101,6 +104,7 @@ class Job:
     telemetry: object = None     # obs.TelemetrySpec | None
     profile: object = None       # obs.ProfileSpec | None
     dvfs: object = None          # dvfs.DvfsSpec | None
+    hist: object = None          # obs.HistSpec | None
     seed: "int | None" = None
     clock_scheme: "str | None" = None
 
@@ -192,6 +196,12 @@ class Job:
             if not isinstance(self.dvfs, DvfsSpec):
                 raise ValueError(
                     f"job {self.job_id!r}: dvfs must be a dvfs.DvfsSpec")
+        if self.hist is not None:
+            from graphite_tpu.obs.hist import HistSpec
+
+            if not isinstance(self.hist, HistSpec):
+                raise ValueError(
+                    f"job {self.job_id!r}: hist must be an obs.HistSpec")
         if validate_trace:
             from graphite_tpu.trace.validate import validate_batch
 
@@ -220,6 +230,7 @@ class JobResult:
     results: object = None         # SimResults (ok only)
     telemetry: object = None       # obs.Timeline | None
     profile: object = None         # obs.TileProfile | None
+    hist: object = None            # obs.Hist | None
     error: "str | None" = None     # failure message (failed only)
     batch_id: "int | None" = None
     attempts: int = 1
@@ -267,6 +278,11 @@ class JobResult:
                         row["energy_pj"] = int(col.sum())
             if self.profile is not None:
                 row["profile_samples"] = len(self.profile)
+            if self.hist is not None:
+                # total event count across sources — a cheap liveness
+                # signal; the full counts go to --hist-out npz files
+                row["hist_events"] = int(sum(
+                    self.hist.total(s) for s in self.hist.sources))
         if self.timings:
             row.update({k: float(v) for k, v in self.timings.items()})
         if self.error is not None:

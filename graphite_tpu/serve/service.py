@@ -528,6 +528,9 @@ class CampaignService:
                     # the emit span links to the per-tile profile the
                     # way it links to the scalar timeline
                     attrs["profile_samples"] = len(res.profile)
+                if res.hist is not None:
+                    attrs["hist_events"] = int(sum(
+                        res.hist.total(s) for s in res.hist.sources))
                 self.tracer.event(p.job.job_id, "emit", **attrs)
         for p, res in zip(pendings, results):
             self._h["split_depth"].observe(res.attempts)
@@ -640,6 +643,7 @@ class CampaignService:
         tel = "-tel" if cls.telemetry is not None else ""
         tel += "-prof" if cls.profile is not None else ""
         tel += "-dvfs" if getattr(cls, "dvfs", None) is not None else ""
+        tel += "-hist" if getattr(cls, "hist", None) is not None else ""
         # round 18: 2D classes carry their mesh in the name — the
         # layout tag is in the key (injective hash below), but a
         # readable "-2d2x2" names the program a human greps for
@@ -708,7 +712,8 @@ class CampaignService:
             mailbox_depth=cls.mailbox_depth,
             hbm_budget_bytes=self.hbm_budget_bytes,
             telemetry=cls.telemetry,
-            profile=cls.profile, dvfs=cls.dvfs, **layout_kw)
+            profile=cls.profile, dvfs=cls.dvfs,
+            hist=getattr(cls, "hist", None), **layout_kw)
         self._last_layout = runner.layout_name
         self._last_residency = int(
             runner.residency_breakdown()["total"])
@@ -751,9 +756,12 @@ class CampaignService:
                 p = pendings[b]
                 tl = None if out.timelines is None else out.timelines[b]
                 pf = None if out.profiles is None else out.profiles[b]
+                hf = (None if getattr(out, "hists", None) is None
+                      else out.hists[b])
                 results.append(JobResult(
                     job_id=p.job.job_id, status=STATUS_OK,
                     results=out.results[b], telemetry=tl, profile=pf,
+                    hist=hf,
                     batch_id=batch_id, attempts=p.attempts + 1,
                     seed=p.job.seed, knob_point=dict(p.job.knobs),
                     n_quanta=int(out.n_quanta[b]),

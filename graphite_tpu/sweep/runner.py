@@ -56,6 +56,10 @@ class SweepOutcome:
     # with a ProfileSpec: the [B, S, T, m] ring demuxed sim-by-sim
     # (each also rides its SimResults.profile)
     profiles: "list | None" = None
+    # per-sim latency histograms (obs.Hist) when the campaign ran with
+    # a HistSpec: the [B, H, B'] (or [B, T, H, B']) bucket-count ring
+    # demuxed sim-by-sim (each also rides its SimResults.hist)
+    hists: "list | None" = None
     # False for unbounded clock schemes (lax/lax_p2p): there is no
     # quantum in the program, so reporting the knob would claim a value
     # that never entered it
@@ -505,6 +509,8 @@ class SweepRunner:
             state = state.replace(telemetry=None)
         if state.profile is not None:
             state = state.replace(profile=None)
+        if state.hist is not None:
+            state = state.replace(hist=None)
         per_sim_trace = (self.pack.n_tiles * self.pack.length
                          * trace_record_bytes(self.pack.sim(0)))
         return device_residency_breakdown(
@@ -512,7 +518,8 @@ class SweepRunner:
             tile_shards=tile_shards,
             per_sim_trace_bytes=per_sim_trace,
             telemetry_spec=self.sim.telemetry_spec,
-            profile_spec=self.sim.profile_spec)
+            profile_spec=self.sim.profile_spec,
+            hist_spec=self.sim.hist_spec)
 
     def device_breakdown(self) -> "dict[str, int]":
         """Per-DEVICE itemized residency of the chosen layout: each
@@ -548,11 +555,14 @@ class SweepRunner:
             state = state.replace(telemetry=None)
         if state.profile is not None:
             state = state.replace(profile=None)
+        if state.hist is not None:
+            state = state.replace(hist=None)
         return residency_breakdown(
             state=state, trace=trace_arrays,
             batch=self.pack.n_sims,
             telemetry_spec=self.sim.telemetry_spec,
-            profile_spec=self.sim.profile_spec)
+            profile_spec=self.sim.profile_spec,
+            hist_spec=self.sim.hist_spec)
 
     @property
     def n_sims(self) -> int:
@@ -571,6 +581,7 @@ class SweepRunner:
         unbounded = self.sim.quantum_ps is None
         tel = self.sim.telemetry_spec
         prof = self.sim.profile_spec
+        hs = self.sim.hist_spec
         dv = self.sim.dvfs_spec
 
         def one(state, trace, kn, px=None):
@@ -600,7 +611,7 @@ class SweepRunner:
                             state.dvfs.voltage_mv.shape)))
             return run_simulation(params, trace, state, q, max_quanta,
                                   knobs=kn, telemetry=tel, profile=prof,
-                                  dvfs=dv, **kw)
+                                  dvfs=dv, hist=hs, **kw)
 
         if isinstance(self.layout_spec, tuple):
             # the 2D batch x tile mesh: each device holds a tile block
@@ -747,12 +758,13 @@ class SweepRunner:
         states0, dtr = self._batched_inputs()
         state, nq_d, deadlock_d, iters_d = self._get_runner(max_quanta)(
             states0, dtr, self.knobs)
-        net_part, mem_part, ioc_part, tel_part, prof_part = \
+        net_part, mem_part, ioc_part, tel_part, prof_part, hist_part = \
             Simulator._result_parts(state)
         (nq, deadlock, overflow, done, core_h, net_h, mem_h, ioc_h,
-         tel_h, prof_h, iters) = jax.device_get((
+         tel_h, prof_h, hist_h, iters) = jax.device_get((
             nq_d, deadlock_d, state.net.overflow, state.done, state.core,
-            net_part, mem_part, ioc_part, tel_part, prof_part, iters_d))
+            net_part, mem_part, ioc_part, tel_part, prof_part, hist_part,
+            iters_d))
         if overflow.any():
             raise MailboxOverflowError(
                 f"mailbox ring overflow in sim(s) "
@@ -795,6 +807,13 @@ class SweepRunner:
             # the [B, S, T, m] ring rode the same ONE batched fetch;
             # the demux serves vmap and batch-shard_map campaigns alike
             profiles = demux_profiles(self.sim.profile_spec, prof_h)
+        hists = None
+        if self.sim.hist_spec is not None and hist_h is not None:
+            from graphite_tpu.obs.hist import demux_hists
+
+            # the [B, (T,) H, B'] count ring rode the same ONE batched
+            # fetch; the demux serves vmap and shard_map campaigns alike
+            hists = demux_hists(self.sim.hist_spec, hist_h)
         results = [
             self.sim._results_host(
                 row(core_h, b), row(net_h, b),
@@ -802,7 +821,8 @@ class SweepRunner:
                 int(nq[b]),
                 None if ioc_h is None else row(ioc_h, b),
                 telemetry=None if timelines is None else timelines[b],
-                profile=None if profiles is None else profiles[b])
+                profile=None if profiles is None else profiles[b],
+                hist=None if hists is None else hists[b])
             for b in range(B)
         ]
         phase_skips = None
@@ -823,4 +843,5 @@ class SweepRunner:
                             quantum_valid=self.sim.quantum_ps is not None,
                             timelines=timelines,
                             profiles=profiles,
+                            hists=hists,
                             layout=self.layout_name)

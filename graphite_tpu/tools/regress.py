@@ -41,7 +41,13 @@ and the round-20 bounded model checker must exhaust the 2-tile/1-line
 MSI and MOSI state spaces with zero invariant violations, replay every
 explored transition bit-equal through the vectorized engines, and
 catch the seeded 'mosi-owner-skips-wb' mutant with a named data-value
-counterexample (rung 14).
+counterexample (rung 14), and the round-21 device-resident latency
+histograms must be pure observability (hist on/off SimResults
+bit-identical, gated + ungated), conserve events exactly (every
+histogram total bit-equals its paired cumulative counter), demux the
+B=4 campaign identically to sequential recordings, and export a valid
+monotone-stamped Chrome trace via tools/report.py --perfetto
+(rung 15).
 """
 
 from __future__ import annotations
@@ -716,6 +722,78 @@ scheme = lax
     print(f"{'mutant self-test names data-value':44} "
           f"{'PASS' if ok else 'FAIL'}")
     failures += 0 if ok else 1
+
+    # 15) latency histograms (round 21, obs/hist.py): a dense device-
+    #     resident histogram recording must leave every SimResults
+    #     field bit-identical (gated + ungated — the hist=None
+    #     off-identity's runtime twin), every histogram total must
+    #     bit-equal its paired cumulative counter (the conservation
+    #     invariant, on every config this rung runs), the B=4
+    #     campaign's demuxed hists must equal sequential solo
+    #     recordings bucket-for-bucket, and the unified --perfetto
+    #     export must load back as valid JSON with monotone per-track
+    #     stamps.
+    import json as _json
+    import os as _os
+    import tempfile as _tf2
+
+    from graphite_tpu.obs import HistSpec, conservation_totals
+    from graphite_tpu.tools import report as _report
+
+    hspec = HistSpec()
+    hist_ref = None
+    for gate, label in ((True, "gated"), (False, "ungated")):
+        sim_h = Simulator(sc_b, batch, phase_gate=gate, mem_gate_bytes=0,
+                          hist=hspec)
+        r_h = sim_h.run()
+        r_off = Simulator(sc_b, batch, phase_gate=gate,
+                          mem_gate_bytes=0).run()
+        failures += _compare(f"hist on vs off ({label} MSI, 16t)",
+                             r_h, r_off)
+        cons = conservation_totals(
+            r_h.hist, r_h, protocol=sim_h.params.mem.protocol)
+        ok = (all(a == b for a, b in cons.values())
+              and any(a > 0 for a, _ in cons.values()))
+        print(f"{f'hist conservation ({label}, {len(cons)} src)':44} "
+              f"{'PASS' if ok else 'FAIL'}"
+              + ("" if ok else f"  ({cons})"))
+        failures += 0 if ok else 1
+        hist_ref = r_h.hist
+    sweep_h = SweepRunner(sc_b, sweep_traces, hist=hspec)
+    out_h = sweep_h.run()
+    proto_h = sweep_h.sim.params.mem.protocol
+    for b, s in enumerate(seeds):
+        solo = Simulator(sc_b, sweep_traces[b],
+                         mailbox_depth=sweep_h.mailbox_depth,
+                         phase_gate=False, mem_gate_bytes=0,
+                         hist=hspec).run()
+        hb = out_h.hists[b]
+        cons = conservation_totals(hb, out_h.results[b],
+                                   protocol=proto_h)
+        ok = (np.array_equal(hb.counts, solo.hist.counts)
+              and hb.boundaries == solo.hist.boundaries
+              and all(a == c for a, c in cons.values()))
+        print(f"{f'sweep B=4 sim {b} hist vs sequential':44} "
+              f"{'PASS' if ok else 'FAIL'}")
+        failures += 0 if ok else 1
+    with _tf2.TemporaryDirectory() as td:
+        hp = _os.path.join(td, "hist.npz")
+        hist_ref.save(hp)
+        outp = _os.path.join(td, "trace.json")
+        n_ev = _report.write_perfetto(outp, hists=[hp])
+        with open(outp) as fh:
+            doc = _json.load(fh)
+        evs = doc.get("traceEvents", [])
+        ok = n_ev == len(evs) and n_ev > 2
+        last = {}
+        for e in evs:
+            if e["ph"] == "M":
+                continue
+            ok = ok and e["ts"] >= last.get(e["pid"], 0)
+            last[e["pid"]] = e["ts"]
+        print(f"{'perfetto export valid JSON + monotone':44} "
+              f"{'PASS' if ok else 'FAIL'}")
+        failures += 0 if ok else 1
 
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
     return 1 if failures else 0

@@ -33,7 +33,16 @@ Input kinds, one renderer:
   --metrics FILE    a Prometheus text exposition written by
                     `tools/serve.py --metrics-out` — renders counters/
                     gauges and histogram summaries (count, sum,
-                    p50/p90/p99 from the cumulative buckets).
+                    p50/p90/p99 from the cumulative buckets);
+  --perfetto OUT.json
+                    unified Chrome-trace export (round 21): merges the
+                    span JSONL (`--spans`, host-time track), telemetry
+                    timelines (positional .npz), per-tile profiles
+                    (`--profile-npz`) and latency histograms
+                    (`--hist`, `obs.Hist.save` / `tools/serve.py
+                    --hist-out`) into ONE trace with separate
+                    host-time and sim-time clock tracks — open in the
+                    Perfetto UI or chrome://tracing.
 
 Output (stdout):
 
@@ -52,6 +61,8 @@ Usage:
   python -m graphite_tpu.tools.report --spans spans.jsonl --format text
   python -m graphite_tpu.tools.report --trade-curve spans.jsonl
   python -m graphite_tpu.tools.report --metrics metrics.prom
+  python -m graphite_tpu.tools.report --perfetto trace.json \
+      --spans spans.jsonl --hist hists/*.npz run.npz
 """
 
 from __future__ import annotations
@@ -339,6 +350,107 @@ def _hist_quantile(buckets: "dict[str, int]", count: int,
     return f">{finite[-1][0]}" if finite else "inf"
 
 
+HOST_PID = 1   # serve lifecycle spans (tracer clock, ts in us)
+SIM_PID = 2    # device rings: telemetry/profile counters + histograms
+               # (simulated time, ts in ns)
+
+
+def perfetto_events(*, spans: "str | None" = None, timelines=(),
+                    profiles=(), hists=()) -> "list[dict]":
+    """One Chrome-trace event list from every observability artifact.
+
+    Two clock tracks, kept as separate trace processes because their
+    clocks never align: HOST_PID carries the serve span JSONL
+    (`tools/serve.py --trace-out`, ts = tracer microseconds) as 'X'
+    complete events, SIM_PID carries the device rings in SIMULATED
+    time — telemetry and per-tile profile samples as 'C' counter
+    tracks (ts = sim ns), and each latency histogram as one instant
+    event whose args hold the deterministic count/p50/p95/p99 summary
+    (`obs.Hist.summary` — the shared bucket_quantile definition).
+    Events are sorted (pid, ts), so every track's stamps are monotone
+    — the invariant tools/regress.py's perfetto rung asserts."""
+    events = [
+        {"ph": "M", "pid": HOST_PID, "tid": 0, "ts": 0,
+         "name": "process_name",
+         "args": {"name": "host-time (serve spans, us)"}},
+        {"ph": "M", "pid": SIM_PID, "tid": 0, "ts": 0,
+         "name": "process_name",
+         "args": {"name": "sim-time (device rings, ns)"}},
+    ]
+    if spans:
+        from graphite_tpu.obs.trace import load_jsonl
+
+        for r in load_jsonl(spans):
+            ev = {"name": r["span"], "cat": "serve", "ph": "X",
+                  "pid": HOST_PID, "tid": r["trace"],
+                  "ts": int(r["start_us"]),
+                  "dur": int(r["dur_us"])}
+            extra = {k: v for k, v in r.items()
+                     if k not in ("trace", "span", "start_us",
+                                  "dur_us")}
+            if extra:
+                ev["args"] = extra
+            events.append(ev)
+    if timelines:
+        from graphite_tpu.obs import Timeline
+
+        for b, path in enumerate(timelines):
+            tl = Timeline.load(path)
+            for row in tl.json_rows():
+                for s in tl.series:
+                    if s == "time_ps":
+                        continue
+                    events.append({
+                        "name": f"tl{b}.{s}", "cat": "telemetry",
+                        "ph": "C", "pid": SIM_PID, "tid": 0,
+                        "ts": int(row["time_ns"]),
+                        "args": {"value": int(row[s])}})
+    if profiles:
+        from graphite_tpu.obs.profile import TileProfile
+
+        for b, path in enumerate(profiles):
+            prof = TileProfile.load(path)
+            times = prof.time_ns
+            for s in prof.series:
+                col = prof.col(s)       # [S, T]
+                for i in range(len(prof)):
+                    # one stacked counter track per series: every
+                    # tile's value rides the same event's args
+                    events.append({
+                        "name": f"prof{b}.{s}", "cat": "profile",
+                        "ph": "C", "pid": SIM_PID, "tid": 0,
+                        "ts": int(times[i]),
+                        "args": {f"t{t}": int(col[i, t])
+                                 for t in range(prof.n_tiles)}})
+    if hists:
+        from graphite_tpu.obs.hist import Hist
+
+        for b, path in enumerate(hists):
+            h = Hist.load(path)
+            for s in h.sources:
+                events.append({
+                    "name": f"hist{b}.{s}", "cat": "hist", "ph": "i",
+                    "pid": SIM_PID, "tid": 0, "ts": 0, "s": "g",
+                    "args": {"count": h.total(s),
+                             "p50": h.quantile(s, 0.5),
+                             "p95": h.quantile(s, 0.95),
+                             "p99": h.quantile(s, 0.99),
+                             "file": path}})
+    # metadata first, then every track's stamps monotone within its pid
+    events.sort(key=lambda e: (e["ph"] != "M", e["pid"], e["ts"]))
+    return events
+
+
+def write_perfetto(out_path: str, **kw) -> int:
+    """Write the unified Chrome trace (load in Perfetto UI /
+    chrome://tracing); returns the event count."""
+    events = perfetto_events(**kw)
+    with open(out_path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ns"},
+                  fh)
+    return len(events)
+
+
 def render_metrics(path: str, fmt: str) -> "list[str]":
     """Prometheus text dump -> aligned metric summaries."""
     from graphite_tpu.obs.metrics import parse_exposition
@@ -400,6 +512,20 @@ def main(argv=None) -> int:
                     help="render a Prometheus text exposition "
                     "(tools/serve.py --metrics-out) as metric "
                     "summaries")
+    ap.add_argument("--perfetto", metavar="OUT.json",
+                    help="write one unified Chrome-trace JSON merging "
+                    "every given artifact: --spans JSONL (host-time "
+                    "track), positional telemetry .npz + --profile-npz "
+                    "+ --hist .npz files (sim-time track); open in "
+                    "the Perfetto UI or chrome://tracing")
+    ap.add_argument("--hist", metavar="FILE", nargs="+", default=(),
+                    help="latency-histogram .npz file(s) "
+                    "(obs.Hist.save / tools/serve.py --hist-out) to "
+                    "fold into the --perfetto export")
+    ap.add_argument("--profile-npz", metavar="FILE", nargs="+",
+                    default=(),
+                    help="per-tile profile .npz file(s) to fold into "
+                    "the --perfetto export as stacked counter tracks")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--summary", action="store_true",
                     help="emit per-timeline/profile summaries only "
@@ -407,12 +533,23 @@ def main(argv=None) -> int:
                     "peaks, skew/Gini stragglers, ...)")
     args = ap.parse_args(argv)
 
-    modes = sum((bool(args.files), bool(args.spans), bool(args.metrics),
-                 bool(args.trade_curve)))
-    if modes != 1:
-        ap.error("give exactly one input: timeline/profile .npz "
-                 "file(s), --spans FILE, --trade-curve FILE, or "
-                 "--metrics FILE")
+    if args.perfetto:
+        if args.metrics or args.trade_curve or args.heatmap:
+            ap.error("--perfetto combines positional timeline .npz, "
+                     "--spans, --profile-npz and --hist only")
+        if not (args.files or args.spans or args.hist
+                or args.profile_npz):
+            ap.error("--perfetto needs at least one input artifact "
+                     "(timeline .npz, --spans, --profile-npz, --hist)")
+    elif args.hist or args.profile_npz:
+        ap.error("--hist/--profile-npz apply to --perfetto mode only")
+    else:
+        modes = sum((bool(args.files), bool(args.spans),
+                     bool(args.metrics), bool(args.trade_curve)))
+        if modes != 1:
+            ap.error("give exactly one input: timeline/profile .npz "
+                     "file(s), --spans FILE, --trade-curve FILE, or "
+                     "--metrics FILE")
     if args.heatmap and not args.files:
         ap.error("--heatmap needs positional profile .npz file(s)")
     if not args.heatmap and (args.slice is not None or args.series):
@@ -422,6 +559,13 @@ def main(argv=None) -> int:
     import os
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.perfetto:
+        n = write_perfetto(args.perfetto, spans=args.spans,
+                           timelines=args.files,
+                           profiles=args.profile_npz, hists=args.hist)
+        print(json.dumps({"perfetto": args.perfetto, "events": n}))
+        return 0
 
     if args.spans:
         for line in render_spans(args.spans, args.format):

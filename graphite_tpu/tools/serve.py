@@ -24,7 +24,12 @@ Job-spec line schema (all fields except `id` optional):
                // optional "series": [...], "energy": {...} — the
                // per-tile spatial profiler ring (obs.ProfileSpec);
                // render results with tools/report.py --heatmap
-               "series": ["clock_skew_ps", "l2_misses"]}}
+               "series": ["clock_skew_ps", "l2_misses"]},
+   "hist": {"log2_buckets": 32,    // device-resident latency histograms
+            // optional "sources": [...], explicit "edges": [...],
+            // "per_tile": true, "energy": {...} (obs.HistSpec);
+            // persist counts with --hist-out DIR
+            "sources": ["miss_lat_ps", "net_lat_ps"]}}
 
 Usage:
   python -m graphite_tpu.tools.serve --jobs jobs.jsonl --budget-bytes 2e9
@@ -74,6 +79,8 @@ DRYRUN_JOBS = [
                               "dram_access_pj": 500}}},
     {"id": "d6", "tiles": 4, "seed": 7, "accesses": 10,
      "profile": {"sample_interval_ps": 1_000_000, "n_samples": 16}},
+    {"id": "d7", "tiles": 4, "seed": 8, "accesses": 10,
+     "hist": {"log2_buckets": 24}},
 ]
 
 
@@ -148,9 +155,20 @@ def build_job(spec: dict, config_cache: dict):
             n_samples=int(p.get("n_samples", 256)),
             series=tuple(p["series"]) if p.get("series") else None,
             energy_prices=_prices(p, "profile"))
+    hist = None
+    if spec.get("hist"):
+        from graphite_tpu.obs import HistSpec
+
+        h = spec["hist"]
+        hist = HistSpec(
+            sources=tuple(h["sources"]) if h.get("sources") else None,
+            edges=tuple(h["edges"]) if h.get("edges") else None,
+            log2_buckets=int(h.get("log2_buckets", 32)),
+            per_tile=bool(h.get("per_tile", False)),
+            energy_prices=_prices(h, "hist"))
     return Job(job_id=str(spec["id"]), config=sc, trace=trace,
                knobs=dict(spec.get("knobs", {})), telemetry=telemetry,
-               profile=profile, seed=seed,
+               profile=profile, hist=hist, seed=seed,
                clock_scheme=spec.get("clock_scheme"))
 
 
@@ -202,6 +220,12 @@ def main(argv=None) -> int:
                     "DIR/<job_id>.npz (obs.TileProfile.save; the "
                     "result line gains \"profile_file\"; render: "
                     "tools/report.py --heatmap DIR/*.npz)")
+    ap.add_argument("--hist-out", metavar="DIR",
+                    help="save each job's latency histograms as "
+                    "DIR/<job_id>.npz (obs.Hist.save; the result line "
+                    "gains \"hist_file\"; merge into a Chrome trace: "
+                    "tools/report.py --perfetto out.json --hist "
+                    "DIR/*.npz)")
     ap.add_argument("--metrics-out", metavar="FILE",
                     help="write the metrics registry as Prometheus "
                     "text exposition on exit "
@@ -286,6 +310,11 @@ def main(argv=None) -> int:
             path = os.path.join(args.profile_out, f"{res.job_id}.npz")
             res.profile.save(path)
             row["profile_file"] = path
+        if args.hist_out and res.hist is not None:
+            os.makedirs(args.hist_out, exist_ok=True)
+            path = os.path.join(args.hist_out, f"{res.job_id}.npz")
+            res.hist.save(path)
+            row["hist_file"] = path
         print(json.dumps(row), flush=True)
 
     # submit with per-job drain on backpressure: a full queue runs a

@@ -512,14 +512,16 @@ def test_scatter_determinism_const_tables_and_row_axis_limits():
 def test_audit_default_programs_clean():
     """The acceptance gate: gated, ungated, shl2, sweep B=4, the
     telemetry-recording gated engine, the combined sweep+telemetry
-    campaign, the 2D batch x tile campaign (round 18) AND the
-    multi-domain DVFS campaign (round 19) all pass every rule — the
-    same call `tools/regress.py --smoke` and
+    campaign, the 2D batch x tile campaign (round 18), the
+    multi-domain DVFS campaign (round 19) AND the histogram-recording
+    gated engine (round 21) all pass every rule — the same call
+    `tools/regress.py --smoke` and
     `python -m graphite_tpu.tools.audit` make."""
     report = audit(tiles=8)
     assert {r.program for r in report.results} == {
         "gated-msi", "ungated-msi", "shl2-mesi", "sweep-b4",
-        "gated-msi-tel", "sweep-b4-tel", "sweep-b4-2d", "sweep-b4-dvfs"}
+        "gated-msi-tel", "sweep-b4-tel", "sweep-b4-2d", "sweep-b4-dvfs",
+        "gated-msi-hist"}
     # the sweep programs must get the knob-fold rule, the others not
     by_prog = {}
     for r in report.results:
@@ -540,6 +542,10 @@ def test_audit_default_programs_clean():
     # lint must NOT run on it (the ring is policed via cond-payload)
     assert "telemetry-off" not in by_prog["sweep-b4-tel"]
     assert "telemetry-off" in by_prog["sweep-b4"]
+    # the round-21 histogram program records, so the hist-off lint
+    # must NOT run on it; every spec-less program gets it
+    assert "hist-off" not in by_prog["gated-msi-hist"]
+    assert "hist-off" in by_prog["gated-msi"]
     assert report.ok and not report.findings, "\n".join(
         str(f) for f in report.findings)
 
