@@ -21,6 +21,12 @@ from graphite_tpu.analysis.audit import (  # noqa: F401
     clock_invar_indices, default_programs, spec_from_simulator,
     spec_from_sweep,
 )
+from graphite_tpu.analysis.comms import (  # noqa: F401
+    Collective, CommsReport, PhaseComms, collective_kind,
+    collective_metrics, comms_report, extract_collectives,
+    gspmd_insertion_fixture, has_mesh_region, mesh_axis_sizes,
+    replication_drift_fixture, shard_map_uniformity,
+)
 from graphite_tpu.analysis.cost import (  # noqa: F401
     CostReport, ResidencyBudgetError, backend_memory_comparison,
     budget_regression_fixture, check_budget, check_budgets, cost_report,
@@ -36,9 +42,10 @@ from graphite_tpu.analysis.registry import (  # noqa: F401
     record_from_spec, save_lock,
 )
 from graphite_tpu.analysis.rules import (  # noqa: F401
-    Finding, LaneWrite, cond_payload, host_sync, knob_fold,
-    lane_summary, lane_writes, phase_conds, scatter_determinism,
-    time_dtype, vmap_gate, write_race,
+    Finding, LaneWrite, cond_payload, gspmd_insertion, host_sync,
+    knob_fold, lane_summary, lane_writes, phase_conds,
+    replication_drift, scatter_determinism, time_dtype, vmap_gate,
+    write_race,
 )
 from graphite_tpu.analysis.walk import (  # noqa: F401
     aval_bytes, aval_sig, find_eqns, invar_path_strings, iter_eqns,

@@ -331,7 +331,7 @@ def spec_from_sweep(name: str, runner,
 DEFAULT_PROGRAM_NAMES = ("gated-msi", "ungated-msi", "shl2-mesi",
                          "sweep-b4", "gated-msi-tel", "sweep-b4-tel",
                          "sweep-b4-2d", "sweep-b4-dvfs",
-                         "gated-msi-hist")
+                         "gated-msi-hist", "gated-msi-2d")
 
 # cache/directory geometry chosen so the directory entry/sharers avals
 # are UNIQUE in the program (same trick as the phase-gating test) — a
@@ -378,7 +378,7 @@ def gated_msi_simulator(tiles: int = 8, extra_cfg: str = ""):
 
 def default_programs(tiles: int = 8, max_quanta: int = 4096,
                      names=None) -> "list[ProgramSpec]":
-    """The nine audited shapes: gated, ungated, shl2, sweep B=4, the
+    """The ten audited shapes: gated, ungated, shl2, sweep B=4, the
     telemetry-recording gated engine (round 9: the ring's aval joins
     the cond-payload forbidden set; telemetry-OFF programs additionally
     run the telemetry-off lint), the COMBINED sweep-B=4 + telemetry
@@ -393,7 +393,10 @@ def default_programs(tiles: int = 8, max_quanta: int = 4096,
     program where both the sync-delay knob and the frequency grid must
     prove live), plus the latency-histogram gated engine (round 21: the
     dense bucket-count ring joins the cond-payload forbidden set and
-    the commit-site scatters meet every structural lint).
+    the commit-site scatters meet every structural lint), and the
+    per-phase-GATED 2D campaign (round 22: one sim per batch cell so
+    the real phase conds survive next to the packed tile-axis exchange
+    — the shape the comms analyzer attributes phase-by-phase).
 
     Small geometry on purpose — the lints are structural, so the
     8-tile lowering carries the same program shape the 1024-tile
@@ -436,7 +439,8 @@ def default_programs(tiles: int = 8, max_quanta: int = 4096,
             sc_shl2, batch, phase_gate=True, mem_gate_bytes=0),
             max_quanta))
     if "sweep-b4" in names or "sweep-b4-tel" in names \
-            or "sweep-b4-2d" in names or "sweep-b4-dvfs" in names:
+            or "sweep-b4-2d" in names or "sweep-b4-dvfs" in names \
+            or "gated-msi-2d" in names:
         # the sweep config splits the modules over TWO DVFS domains so
         # the sync_delay knob actually crosses a boundary — in a
         # single-domain config it is structurally inert (MemParams.
@@ -491,6 +495,18 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
         runner_2d = SweepRunner(sc_sweep, sweep_traces, layout=(2, 2))
         specs.append(spec_from_sweep("sweep-b4-2d", runner_2d,
                                      max_quanta))
+    if "gated-msi-2d" in names:
+        # round 22: the per-phase-GATED 2D campaign — layout (4, 2)
+        # puts ONE sim per batch cell, so the real lax.cond phase gates
+        # survive (the vmapped layouts above trade them for masked
+        # always-run phases) alongside the packed tile-axis exchange.
+        # This is the registered shape the comms analyzer attributes
+        # collective-by-collective to protocol phases: each phase's
+        # px gather sits immediately before (or inside) its cond.
+        runner_g2d = SweepRunner(sc_sweep, sweep_traces, layout=(4, 2),
+                                 phase_gate=True, mem_gate_bytes=0)
+        specs.append(spec_from_sweep("gated-msi-2d", runner_g2d,
+                                     max_quanta))
     if "gated-msi-hist" in names:
         # the round-21 latency-histogram program: the dense bucket-count
         # ring in the carry — its [H, B] aval joins the cond-payload
@@ -541,7 +557,8 @@ domains = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE>, \
 
 RULE_NAMES = ("cond-payload", "knob-fold", "time-dtype", "vmap-gate",
               "host-sync", "scatter-determinism", "write-race",
-              "telemetry-off", "profile-off", "hist-off", "dvfs-off")
+              "telemetry-off", "profile-off", "hist-off", "dvfs-off",
+              "gspmd-insertion", "replication-drift")
 
 
 @dataclasses.dataclass
@@ -630,6 +647,16 @@ def audit_program(spec: ProgramSpec, *,
     # ordered-multi-writer one (analysis/protocol.py's model checker
     # supplies the reachable fan-in bounds; the gate itself is static)
     add("write-race", rules.write_race(spec.closed, spec.n_tiles))
+    from graphite_tpu.analysis import comms
+    if comms.has_mesh_region(spec.closed):
+        # round 22: mesh programs additionally run the collective
+        # lints — every collective must match the px packed-exchange
+        # whitelist (the mesh.py GSPMD-cliff regression gate), and
+        # every output declared replicated across the tile axis must
+        # be provably uniform
+        add("gspmd-insertion", rules.gspmd_insertion(
+            spec.closed, spec.n_tiles, phase_names=spec.phase_names))
+        add("replication-drift", rules.replication_drift(spec.closed))
     if not spec.expect_telemetry:
         # telemetry-OFF programs must carry no trace of the timeline
         # machinery (ON programs instead police the ring via the

@@ -460,9 +460,19 @@ class CostReport:
     base_kernels_per_iter: int = 0  # per-iter eqns outside the phase conds
     top_eqns: "list[dict]" = dataclasses.field(default_factory=list)
     memory_cmp: "dict | None" = None  # backend_memory_comparison output
+    # round 22: the static collective/ICI metrics (analysis/comms.py).
+    # None on non-mesh programs — the keys exist only where collectives
+    # can, so every pre-round-22 BUDGETS.json entry stays byte-identical
+    collectives_per_iter: "int | None" = None
+    ici_bytes_per_iter: "int | None" = None
 
     def metrics(self) -> "dict[str, int]":
-        return {m: int(getattr(self, m)) for m in BUDGET_METRICS}
+        out = {m: int(getattr(self, m)) for m in BUDGET_METRICS}
+        for m in COMMS_METRICS:
+            v = getattr(self, m)
+            if v is not None:
+                out[m] = int(v)
+        return out
 
     def to_json(self) -> dict:
         return {
@@ -515,6 +525,10 @@ def cost_report(spec) -> CostReport:
         it = dynamic_cost(closed)
         phases = per_phase_costs(closed, spec.n_tiles,
                                  getattr(spec, "phase_names", ()))
+    # lazy: comms imports this module (main_loop_body) at its top
+    from graphite_tpu.analysis import comms
+
+    cm = comms.collective_metrics(spec)
     return CostReport(
         program=spec.name,
         tiles=int(spec.n_tiles),
@@ -527,6 +541,10 @@ def cost_report(spec) -> CostReport:
         phase_costs=phases,
         base_kernels_per_iter=it.eqns - sum(p.eqns for p in phases),
         top_eqns=_top_eqns(closed),
+        collectives_per_iter=(None if cm is None
+                              else cm["collectives_per_iter"]),
+        ici_bytes_per_iter=(None if cm is None
+                            else cm["ici_bytes_per_iter"]),
     )
 
 
@@ -581,6 +599,13 @@ def backend_memory_comparison(fn, args, report: "CostReport | None" = None,
 BUDGET_METRICS = ("n_eqns_total", "kernels_per_iter", "bytes_per_iter",
                   "arg_bytes", "out_bytes", "peak_bytes")
 
+# round 22: the collective/ICI pair, budgeted ONLY on mesh programs
+# (CostReport carries None elsewhere and metrics() drops them — the
+# keys never appear in a non-mesh BUDGETS.json entry).  The ratchet
+# over ici_bytes_per_iter is the [T, k] mailbox compaction's
+# acceptance metric (ROADMAP).
+COMMS_METRICS = ("collectives_per_iter", "ici_bytes_per_iter")
+
 # ceiling = measured * rel + abs: counts get 10% + a small absolute
 # slack (jax point releases shuffle a few eqns), byte metrics 15% + 64 KB
 # (padding/layout noise) — tight enough that a doubled carried buffer or
@@ -593,6 +618,12 @@ _SLACK = {
     "arg_bytes": (1.05, 1 << 12),
     "out_bytes": (1.05, 1 << 12),
     "peak_bytes": (1.15, 1 << 16),
+    # collective counts are exact program structure — a single stray
+    # collective should blow the count budget, so the absolute slack is
+    # small; ICI bytes get byte-metric treatment at a 4 KB floor (the
+    # audited shapes move only a few KB per iteration)
+    "collectives_per_iter": (1.10, 2),
+    "ici_bytes_per_iter": (1.15, 1 << 12),
 }
 
 

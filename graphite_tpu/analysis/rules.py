@@ -662,3 +662,94 @@ def write_race(jaxpr, n_tiles: int, *,
             "write-race", SEV_ERROR if gated else SEV_WARNING,
             w.site, msg, data=data))
     return out
+
+
+# ---------------------------------------------------------------------------
+# rule 12: gspmd-insertion (round 22)
+# ---------------------------------------------------------------------------
+
+
+def gspmd_insertion(jaxpr, n_tiles: int, *,
+                    phase_names=()) -> "list[Finding]":
+    """No collective outside the px packed-exchange whitelist.
+
+    The regression gate for the mesh.py cliff: the packed exchange
+    (`ParallelCtx.ag`) emits exactly ONE collective shape — a full-axis
+    tiled int64 all_gather of the phase's packed descriptor — and the
+    declared replication reductions are full-axis psum-likes.  Anything
+    else in a mesh program is a STRAY: the tiny per-field/per-scatter
+    collectives the GSPMD partitioner re-inserts when a rewrite loses
+    the packing (~270 per iteration, measured 16x slower — see
+    parallel/mesh.py's warning block), a partial-axis group reduction,
+    or a permute the engine never emits.  Error severity; each finding
+    names the collective's protocol phase so the report says WHERE the
+    exchange discipline broke."""
+    from graphite_tpu.analysis import comms
+
+    out = []
+    for c in comms.extract_collectives(
+            jaxpr, n_tiles=n_tiles, phase_names=phase_names,
+            axis_env=comms.mesh_axis_sizes(jaxpr)):
+        if c.kind != comms.KIND_STRAY:
+            continue
+        out.append(Finding(
+            "gspmd-insertion", SEV_ERROR, c.site,
+            f"stray collective {c.primitive} over axis "
+            f"({c.axis_name}) in phase '{c.phase}': "
+            f"{c.dtype}{list(c.shape)} ({c.ici_bytes} ICI bytes) is "
+            f"outside the px packed-exchange whitelist (one full-axis "
+            f"tiled int64 all_gather per phase) and the declared "
+            f"replication reductions — the GSPMD-insertion cliff "
+            f"(parallel/mesh.py) reintroduces ~270 such collectives "
+            f"per iteration.  Route the field through ParallelCtx.ag's "
+            f"packed descriptor instead",
+            data=c.to_json()))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule 13: replication-drift (round 22)
+# ---------------------------------------------------------------------------
+
+
+def replication_drift(jaxpr) -> "list[Finding]":
+    """Every shard_map output DECLARED replicated across the tile axis
+    must be PROVABLY uniform.
+
+    The multi-chip engine recomputes its [T] control vectors, mailbox
+    matrices and sync tables identically on every device
+    (parallel/px.py's replication contract; `campaign_state_specs`
+    declares them unsharded) — the contract holds only if nothing
+    shard-dependent ever reaches a replicated carry slot.  The comms
+    analyzer's tile-variance dataflow checks exactly that: variance
+    enters at tile-sharded inputs, `axis_index`, and partial-axis
+    (grouped) collectives, and is killed only by a full-axis exchange
+    or reduction.  A declared-replicated output the dataflow cannot
+    prove uniform — e.g. a partial-axis psum leaking a group-local
+    value into a replicated carry — is silent cross-device divergence:
+    the replicas disagree and every downstream bit-identity claim is
+    void.  Error severity; findings name the leaking collective sites."""
+    from graphite_tpu.analysis import comms
+
+    out = []
+    for row in comms.shard_map_uniformity(jaxpr):
+        if not row["non_uniform"]:
+            continue
+        leak_s = ", ".join(
+            f"{lk['primitive']} at {lk['site']}"
+            for lk in row["leaks"]) or "no collective leak recorded " \
+            "(variance flows from a sharded input or axis_index)"
+        out.append(Finding(
+            "replication-drift", SEV_ERROR, row["site"],
+            f"shard_map output(s) {row['non_uniform']} are declared "
+            f"replicated across the tile axis (no tile entry in "
+            f"out_names) but are not provably uniform — a "
+            f"shard-dependent value leaks into a replicated carry "
+            f"slot and the device replicas can silently diverge.  "
+            f"Variance sources: {leak_s}",
+            data={"site": row["site"],
+                  "non_uniform": list(row["non_uniform"]),
+                  "declared_replicated":
+                      list(row["declared_replicated"]),
+                  "leaks": list(row["leaks"])}))
+    return out

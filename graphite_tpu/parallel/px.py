@@ -46,7 +46,12 @@ class ParallelCtx:
 
     @property
     def sharded(self) -> bool:
-        return self.axis is not None
+        # n_dev > 1: a 1-device tile axis needs no exchange — ag()/lo()
+        # must be identities so solo programs lower to ZERO collective
+        # equations and provably pay no fabric tax (the comms analyzer
+        # pins this; a size-1 all_gather would still round-trip every
+        # field through the int64 descriptor packing)
+        return self.axis is not None and self.n_dev > 1
 
     # -- local block addressing ------------------------------------------
 

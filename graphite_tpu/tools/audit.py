@@ -11,7 +11,16 @@ forbidden set for recording programs), knob-fold, time-dtype,
 vmap-gate, host-sync, scatter-determinism, write-race (the round-20
 [T, k]-compaction gate — no ordered-multi-writer scatter into a req
 lane or mailbox matrix; `--lanes` emits the full classification
-table), telemetry-off, profile-off.  Each program's STATIC COST report (analysis/cost.py —
+table), telemetry-off, profile-off, and (round 22, mesh programs only)
+gspmd-insertion + replication-drift — every collective must match the
+px packed-exchange whitelist and every declared-replicated shard_map
+output must be provably uniform across the tile axis.  `--comms` emits
+each mesh program's per-phase collective/ICI table (analysis/comms.py:
+collectives_per_iter / ici_bytes_per_iter, phase-attributed and priced
+by the ring model); `--comms-fixture` swaps in the known-bad legacy
+unpacked-exchange lowering — the gspmd-insertion lint MUST exit
+nonzero naming the stray collectives' phase (the CI self-test for the
+mesh.py GSPMD-cliff gate).  Each program's STATIC COST report (analysis/cost.py —
 per-iteration kernel proxy with per-phase attribution, bytes moved,
 peak-live residency) is emitted as a JSON line alongside the lint rows.
 Pure static analysis over `jax.make_jaxpr` output: no compile, no
@@ -49,6 +58,7 @@ Usage:
                                      [--lock | --lock-update]
                                      [--lock-file PATH]
                                      [--lock-fixture]
+                                     [--comms] [--comms-fixture]
 """
 
 from __future__ import annotations
@@ -81,6 +91,17 @@ def main(argv=None) -> int:
                     "/ commutative / ordered — the [T, k] compaction "
                     "input; reachable fan-in bounds come from "
                     "tools/mc.py)")
+    ap.add_argument("--comms", action="store_true",
+                    help="emit each mesh program's per-phase "
+                    "collective/ICI table (collectives_per_iter / "
+                    "ici_bytes_per_iter, phase-attributed and priced "
+                    "by the ring model; non-mesh programs emit a "
+                    "mesh:false row)")
+    ap.add_argument("--comms-fixture", action="store_true",
+                    help="audit the known-bad legacy unpacked-exchange "
+                    "lowering instead of the real programs — the "
+                    "gspmd-insertion lint MUST exit nonzero naming the "
+                    "stray collectives' protocol phase (CI self-test)")
     ap.add_argument("--programs", default=None,
                     help="comma-separated subset of program names "
                     "(default: all seven)")
@@ -138,10 +159,12 @@ def main(argv=None) -> int:
     if args.allow_increase and not args.ratchet:
         ap.error("--allow-increase is a ratchet exception; it needs "
                  "--budget-update --ratchet")
-    if args.regression_fixture and args.lock_fixture:
-        ap.error("--regression-fixture and --lock-fixture each swap in "
-                 "their own known-bad program; run the self-tests "
-                 "separately")
+    n_fixtures = sum((args.regression_fixture, args.lock_fixture,
+                      args.comms_fixture))
+    if n_fixtures > 1:
+        ap.error("--regression-fixture, --lock-fixture and "
+                 "--comms-fixture each swap in their own known-bad "
+                 "program; run the self-tests separately")
     # each fixture self-tests ONE gate; arming the OTHER gate alongside
     # lets its finding (the budget fixture's perturbed identity always
     # trips the lock) carry the nonzero exit even when the gate under
@@ -154,7 +177,18 @@ def main(argv=None) -> int:
         ap.error("--lock-fixture self-tests the lock gate; combine it "
                  "with --budget and the exit code no longer isolates "
                  "the gate under test (run the budget gate separately)")
-    if (args.regression_fixture or args.lock_fixture) \
+    if args.comms_fixture and (args.budget or args.lock):
+        # same isolation discipline as the other fixtures: the
+        # gspmd-insertion lint always runs on mesh programs, so the
+        # fixture needs no gate armed — but an unregistered fixture
+        # also trips the budget/lock gates, and either would carry the
+        # nonzero exit even with the lint under test broken
+        ap.error("--comms-fixture self-tests the gspmd-insertion lint; "
+                 "--budget/--lock would trip on the unregistered "
+                 "fixture and mask a broken lint (run those gates "
+                 "separately)")
+    if (args.regression_fixture or args.lock_fixture
+            or args.comms_fixture) \
             and (args.budget_update or args.lock_update):
         # both fixtures deliberately reuse the real program's name so
         # their gates run against the checked-in baselines — writing a
@@ -179,12 +213,13 @@ def main(argv=None) -> int:
         DEFAULT_MAX_COND_BYTES, audit, default_programs,
     )
 
+    budgetable = cost.BUDGET_METRICS + cost.COMMS_METRICS
     unknown_metrics = [m for m in args.allow_increase
-                       if m not in cost.BUDGET_METRICS]
+                       if m not in budgetable]
     if unknown_metrics:
         ap.error(f"--allow-increase: unknown metric(s) "
                  f"{unknown_metrics} (choose from "
-                 f"{', '.join(cost.BUDGET_METRICS)})")
+                 f"{', '.join(budgetable)})")
 
     t0 = time.perf_counter()
     names = None
@@ -195,6 +230,9 @@ def main(argv=None) -> int:
             specs = [cost.budget_regression_fixture(args.tiles)]
         elif args.lock_fixture:
             specs = [registry.lock_regression_fixture(args.tiles)]
+        elif args.comms_fixture:
+            from graphite_tpu.analysis import comms
+            specs = [comms.gspmd_insertion_fixture(args.tiles)]
         else:
             specs = default_programs(args.tiles, names=names)
     except ValueError as e:
@@ -295,6 +333,15 @@ def main(argv=None) -> int:
                 "lanes": True, "program": s.name,
                 "n_scatters": len(writes),
                 "table": rules.lane_summary(writes)}))
+
+    if args.comms:
+        from graphite_tpu.analysis import comms
+        for s in specs:
+            if not comms.has_mesh_region(s.closed):
+                print(json.dumps({"comms": True, "program": s.name,
+                                  "mesh": False}))
+                continue
+            print(json.dumps(comms.comms_report(s).to_json()))
 
     for f in report.findings:
         print(json.dumps(f.to_json()))

@@ -47,7 +47,12 @@ bit-identical, gated + ungated), conserve events exactly (every
 histogram total bit-equals its paired cumulative counter), demux the
 B=4 campaign identically to sequential recordings, and export a valid
 monotone-stamped Chrome trace via tools/report.py --perfetto
-(rung 15).
+(rung 15), and the round-22 collective/ICI analyzer must pass its
+comms audit over the registered mesh programs under the forced-4-
+device re-exec (every collective a whitelisted px packed exchange,
+every declared-replicated output provably uniform) while the known-bad
+legacy unpacked-exchange fixture trips the gspmd-insertion lint with
+exit 1 (rung 16).
 """
 
 from __future__ import annotations
@@ -794,6 +799,37 @@ scheme = lax
         print(f"{'perfetto export valid JSON + monotone':44} "
               f"{'PASS' if ok else 'FAIL'}")
         failures += 0 if ok else 1
+
+    # 16) collective/ICI traffic analyzer (round 22, analysis/comms.py):
+    #     the comms audit must exit 0 over the registered mesh programs
+    #     — every collective a whitelisted px packed exchange, every
+    #     declared-replicated shard_map output provably uniform, the
+    #     per-phase collective tables emitted — and the known-bad
+    #     legacy unpacked-exchange fixture must trip the
+    #     gspmd-insertion lint (exit 1, the stray's phase named).  Both
+    #     run under the same forced-4-host-device re-exec recipe as
+    #     rung 12 so the audit sees a real multi-device platform.
+    import os as _os16
+    import subprocess as _sp16
+
+    env16 = dict(_os16.environ)
+    env16["JAX_PLATFORMS"] = "cpu"
+    flags16 = env16.get("XLA_FLAGS", "")
+    env16["XLA_FLAGS"] = (
+        flags16 + " --xla_force_host_platform_device_count=4").strip()
+    rc = _sp16.call(
+        [sys.executable, "-m", "graphite_tpu.tools.audit",
+         "--programs", "sweep-b4-2d,gated-msi-2d", "--comms"],
+        env=env16, stdout=_sp16.DEVNULL)
+    print(f"{'comms audit (mesh programs, forced 4-dev)':44} "
+          f"{'PASS' if rc == 0 else 'FAIL'}")
+    failures += 0 if rc == 0 else 1
+    rc = _sp16.call(
+        [sys.executable, "-m", "graphite_tpu.tools.audit",
+         "--comms-fixture"], env=env16, stdout=_sp16.DEVNULL)
+    print(f"{'gspmd-insertion fixture exits 1':44} "
+          f"{'PASS' if rc == 1 else 'FAIL'}")
+    failures += 0 if rc == 1 else 1
 
     print(f"{failures} failure(s)  ({_t.perf_counter() - t0:.0f}s)")
     return 1 if failures else 0

@@ -12,12 +12,25 @@ is broken.  Run:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -m graphite_tpu.tools.shard_bench
 
-Prints one line per (workload, devices) with wall-clock and the
-sharded/single ratio.
+Output is JSON lines in bench.py's field convention — one row per
+workload with {"metric", "value", "unit", "vs_baseline"} plus
+companions: the single-device and GSPMD wall-clocks, and the STATIC
+collective counts of the packed-exchange lowering (analysis/comms.py
+over a SweepRunner tile-axis lowering of the same config —
+`collectives_per_iter` / `ici_bytes_per_iter` / stray count), so every
+measured number sits next to the collective budget that explains it.
+`vs_baseline` is the shard_map/single wall ratio: ~1 means the sharded
+lowering costs what the math costs; GSPMD's ~10x is the pathology the
+packed exchange exists to avoid.
+
+With fewer than 2 visible devices the bench emits a single
+{"skipped": true, "reason": ...} row and exits 0 — the measured
+comparison needs a mesh, and a silent half-run would look like data.
 """
 
 from __future__ import annotations
 
+import json
 import time
 
 import jax
@@ -43,22 +56,51 @@ def _timed(sc, batch, mesh, repeats=3, spmd=None):
     return best, res
 
 
-def main():
+def _static_comms(sc, batch, n_dev: int) -> dict:
+    """The static collective budget of the same config sharded over the
+    tile axis: lower a (1, n_dev) batch x tile campaign of `batch` over
+    a device-less AbstractMesh (no devices consumed — pure tracing) and
+    run the comms extractor over its main loop.  These are the numbers
+    BUDGETS.json ratchets for the registered mesh programs, computed
+    here for the BENCHED shape so the measured ratio sits next to the
+    collective count that explains it."""
+    from graphite_tpu.analysis import comms
+    from graphite_tpu.analysis.audit import spec_from_sweep
+    from graphite_tpu.sweep import SweepRunner
+
+    runner = SweepRunner(sc, [batch], layout=(1, n_dev))
+    spec = spec_from_sweep("shard-bench", runner, 4096)
+    rep = comms.comms_report(spec)
+    return {
+        "static_collectives_per_iter": int(rep.collectives_per_iter),
+        "static_ici_bytes_per_iter": int(rep.ici_bytes_per_iter),
+        "static_stray_collectives": len(rep.strays()),
+    }
+
+
+def main() -> int:
     # the ambient TPU-tunnel sitecustomize can override JAX_PLATFORMS at
     # interpreter startup; flip it back (same recipe as tests/conftest.py)
     jax.config.update("jax_platforms", "cpu")
-    assert len(jax.devices()) >= 2, (
-        "needs a multi-device platform: run with JAX_PLATFORMS=cpu "
-        "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        print(json.dumps({
+            "skipped": True,
+            "reason": f"needs a multi-device platform (found {n_dev} "
+            f"device); run with JAX_PLATFORMS=cpu "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8",
+            "metric": "multi-device step wall-clock"}))
+        return 0
 
-    from graphite_tpu.parallel.mesh import make_tile_mesh
-    from graphite_tpu.tools._template import coherence_stress_workload, config_text
     from graphite_tpu.config import ConfigFile, SimConfig
+    from graphite_tpu.parallel.mesh import make_tile_mesh
+    from graphite_tpu.tools._template import (
+        coherence_stress_workload, config_text,
+    )
     from graphite_tpu.trace import synthetic
 
-    n_dev = len(jax.devices())
     mesh = make_tile_mesh(n_dev)
-    results = []
+    rows = []
 
     # workload 1: full-MSI coherence stress (the [T, T] mailbox path)
     sc, batch = coherence_stress_workload(64, n_accesses=200)
@@ -67,7 +109,7 @@ def main():
     np.testing.assert_array_equal(r1.clock_ps, rsm.clock_ps)
     tg, rg = _timed(sc, batch, mesh, spmd="gspmd")
     np.testing.assert_array_equal(r1.clock_ps, rg.clock_ps)
-    results.append(("msi_stress_64t", t1, tsm, tg))
+    rows.append(("msi_stress_64t", sc, batch, t1, tsm, tg))
 
     # workload 2: memoryless message ring (the USER-net mailbox path)
     sc2 = SimConfig(ConfigFile.from_string(config_text(64)))
@@ -76,7 +118,7 @@ def main():
     t1b, _ = _timed(sc2, batch2, None)
     tsmb, _ = _timed(sc2, batch2, mesh)
     tgb, _ = _timed(sc2, batch2, mesh, spmd="gspmd")
-    results.append(("ring_64t", t1b, tsmb, tgb))
+    rows.append(("ring_64t", sc2, batch2, t1b, tsmb, tgb))
 
     # workload 3: shared-L2 coherence stress — round 5 put the shL2
     # engines on the packed exchange; its multi-device overhead should
@@ -88,14 +130,25 @@ def main():
     np.testing.assert_array_equal(r1c.clock_ps, rsmc.clock_ps)
     tgc, rgc = _timed(sc3, batch3, mesh, spmd="gspmd")
     np.testing.assert_array_equal(r1c.clock_ps, rgc.clock_ps)
-    results.append(("shl2_stress_64t", t1c, tsmc, tgc))
+    rows.append(("shl2_stress_64t", sc3, batch3, t1c, tsmc, tgc))
 
-    for name, a, b, c in results:
-        print(f"{name}: single={a*1e3:.0f} ms  "
-              f"{n_dev}dev shard_map={b*1e3:.0f} ms ({b/a:.2f}x)  "
-              f"{n_dev}dev gspmd={c*1e3:.0f} ms ({c/a:.2f}x)")
-    return results
+    for name, wsc, wbatch, single, sharded, gspmd in rows:
+        print(json.dumps({
+            "metric": f"multi-device step wall-clock ({name}, "
+            f"{n_dev} dev shard_map)",
+            "value": round(sharded * 1e3, 1),
+            "unit": "ms",
+            "vs_baseline": round(sharded / single, 4),
+            "single_ms": round(single * 1e3, 1),
+            "gspmd_ms": round(gspmd * 1e3, 1),
+            "gspmd_vs_single": round(gspmd / single, 4),
+            "devices": n_dev,
+            **_static_comms(wsc, wbatch, n_dev),
+        }))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())

@@ -513,15 +513,16 @@ def test_audit_default_programs_clean():
     """The acceptance gate: gated, ungated, shl2, sweep B=4, the
     telemetry-recording gated engine, the combined sweep+telemetry
     campaign, the 2D batch x tile campaign (round 18), the
-    multi-domain DVFS campaign (round 19) AND the histogram-recording
-    gated engine (round 21) all pass every rule — the same call
+    multi-domain DVFS campaign (round 19), the histogram-recording
+    gated engine (round 21) AND the per-phase-gated 2D campaign
+    (round 22) all pass every rule — the same call
     `tools/regress.py --smoke` and
     `python -m graphite_tpu.tools.audit` make."""
     report = audit(tiles=8)
     assert {r.program for r in report.results} == {
         "gated-msi", "ungated-msi", "shl2-mesi", "sweep-b4",
         "gated-msi-tel", "sweep-b4-tel", "sweep-b4-2d", "sweep-b4-dvfs",
-        "gated-msi-hist"}
+        "gated-msi-hist", "gated-msi-2d"}
     # the sweep programs must get the knob-fold rule, the others not
     by_prog = {}
     for r in report.results:
